@@ -1,0 +1,24 @@
+"""Test harness config: run everything on an 8-device virtual CPU mesh.
+
+The TPU analogue of the reference's "N containers on one box" topology
+(SURVEY.md §4): multi-device behavior is exercised without hardware via
+``--xla_force_host_platform_device_count``.
+
+Note: the environment's sitecustomize imports jax at interpreter startup
+(registering the live TPU backend), so setting JAX_PLATFORMS here is too
+late — instead we flip the platform with ``jax.config.update`` before
+any backend is initialized, and extend XLA_FLAGS (read at backend init,
+not at import).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep f32 matmuls exact on CPU so oracle-parity tolerances are meaningful.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,108 @@
+"""JAX forward vs. float64 numpy oracle (SURVEY.md §4 implication (a)).
+
+The oracle mirrors manual_nn.forward_pass; the jit path must match to
+f32 tolerance on sample-scale and MNIST-scale models.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.core.activations import apply_activation
+from tpu_dist_nn.models.fcnn import (
+    forward,
+    forward_logits,
+    init_fcnn,
+    params_from_spec,
+    spec_from_params,
+)
+from tpu_dist_nn.testing.factories import random_inputs, random_model
+from tpu_dist_nn.testing.oracle import oracle_forward, oracle_forward_batch
+
+
+def test_forward_matches_oracle_small():
+    model = random_model([6, 5, 4, 3], seed=7)
+    x = random_inputs(9, 6)
+    params = params_from_spec(model)
+    got = np.asarray(jax.jit(forward)(params, jnp.asarray(x, jnp.float32)))
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_forward_matches_oracle_mnist_shape():
+    # The exported/served model shape: 784-32-16-10 (notebook cell 8).
+    model = random_model([784, 32, 16, 10], seed=8)
+    x = random_inputs(32, 784)
+    params = params_from_spec(model)
+    got = np.asarray(jax.jit(forward)(params, jnp.asarray(x, jnp.float32)))
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-5)
+    # Softmax outputs sum to 1.
+    np.testing.assert_allclose(got.sum(-1), np.ones(32), rtol=1e-5)
+
+
+def test_forward_float64_exact():
+    # With x64 enabled the jit path agrees with the float64 oracle tightly.
+    model = random_model([12, 8, 4], seed=9)
+    x = random_inputs(5, 12)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        params = params_from_spec(model, dtype=jnp.float64)
+        got = np.asarray(forward(params, jnp.asarray(x, jnp.float64)))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-14)
+
+
+def test_activations_match_oracle_names():
+    x = jnp.asarray(np.linspace(-3, 3, 24).reshape(4, 6), jnp.float32)
+    for name, ref in [
+        ("relu", lambda v: np.maximum(0, v)),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("linear", lambda v: v),
+        ("tanh", np.tanh),
+    ]:
+        got = np.asarray(apply_activation(x, name))
+        np.testing.assert_allclose(got, ref(np.asarray(x, np.float64)), rtol=1e-5, atol=1e-6)
+    # Unknown activation falls back to linear (grpc_node.py:72-73).
+    np.testing.assert_allclose(np.asarray(apply_activation(x, "mystery")), np.asarray(x))
+
+
+def test_softmax_stability():
+    x = jnp.asarray([[1000.0, 1000.0, 999.0]], jnp.float32)
+    out = np.asarray(apply_activation(x, "softmax"))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-6)
+
+
+def test_logits_mode_skips_final_activation():
+    model = random_model([6, 4, 3], seed=10)
+    params = params_from_spec(model)
+    x = jnp.asarray(random_inputs(3, 6), jnp.float32)
+    probs = jax.jit(forward)(params, x)
+    logits = jax.jit(forward_logits)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(jax.nn.softmax(logits, axis=-1)), np.asarray(probs), rtol=1e-5, atol=1e-7
+    )
+
+
+def test_init_and_export_round_trip():
+    params = init_fcnn(jax.random.key(0), [20, 16, 10])
+    spec = spec_from_params(params, ["relu", "softmax"])
+    assert spec.layers[0].type_tag == "hidden"
+    assert spec.layers[-1].type_tag == "output"
+    x = random_inputs(4, 20)
+    got = np.asarray(forward(params, jnp.asarray(x, jnp.float32)))
+    want = oracle_forward_batch(spec, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_dim_mismatch_raises_in_oracle():
+    model = random_model([6, 4, 3], seed=11)
+    try:
+        oracle_forward(model, np.zeros(5))
+    except ValueError as e:
+        assert "Dimension mismatch" in str(e)
+    else:
+        raise AssertionError("expected ValueError")

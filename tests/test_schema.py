@@ -1,0 +1,135 @@
+"""Schema round-trip and partitioning tests (SURVEY.md §4 implication (d))."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.core.schema import (
+    ModelSpec,
+    StageSpec,
+    load_examples,
+    load_model,
+    partition_model,
+    save_examples,
+    save_model,
+    stage_port,
+    validate_distribution,
+)
+from tpu_dist_nn.testing.factories import random_model
+
+SAMPLE_CONFIG = {
+    # Shape of config_sample.json: per-neuron weights/bias/activation.
+    "layers": [
+        {
+            "type": "hidden",
+            "nodes": 3,
+            "neurons": [
+                {"weights": [0.1, 0.2], "bias": 0.3, "activation": "relu"},
+                {"weights": [0.4, 0.5], "bias": 0.6, "activation": "relu"},
+                {"weights": [0.7, 0.8], "bias": 0.9, "activation": "relu"},
+            ],
+        },
+        {
+            "type": "output",
+            "nodes": 2,
+            "neurons": [
+                {"weights": [1.0, 1.1, 1.2], "bias": 0.5, "activation": "softmax"},
+                {"weights": [1.5, 1.3, 1.1], "bias": 0.8, "activation": "softmax"},
+            ],
+        },
+    ]
+}
+
+
+def test_neuron_weight_transpose_rule():
+    model = ModelSpec.from_json_dict(SAMPLE_CONFIG)
+    l0 = model.layers[0]
+    # Neuron rows stacked then transposed → (in_dim, out_dim) (grpc_node.py:51).
+    assert l0.weights.shape == (2, 3)
+    np.testing.assert_allclose(l0.weights[:, 0], [0.1, 0.2])
+    np.testing.assert_allclose(l0.weights[:, 2], [0.7, 0.8])
+    assert l0.biases.tolist() == [0.3, 0.6, 0.9]
+    assert l0.activation == "relu"
+    assert model.layers[1].activation == "softmax"
+    assert model.input_dim == 2 and model.output_dim == 2
+
+
+def test_model_json_round_trip(tmp_path):
+    model = random_model([7, 5, 3], seed=3)
+    model.metadata["inference_metrics"] = {"accuracy": 0.9685}
+    p = tmp_path / "m.json"
+    save_model(model, p)
+    loaded = load_model(p)
+    assert len(loaded.layers) == 2
+    for a, b in zip(model.layers, loaded.layers):
+        np.testing.assert_allclose(a.weights, b.weights)
+        np.testing.assert_allclose(a.biases, b.biases)
+        assert a.activation == b.activation
+        assert a.type_tag == b.type_tag
+    assert loaded.metadata["inference_metrics"] == {"accuracy": 0.9685}
+
+
+def test_examples_round_trip(tmp_path):
+    inputs = np.random.default_rng(0).uniform(size=(4, 6))
+    labels = np.array([1, 0, 3, 2], dtype=np.int32)
+    p = tmp_path / "ex.json"
+    save_examples(inputs, labels, p)
+    li, ll = load_examples(p)
+    np.testing.assert_allclose(li, inputs)
+    np.testing.assert_array_equal(ll, labels)
+
+
+def test_examples_nested_inputs_flattened(tmp_path):
+    p = tmp_path / "ex.json"
+    p.write_text(json.dumps({"examples": [{"input": [[0.5, 0.8], [0.6, 0.2]], "label": 5}]}))
+    inputs, labels = load_examples(p)
+    assert inputs.shape == (1, 4)
+    assert labels[0] == 5
+
+
+def test_distribution_validation():
+    # sum(layer_distribution) == len(layers) (run_grpc_fcnn.py:182-183).
+    validate_distribution([1, 2], 3)
+    with pytest.raises(ValueError):
+        validate_distribution([1, 1], 3)
+    with pytest.raises(ValueError):
+        validate_distribution([-1, 4], 3)
+
+
+def test_partition_model():
+    model = random_model([8, 6, 4, 2], seed=1)
+    stages = partition_model(model, [2, 1])
+    assert len(stages) == 2
+    assert [len(s.layers) for s in stages] == [2, 1]
+    assert stages[0].expected_input_dim == 8
+    assert stages[1].expected_input_dim == 4
+    assert stages[0].name == "fcnn_node_0"
+    # Port formula parity: 5100 + 100*i + 1 (run_grpc_fcnn.py:221).
+    assert stages[0].port == 5201 - 100  # 5101
+    assert stage_port(2) == 5301
+
+
+def test_partition_empty_stage_is_identity():
+    model = random_model([8, 6, 4], seed=2)
+    stages = partition_model(model, [2, 0, 0])
+    assert stages[1].layers == [] and stages[1].output_dim == 4
+    assert stages[2].expected_input_dim == 4
+
+
+def test_stage_json_round_trip():
+    model = random_model([5, 4, 3], seed=4)
+    stage = partition_model(model, [2])[0]
+    obj = stage.to_stage_json()
+    assert set(obj) == {"layer_0", "layer_1"}
+    back = StageSpec.from_stage_json(obj, index=0)
+    assert len(back.layers) == 2
+    np.testing.assert_allclose(back.layers[0].weights, stage.layers[0].weights)
+
+
+def test_chain_dim_mismatch_raises():
+    model = random_model([5, 4, 3], seed=5)
+    model.layers[1].weights = np.zeros((9, 3))
+    model.layers[1].biases = np.zeros(3)
+    with pytest.raises(ValueError):
+        partition_model(model, [1, 1])

@@ -1,0 +1,20 @@
+"""tpu_dist_nn — a TPU-native pipeline-parallel neural-network framework.
+
+A ground-up JAX/XLA re-design of the capabilities of docker-dist-nn
+(reference: /root/reference): a model described as JSON
+(``layers[].neurons[].{weights,bias,activation}``) is partitioned across
+pipeline stages by a ``layer_distribution`` vector and executed with
+activations handed stage-to-stage — here over TPU ICI via
+``lax.ppermute`` under ``shard_map`` instead of gRPC over a Docker bridge
+network — plus a native on-TPU training path the reference lacks
+(it trains centrally in Keras/torch and serves exported weights).
+
+Public surface:
+  - :mod:`tpu_dist_nn.core.schema` — the JSON model format (load/save),
+    the public contract shared with the reference
+    (``config/config_sample.json``).
+  - :mod:`tpu_dist_nn.models.fcnn` — pure-functional forward pass.
+  - :mod:`tpu_dist_nn.testing` — the float64 numpy oracle and fixtures.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from tpu_dist_nn.core.schema import (  # noqa: F401
+    LayerSpec,
+    ModelSpec,
+    StageSpec,
+    load_examples,
+    load_model,
+    partition_model,
+    save_model,
+    validate_distribution,
+)
+from tpu_dist_nn.core.activations import apply_activation, ACTIVATION_IDS  # noqa: F401

@@ -1,0 +1,77 @@
+"""Activation functions, usable both eagerly and inside traced pipeline stages.
+
+Reproduces the activation semantics of the reference node runtime
+(``/root/reference/src/grpc_node.py:62-73``): relu, sigmoid, numerically
+stable softmax (max-subtracted along the last axis), and linear as the
+fallback for unknown names.  ``tanh`` and ``gelu`` are additions for the
+wider model families (conv / transformer configs in BASELINE.json).
+
+Activations also exist as dense integer ids so that a pipeline stage —
+which under SPMD must be a single traced program shared by all stages —
+can select its activation with ``lax.switch`` instead of Python control
+flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Order matters: index == activation id used by the stage executor's
+# lax.switch. "linear" is id 0 so zero-initialized padding layers are
+# identity-friendly.
+_ACTIVATION_ORDER = ("linear", "relu", "sigmoid", "softmax", "tanh", "gelu")
+
+ACTIVATION_IDS = {name: i for i, name in enumerate(_ACTIVATION_ORDER)}
+
+
+def _linear(x):
+    return x
+
+
+def _relu(x):
+    return jnp.maximum(0, x)
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _softmax(x):
+    # Stable softmax over the last axis, mirroring grpc_node.py:68-71.
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _tanh(x):
+    return jnp.tanh(x)
+
+
+def _gelu(x):
+    return jax.nn.gelu(x)
+
+
+_ACTIVATION_FNS = (_linear, _relu, _sigmoid, _softmax, _tanh, _gelu)
+
+
+def activation_id(name: str) -> int:
+    """Map an activation name to its dense id; unknown names are linear.
+
+    The reference treats any unrecognized activation as linear
+    (grpc_node.py:72-73), so we do the same rather than raising.
+    """
+    return ACTIVATION_IDS.get(name.lower(), 0)
+
+
+def apply_activation(x: jnp.ndarray, name: str) -> jnp.ndarray:
+    """Apply a named activation eagerly (host-side dispatch on the name)."""
+    return _ACTIVATION_FNS[activation_id(name)](x)
+
+
+def apply_activation_by_id(x: jnp.ndarray, act_id) -> jnp.ndarray:
+    """Apply an activation selected by a traced integer id.
+
+    Used inside the pipeline stage executor where the activation is data
+    (part of the stacked per-stage parameters), not Python structure.
+    """
+    return lax.switch(act_id, _ACTIVATION_FNS, x)

@@ -1,0 +1,7 @@
+from tpu_dist_nn.models.fcnn import (  # noqa: F401
+    forward,
+    forward_logits,
+    init_fcnn,
+    params_from_spec,
+    spec_from_params,
+)

@@ -1,0 +1,130 @@
+"""Pure-functional fully-connected network: params pytree + forward.
+
+The TPU-native equivalent of the reference's per-node numpy compute
+(``grpc_node.py:75-97``): each layer computes
+``activation(x @ W + b)`` with ``W`` of shape ``(in_dim, out_dim)``.
+Here the whole chain is a single jit-compiled function — XLA fuses the
+bias add and activation into the MXU matmul — rather than one container
+per layer group with gRPC hops in between.
+
+Dtype policy: parameters default to float32 (the reference wire format
+was float64; TPU MXU wants f32/bf16 — parity with the float64 numpy
+oracle is asserted to tolerance in tests, see SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.core.activations import activation_id, apply_activation_by_id
+from tpu_dist_nn.core.schema import LayerSpec, ModelSpec
+
+
+def params_from_spec(model: ModelSpec, dtype=jnp.float32) -> list[dict]:
+    """Materialize a params pytree from a ModelSpec.
+
+    Activation ids ride along as numpy int32 scalars (hashable/static
+    per-layer in the unrolled forward, traced data in the stacked
+    pipeline representation).
+    """
+    params = []
+    for layer in model.layers:
+        params.append(
+            {
+                "w": jnp.asarray(layer.weights, dtype=dtype),
+                "b": jnp.asarray(layer.biases, dtype=dtype),
+                "act": jnp.asarray(activation_id(layer.activation), dtype=jnp.int32),
+            }
+        )
+    return params
+
+
+def spec_from_params(
+    params: Sequence[dict],
+    activations: Sequence[str],
+    metadata: dict | None = None,
+) -> ModelSpec:
+    """Back-convert a params pytree to the JSON-exportable ModelSpec.
+
+    ``activations`` supplies names (ids are not reversible to arbitrary
+    unknown names). The last layer is tagged "output", the rest "hidden",
+    matching the exporter convention (notebook cell 10).
+    """
+    if len(activations) != len(params):
+        raise ValueError(
+            f"need {len(params)} activation names, got {len(activations)}"
+        )
+    layers = []
+    n = len(params)
+    for i, (p, act) in enumerate(zip(params, activations)):
+        layers.append(
+            LayerSpec(
+                weights=np.asarray(p["w"], dtype=np.float64),
+                biases=np.asarray(p["b"], dtype=np.float64),
+                activation=act,
+                type_tag="output" if i == n - 1 else "hidden",
+            )
+        )
+    return ModelSpec(layers=layers, metadata=dict(metadata or {}))
+
+
+def init_fcnn(
+    key: jax.Array,
+    layer_sizes: Sequence[int],
+    activations: Sequence[str] | None = None,
+    dtype=jnp.float32,
+) -> list[dict]:
+    """He-initialized FCNN params for ``layer_sizes = [in, h1, ..., out]``.
+
+    Default activations: relu on hidden layers, softmax on the output —
+    the reference's training recipes (generate_mnist_pytorch.py:25-32,
+    notebook cell 8) all use this shape.
+    """
+    n_layers = len(layer_sizes) - 1
+    if activations is None:
+        activations = ["relu"] * (n_layers - 1) + ["softmax"]
+    if len(activations) != n_layers:
+        raise ValueError(f"need {n_layers} activations, got {len(activations)}")
+    params = []
+    keys = jax.random.split(key, n_layers)
+    for i in range(n_layers):
+        fan_in, fan_out = layer_sizes[i], layer_sizes[i + 1]
+        w = jax.random.normal(keys[i], (fan_in, fan_out), dtype=dtype) * jnp.sqrt(
+            2.0 / fan_in
+        ).astype(dtype)
+        params.append(
+            {
+                "w": w,
+                "b": jnp.zeros((fan_out,), dtype=dtype),
+                "act": jnp.asarray(activation_id(activations[i]), dtype=jnp.int32),
+            }
+        )
+    return params
+
+
+def forward(params: Sequence[dict], x: jnp.ndarray) -> jnp.ndarray:
+    """Forward pass ``x: (batch, in_dim) -> (batch, out_dim)``.
+
+    The layer loop unrolls at trace time (static structure); each step is
+    ``activation(x @ W + b)`` (grpc_node.py:87-90).
+    """
+    for p in params:
+        x = apply_activation_by_id(x @ p["w"] + p["b"], p["act"])
+    return x
+
+
+def forward_logits(params: Sequence[dict], x: jnp.ndarray) -> jnp.ndarray:
+    """Forward pass that skips the final layer's activation entirely.
+
+    For softmax output layers trained with cross-entropy, where the loss
+    consumes raw logits. A separate function (rather than a bool flag on
+    :func:`forward`) so both are directly jittable with no static args.
+    """
+    for p in params[:-1]:
+        x = apply_activation_by_id(x @ p["w"] + p["b"], p["act"])
+    p = params[-1]
+    return x @ p["w"] + p["b"]

@@ -1,0 +1,1 @@
+from tpu_dist_nn.testing.oracle import oracle_forward, oracle_forward_batch  # noqa: F401

@@ -1,0 +1,37 @@
+"""Test/bench fixtures: random models and synthetic datasets."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_dist_nn.core.schema import LayerSpec, ModelSpec
+
+
+def random_model(
+    layer_sizes,
+    activations=None,
+    seed: int = 0,
+    scale: float = 0.5,
+) -> ModelSpec:
+    """A random float64 ModelSpec with the given ``[in, h1, ..., out]`` sizes."""
+    rng = np.random.default_rng(seed)
+    n = len(layer_sizes) - 1
+    if activations is None:
+        activations = ["relu"] * (n - 1) + ["softmax"]
+    layers = []
+    for i in range(n):
+        fan_in, fan_out = layer_sizes[i], layer_sizes[i + 1]
+        layers.append(
+            LayerSpec(
+                weights=rng.normal(0, scale / np.sqrt(fan_in), (fan_in, fan_out)),
+                biases=rng.normal(0, 0.1, (fan_out,)),
+                activation=activations[i],
+                type_tag="output" if i == n - 1 else "hidden",
+            )
+        )
+    return ModelSpec(layers=layers)
+
+
+def random_inputs(num: int, dim: int, seed: int = 1) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0.0, 1.0, (num, dim))
