@@ -1,0 +1,126 @@
+"""Pipelined executor vs. oracle on a virtual multi-device CPU mesh.
+
+The TPU analogue of the reference's end-to-end validation topology
+(N containers on one box, SURVEY.md §4): N virtual devices on one host,
+stage hand-off via ppermute instead of gRPC.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist_nn.core.schema import partition_model
+from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+from tpu_dist_nn.parallel.pipeline import (
+    build_pipeline_params,
+    pipeline_forward,
+    pipeline_spec_summary,
+)
+from tpu_dist_nn.testing.factories import random_inputs, random_model
+from tpu_dist_nn.testing.oracle import oracle_forward_batch
+
+
+def _run(model, distribution, mesh_spec, n=12, microbatches=1, logits=False):
+    stages = partition_model(model, distribution)
+    params = build_pipeline_params(stages)
+    mesh = build_mesh(mesh_spec)
+    x = random_inputs(n, model.input_dim, seed=42)
+    out = pipeline_forward(
+        mesh, params, x, num_microbatches=microbatches, logits=logits
+    )
+    return np.asarray(out), x
+
+
+def test_four_stage_pipeline_matches_oracle():
+    # 784-32-16-10-ish shape at test scale: uneven widths across stages.
+    model = random_model([20, 12, 8, 6, 4], seed=0)
+    got, x = _run(model, [1, 1, 1, 1], MeshSpec(stage=4), n=16, microbatches=4)
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_pipeline_with_multiple_layers_per_stage():
+    model = random_model([10, 9, 8, 7, 6, 5], seed=1)
+    got, x = _run(model, [2, 2, 1], MeshSpec(stage=3), n=8, microbatches=2)
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_stage_count_must_match_mesh():
+    model = random_model([10, 8, 6], seed=2)
+    stages = partition_model(model, [1, 1])
+    params = build_pipeline_params(stages)
+    mesh = build_mesh(MeshSpec(stage=4))
+    with pytest.raises(ValueError):
+        pipeline_forward(mesh, params, random_inputs(4, 10))
+
+
+def test_data_times_stage_mesh():
+    # DP x PP on the same 8 virtual devices: data=2, stage=4.
+    model = random_model([20, 12, 8, 6, 4], seed=3)
+    got, x = _run(
+        model, [1, 1, 1, 1], MeshSpec(stage=4, data=2), n=24, microbatches=3
+    )
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_uneven_batch_padding():
+    model = random_model([12, 8, 4], seed=4)
+    got, x = _run(model, [1, 1], MeshSpec(stage=2), n=7, microbatches=3)
+    want = oracle_forward_batch(model, x)
+    assert got.shape == (7, 4)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_single_stage_pipeline():
+    model = random_model([12, 8, 4], seed=5)
+    got, x = _run(model, [2], MeshSpec(stage=1), n=6)
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_empty_stage_is_identity():
+    model = random_model([12, 8, 4], seed=6)
+    got, x = _run(model, [1, 0, 1], MeshSpec(stage=3), n=6, microbatches=2)
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_logits_variant():
+    model = random_model([12, 8, 4], seed=7)
+    stages = partition_model(model, [1, 1])
+    params = build_pipeline_params(stages)
+    mesh = build_mesh(MeshSpec(stage=2))
+    x = random_inputs(6, 12, seed=9)
+    probs = np.asarray(pipeline_forward(mesh, params, x))
+    logits = np.asarray(pipeline_forward(mesh, params, x, logits=True))
+    np.testing.assert_allclose(
+        np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1)), probs,
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_input_dim_validation():
+    # The per-forward dim check of the reference (grpc_node.py:83-84),
+    # surfaced host-side before compile (SURVEY.md §7 hard part 5).
+    model = random_model([12, 8, 4], seed=8)
+    stages = partition_model(model, [1, 1])
+    params = build_pipeline_params(stages)
+    mesh = build_mesh(MeshSpec(stage=2))
+    with pytest.raises(ValueError, match="expected input"):
+        pipeline_forward(mesh, params, random_inputs(4, 11))
+
+
+def test_summary():
+    model = random_model([20, 12, 8, 6, 4], seed=10)
+    params = build_pipeline_params(partition_model(model, [2, 2]))
+    s = pipeline_spec_summary(params)
+    assert s == {
+        "num_stages": 2,
+        "layers_per_stage": 2,
+        "padded_width": 20,
+        "input_dim": 20,
+        "output_dim": 4,
+    }

@@ -53,6 +53,16 @@ def _gelu(x):
 
 _ACTIVATION_FNS = (_linear, _relu, _sigmoid, _softmax, _tanh, _gelu)
 
+SOFTMAX_ID = ACTIVATION_IDS["softmax"]
+
+
+def activation_branches() -> list:
+    """The id-ordered activation function list, for building lax.switch
+    tables elsewhere (e.g. the pipeline's masked variant) without
+    duplicating the ordering — lax.switch clamps out-of-range ids, so a
+    desynced copy would silently compute the wrong activation."""
+    return list(_ACTIVATION_FNS)
+
 
 def activation_id(name: str) -> int:
     """Map an activation name to its dense id; unknown names are linear.

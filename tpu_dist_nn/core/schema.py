@@ -157,7 +157,9 @@ class ModelSpec:
             raise ValueError("model has no layers")
         layers = [LayerSpec.from_neurons(lj) for lj in obj["layers"]]
         metadata = {k: v for k, v in obj.items() if k != "layers"}
-        return cls(layers=layers, metadata=metadata)
+        spec = cls(layers=layers, metadata=metadata)
+        spec.validate_chain()
+        return spec
 
     def to_json_dict(self) -> dict:
         out: dict[str, Any] = {"layers": [l.to_neurons() for l in self.layers]}
@@ -251,7 +253,14 @@ class StageSpec:
             LayerSpec.from_neurons({"neurons": obj[k]}) for k in keys if obj[k]
         ]
         if expected_input_dim is None:
-            expected_input_dim = layers[0].in_dim if layers else 0
+            if not layers:
+                # The layer_N format carries no dims of its own; an empty
+                # (identity) stage is unrecoverable without the caller
+                # supplying the pass-through width.
+                raise ValueError(
+                    "stage config has no layers; pass expected_input_dim explicitly"
+                )
+            expected_input_dim = layers[0].in_dim
         return cls(index=index, layers=layers, expected_input_dim=expected_input_dim)
 
 

@@ -27,9 +27,10 @@ from tpu_dist_nn.core.schema import LayerSpec, ModelSpec
 def params_from_spec(model: ModelSpec, dtype=jnp.float32) -> list[dict]:
     """Materialize a params pytree from a ModelSpec.
 
-    Activation ids ride along as numpy int32 scalars (hashable/static
-    per-layer in the unrolled forward, traced data in the stacked
-    pipeline representation).
+    Activation ids ride along as int32 array leaves — they are traced
+    data, so each layer's activation compiles to a runtime lax.switch
+    (not specialized away), which keeps the pytree structure uniform
+    with the stacked pipeline representation.
     """
     params = []
     for layer in model.layers:

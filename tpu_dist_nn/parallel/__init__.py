@@ -1,0 +1,7 @@
+from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh  # noqa: F401
+from tpu_dist_nn.parallel.pipeline import (  # noqa: F401
+    PipelineParams,
+    build_pipeline_params,
+    pipeline_forward,
+    pipeline_spec_summary,
+)

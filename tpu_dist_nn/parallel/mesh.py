@@ -1,0 +1,94 @@
+"""Device mesh construction — the TPU substrate replacing Docker.
+
+The reference's placement substrate is a Docker bridge network with one
+container per stage (``run_grpc_fcnn.py:45-62,83-155``); here placement
+is a ``jax.sharding.Mesh`` whose axes name the parallelism degrees:
+
+* ``stage`` — pipeline stages (the reference's one real axis, §2.3 PP),
+* ``data``  — batch sharding (the reference's client-side chunking,
+  ``run_grpc_inference.py:197-211``, promoted to true data parallelism),
+* ``model`` — tensor parallelism (intra-layer, reserved),
+* ``seq``   — sequence/context parallelism (reserved for the
+  transformer configs; ring attention rides this axis).
+
+Multi-chip topology note: the stage axis should map to an ICI ring so
+``ppermute`` hand-offs ride inter-chip links, which
+``jax.make_mesh``'s default device assignment already optimizes for.
+Without hardware, tests emulate N devices via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_STAGE = "stage"
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named parallelism degrees; product must fit the device count."""
+
+    stage: int = 1
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.stage * self.data * self.model * self.seq
+
+    def axis_names(self) -> tuple[str, ...]:
+        return (AXIS_DATA, AXIS_SEQ, AXIS_STAGE, AXIS_MODEL)
+
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (self.data, self.seq, self.stage, self.model)
+
+
+def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
+    """Build a mesh with axes ``(data, seq, stage, model)``.
+
+    Axis order puts ``stage`` and ``model`` innermost so that pipeline
+    and tensor hand-offs map to nearest-neighbor ICI links, with data
+    parallelism outermost (its all-reduce tolerates DCN on multi-host).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if spec.num_devices > len(devices):
+        raise ValueError(
+            f"mesh spec needs {spec.num_devices} devices "
+            f"({spec.stage} stage x {spec.data} data x {spec.model} model x "
+            f"{spec.seq} seq) but only {len(devices)} are available"
+        )
+    devices = devices[: spec.num_devices]
+    if devices == jax.devices()[: spec.num_devices] and spec.num_devices == len(jax.devices()):
+        # Let JAX optimize assignment for the physical topology. Axis
+        # types must stay Auto (jax 0.9's make_mesh defaults to Explicit,
+        # which switches eager ops into sharding-in-types mode).
+        from jax.sharding import AxisType
+
+        return jax.make_mesh(
+            spec.axis_sizes(),
+            spec.axis_names(),
+            axis_types=(AxisType.Auto,) * 4,
+            devices=devices,
+        )
+    import numpy as np
+
+    arr = np.asarray(devices).reshape(spec.axis_sizes())
+    return Mesh(arr, spec.axis_names())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading batch dim over the data axis."""
+    return NamedSharding(mesh, P(AXIS_DATA))

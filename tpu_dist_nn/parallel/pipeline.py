@@ -1,0 +1,291 @@
+"""Pipeline-parallel stage executor: shard_map + ppermute GPipe schedule.
+
+The TPU-native replacement for the reference's container-per-stage
+pipeline (``grpc_node.py`` + ``run_grpc_fcnn.py``): where the reference
+chains stages with nested synchronous gRPC calls whose reply unwinds
+back through every stage (``grpc_node.py:120-147``), here all stages
+run as one SPMD program over the ``stage`` mesh axis, activations hand
+off device-to-device with ``lax.ppermute`` (ICI, zero serialization —
+vs. the reference's 2x proto ser/de per hop, SURVEY.md §2.4), and
+cross-request concurrency (the reference's 10-thread server pool,
+``grpc_node.py:169``) becomes an explicit GPipe microbatch schedule:
+microbatch ``m`` enters stage 0 at step ``m`` and exits stage ``S-1``
+at step ``m + S - 1``; total steps ``T = M + S - 1``.
+
+Uneven stage shapes (SURVEY.md §7 hard part 1): SPMD wants one traced
+program for every device, so stage parameters are padded to uniform
+``(L, D, D)`` blocks — ``D`` the max layer width, ``L`` the max layer
+count per stage, missing layers filled with identity — and activations
+are masked to each layer's true width (softmax gets ``-inf`` padding so
+its denominator only sees real columns). Zero columns propagate: padded
+input columns stay exactly zero through every masked layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.core.activations import (
+    ACTIVATION_IDS,
+    activation_branches,
+    activation_id,
+)
+from tpu_dist_nn.core.schema import StageSpec
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
+
+_SOFTMAX_ID = ACTIVATION_IDS["softmax"]
+
+
+class PipelineWeights(NamedTuple):
+    """Trainable stage parameters, stacked over a leading stage axis.
+
+    ``w``: (S, L, D, D) — each real layer's (in,out) matrix embedded at
+    ``[:in_dim, :out_dim]``; identity filler for missing layers.
+    ``b``: (S, L, D).
+    """
+
+    w: jax.Array
+    b: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineMeta:
+    """Static (non-trainable) pipeline structure.
+
+    ``act``/``act_logits``: (S, L) activation ids; the logits variant has
+    the final real layer forced to linear so training consumes raw
+    logits. ``width``: (S, L) true output width per layer slot.
+    Hashable by identity so jitted executors can key caches on it.
+    """
+
+    act: tuple[tuple[int, ...], ...]
+    act_logits: tuple[tuple[int, ...], ...]
+    width: tuple[tuple[int, ...], ...]
+    in_dim: int
+    final_dim: int
+    num_stages: int
+    layers_per_stage: int
+    max_dim: int
+
+    def act_array(self, logits: bool) -> np.ndarray:
+        return np.asarray(self.act_logits if logits else self.act, dtype=np.int32)
+
+    def width_array(self) -> np.ndarray:
+        return np.asarray(self.width, dtype=np.int32)
+
+
+class PipelineParams(NamedTuple):
+    weights: PipelineWeights
+    meta: PipelineMeta
+
+
+def build_pipeline_params(stages: Sequence[StageSpec], dtype=jnp.float32) -> PipelineParams:
+    """Pad and stack per-stage layer chains into uniform SPMD blocks."""
+    if not stages:
+        raise ValueError("need at least one stage")
+    S = len(stages)
+    L = max(1, max(len(s.layers) for s in stages))
+    dims = [stages[0].expected_input_dim]
+    for s in stages:
+        for layer in s.layers:
+            dims.append(layer.out_dim)
+    D = max(dims)
+
+    w = np.zeros((S, L, D, D), dtype=np.float64)
+    b = np.zeros((S, L, D), dtype=np.float64)
+    act = np.zeros((S, L), dtype=np.int32)
+    width = np.zeros((S, L), dtype=np.int32)
+    eye = np.eye(D)
+    for si, stage in enumerate(stages):
+        for li in range(L):
+            if li < len(stage.layers):
+                layer = stage.layers[li]
+                w[si, li, : layer.in_dim, : layer.out_dim] = layer.weights
+                b[si, li, : layer.out_dim] = layer.biases
+                act[si, li] = activation_id(layer.activation)
+                width[si, li] = layer.out_dim
+            else:
+                # Identity filler: x @ I = x, full width so the mask is a
+                # no-op and already-zero padding columns pass through.
+                w[si, li] = eye
+                act[si, li] = 0
+                width[si, li] = D
+
+    # Locate the final real layer (last stage with any layers) and force
+    # its activation to linear in the logits variant.
+    act_logits = act.copy()
+    real_stages = [si for si, s in enumerate(stages) if s.layers]
+    if real_stages:
+        si = real_stages[-1]
+        li = len(stages[si].layers) - 1
+        act_logits[si, li] = 0
+
+    final_dim = stages[-1].output_dim
+    meta = PipelineMeta(
+        act=tuple(map(tuple, act.tolist())),
+        act_logits=tuple(map(tuple, act_logits.tolist())),
+        width=tuple(map(tuple, width.tolist())),
+        in_dim=stages[0].expected_input_dim,
+        final_dim=final_dim,
+        num_stages=S,
+        layers_per_stage=L,
+        max_dim=D,
+    )
+    weights = PipelineWeights(w=jnp.asarray(w, dtype), b=jnp.asarray(b, dtype))
+    return PipelineParams(weights=weights, meta=meta)
+
+
+def _masked_activation(z: jax.Array, act_id: jax.Array, width: jax.Array) -> jax.Array:
+    """Apply an activation restricted to the first ``width`` columns.
+
+    Padding columns are forced to exactly zero afterwards; softmax masks
+    its input with -inf so padding never enters the normalizer.
+    """
+    col = lax.broadcasted_iota(jnp.int32, z.shape, z.ndim - 1)
+    mask = col < width
+
+    def _masked_softmax(v):
+        return jax.nn.softmax(jnp.where(mask, v, -jnp.inf), axis=-1)
+
+    # Same id-ordered table as the single-chip path, with only the
+    # softmax slot overridden by the width-masked variant.
+    branches = activation_branches()
+    branches[_SOFTMAX_ID] = _masked_softmax
+    y = lax.switch(act_id, branches, z)
+    return jnp.where(mask, y, jnp.zeros((), z.dtype))
+
+
+def _stage_apply(w, b, act, width, x):
+    """Run one stage's padded layer chain on a microbatch ``x: (mb, D)``.
+
+    The per-node compute of the reference (``grpc_node.py:75-97``) —
+    a chain of ``activation(x @ W + b)`` — unrolled over the padded
+    layer slots (L is small and static).
+    """
+    L = w.shape[0]
+    for li in range(L):
+        x = _masked_activation(x @ w[li] + b[li], act[li], width[li])
+    return x
+
+
+def _pipeline_device_fn(xs, w, b, act, width, *, num_stages, num_microbatches):
+    """Per-device body under shard_map: the GPipe schedule.
+
+    ``xs``: (M, mb, D) microbatches (replicated over the stage axis;
+    only stage 0 consumes them). ``w``/``b``/``act``/``width`` arrive
+    with a leading length-1 stage-shard axis.
+    """
+    w, b, act, width = w[0], b[0], act[0], width[0]
+    S, M = num_stages, num_microbatches
+    s_idx = lax.axis_index(AXIS_STAGE)
+    # The carry must be typed as varying over the mapped axes (its value
+    # genuinely differs per stage/data coordinate once the schedule runs).
+    state0 = lax.pcast(
+        jnp.zeros(xs.shape[1:], xs.dtype), (AXIS_STAGE, AXIS_DATA), to="varying"
+    )
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def step(state, t):
+        inp = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        x = jnp.where(s_idx == 0, inp, state)
+        y = _stage_apply(w, b, act, width, x)
+        nxt = lax.ppermute(y, AXIS_STAGE, fwd_perm) if fwd_perm else y
+        return nxt, y
+
+    _, ys = lax.scan(step, state0, jnp.arange(S + M - 1))
+    outs = ys[S - 1 :]  # (M, mb, D); microbatch m exits the tail at t = m+S-1
+    # Only the tail stage's emissions are the model output; psum
+    # replicates them to every stage coordinate.
+    outs = jnp.where(s_idx == S - 1, outs, jnp.zeros((), outs.dtype))
+    return lax.psum(outs, AXIS_STAGE)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: bool, dtype):
+    """Build + jit the shard_mapped pipeline executor for one config."""
+    act = jnp.asarray(meta.act_array(logits))
+    width = jnp.asarray(meta.width_array())
+
+    stage_spec = P(AXIS_STAGE)
+    xs_spec = P(None, AXIS_DATA, None)
+    device_fn = functools.partial(
+        _pipeline_device_fn,
+        num_stages=meta.num_stages,
+        num_microbatches=num_microbatches,
+    )
+    mapped = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(xs_spec, stage_spec, stage_spec, stage_spec, stage_spec),
+        out_specs=xs_spec,
+    )
+
+    @jax.jit
+    def run(weights: PipelineWeights, xs):
+        out = mapped(xs, weights.w, weights.b, act, width)
+        # (M, B, D) -> (M*B, final_dim): slice off feature padding and
+        # merge microbatches inside jit so XLA handles the reshard of the
+        # data-sharded batch axis.
+        m, bsz, _ = out.shape
+        return out[..., : meta.final_dim].reshape(m * bsz, meta.final_dim)
+
+    return run
+
+
+def pipeline_forward(
+    mesh,
+    params: PipelineParams,
+    x,
+    *,
+    num_microbatches: int = 1,
+    logits: bool = False,
+):
+    """Run the pipelined forward over a batch ``x: (N, in_dim)``.
+
+    Pads the batch up to ``num_microbatches * data_axis`` granularity and
+    features up to the uniform stage width, runs the schedule, and
+    returns ``(N, final_dim)``.
+    """
+    weights, meta = params
+    stage_size = mesh.shape[AXIS_STAGE]
+    if meta.num_stages != stage_size:
+        raise ValueError(
+            f"pipeline has {meta.num_stages} stages but the mesh '{AXIS_STAGE}' "
+            f"axis has size {stage_size}"
+        )
+    x = jnp.asarray(x, weights.w.dtype)
+    if x.ndim != 2 or x.shape[1] != meta.in_dim:
+        raise ValueError(
+            f"expected input of shape (N, {meta.in_dim}), got {tuple(x.shape)}"
+        )
+    n = x.shape[0]
+    data_size = mesh.shape[AXIS_DATA]
+    m = num_microbatches
+    chunk = m * data_size
+    n_pad = -n % chunk
+    x = jnp.pad(x, ((0, n_pad), (0, meta.max_dim - meta.in_dim)))
+    xs = x.reshape(m, (n + n_pad) // m, meta.max_dim)
+    run = _compiled_pipeline(mesh, meta, m, logits, weights.w.dtype)
+    out = run(weights, xs)
+    return out[:n]
+
+
+def pipeline_spec_summary(params: PipelineParams) -> dict:
+    """Human-readable placement summary (the analogue of the reference
+    orchestrator's spawn log, run_grpc_fcnn.py:133-143)."""
+    meta = params.meta
+    return {
+        "num_stages": meta.num_stages,
+        "layers_per_stage": meta.layers_per_stage,
+        "padded_width": meta.max_dim,
+        "input_dim": meta.in_dim,
+        "output_dim": meta.final_dim,
+    }
