@@ -1,0 +1,86 @@
+"""Data layer tests: synthetic dataset, IDX parsing, feeding."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.core.schema import load_examples
+from tpu_dist_nn.data import (
+    Dataset,
+    batch_iterator,
+    device_prefetch,
+    load_mnist_idx,
+    synthetic_mnist,
+)
+
+
+def test_synthetic_dataset_shapes_and_range():
+    ds = synthetic_mnist(200, num_classes=10, dim=784, seed=3)
+    assert ds.x.shape == (200, 784) and ds.y.shape == (200,)
+    assert ds.x.min() >= 0.0 and ds.x.max() <= 1.0
+    assert set(np.unique(ds.y)) <= set(range(10))
+    # Deterministic given the seed.
+    ds2 = synthetic_mnist(200, num_classes=10, dim=784, seed=3)
+    np.testing.assert_array_equal(ds.x, ds2.x)
+
+
+def test_split_and_examples_round_trip(tmp_path):
+    ds = synthetic_mnist(100, num_classes=4, dim=8, seed=1)
+    train, test = ds.split(0.8, seed=0)
+    assert len(train) == 80 and len(test) == 20
+    p = tmp_path / "examples.json"
+    test.to_examples_json(p)
+    x, y = load_examples(p)
+    np.testing.assert_allclose(x, test.x)
+    np.testing.assert_array_equal(y, test.y)
+
+
+def test_idx_round_trip(tmp_path):
+    # Write MNIST-format IDX files and parse them back.
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (5, 4, 4), dtype=np.uint8)
+    labels = rng.integers(0, 10, 5, dtype=np.uint8)
+    (tmp_path / "train-images-idx3-ubyte").write_bytes(
+        struct.pack(">IIII", 0x0803, 5, 4, 4) + images.tobytes()
+    )
+    (tmp_path / "train-labels-idx1-ubyte").write_bytes(
+        struct.pack(">II", 0x0801, 5) + labels.tobytes()
+    )
+    ds = load_mnist_idx(tmp_path, "train")
+    assert ds.x.shape == (5, 16)
+    np.testing.assert_allclose(ds.x, images.reshape(5, 16) / 255.0)
+    np.testing.assert_array_equal(ds.y, labels)
+
+
+def test_idx_bad_magic(tmp_path):
+    (tmp_path / "train-images-idx3-ubyte").write_bytes(
+        struct.pack(">IIII", 0x9999, 1, 2, 2) + b"\x00" * 4
+    )
+    with pytest.raises(ValueError, match="magic"):
+        load_mnist_idx(tmp_path, "train")
+
+
+def test_batch_iterator_drop_remainder():
+    x = np.arange(10)[:, None]
+    batches = list(batch_iterator(x, batch_size=4, drop_remainder=True))
+    assert [len(b) for b in batches] == [4, 4]
+    batches = list(batch_iterator(x, batch_size=4))
+    assert [len(b) for b in batches] == [4, 4, 2]
+
+
+def test_batch_iterator_shuffle_covers_all():
+    x = np.arange(20)
+    seen = np.concatenate(list(batch_iterator(x, batch_size=6, shuffle=True, seed=1)))
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_device_prefetch_order():
+    x = np.arange(12).reshape(6, 2)
+    out = list(device_prefetch(batch_iterator(x, batch_size=2), depth=3))
+    np.testing.assert_array_equal(np.concatenate([np.asarray(b) for b in out]), x)
+
+
+def test_dataset_length_mismatch():
+    with pytest.raises(ValueError):
+        Dataset(np.zeros((3, 2)), np.zeros(4, dtype=np.int32), 2)

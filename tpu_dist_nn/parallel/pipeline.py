@@ -34,14 +34,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tpu_dist_nn.core.activations import (
-    ACTIVATION_IDS,
+    SOFTMAX_ID,
     activation_branches,
     activation_id,
 )
 from tpu_dist_nn.core.schema import StageSpec
 from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
-
-_SOFTMAX_ID = ACTIVATION_IDS["softmax"]
 
 
 class PipelineWeights(NamedTuple):
@@ -69,6 +67,9 @@ class PipelineMeta:
     act: tuple[tuple[int, ...], ...]
     act_logits: tuple[tuple[int, ...], ...]
     width: tuple[tuple[int, ...], ...]
+    # Input width per layer slot (0 for identity filler): with `width`,
+    # defines each real layer's [in, out] block for gradient masking.
+    in_width: tuple[tuple[int, ...], ...]
     in_dim: int
     final_dim: int
     num_stages: int
@@ -80,6 +81,22 @@ class PipelineMeta:
 
     def width_array(self) -> np.ndarray:
         return np.asarray(self.width, dtype=np.int32)
+
+    def grad_masks(self) -> tuple[np.ndarray, np.ndarray]:
+        """0/1 masks over (S,L,D,D) weights and (S,L,D) biases selecting
+        real layer blocks — identity filler and padding regions must
+        receive exactly zero gradient or training would corrupt the
+        pass-through structure."""
+        S, L, D = self.num_stages, self.layers_per_stage, self.max_dim
+        w_mask = np.zeros((S, L, D, D), dtype=np.float32)
+        b_mask = np.zeros((S, L, D), dtype=np.float32)
+        for s in range(S):
+            for l in range(L):
+                fan_in, fan_out = self.in_width[s][l], self.width[s][l]
+                if fan_in > 0:
+                    w_mask[s, l, :fan_in, :fan_out] = 1.0
+                    b_mask[s, l, :fan_out] = 1.0
+        return w_mask, b_mask
 
 
 class PipelineParams(NamedTuple):
@@ -103,6 +120,7 @@ def build_pipeline_params(stages: Sequence[StageSpec], dtype=jnp.float32) -> Pip
     b = np.zeros((S, L, D), dtype=np.float64)
     act = np.zeros((S, L), dtype=np.int32)
     width = np.zeros((S, L), dtype=np.int32)
+    in_width = np.zeros((S, L), dtype=np.int32)
     eye = np.eye(D)
     for si, stage in enumerate(stages):
         for li in range(L):
@@ -112,6 +130,7 @@ def build_pipeline_params(stages: Sequence[StageSpec], dtype=jnp.float32) -> Pip
                 b[si, li, : layer.out_dim] = layer.biases
                 act[si, li] = activation_id(layer.activation)
                 width[si, li] = layer.out_dim
+                in_width[si, li] = layer.in_dim
             else:
                 # Identity filler: x @ I = x, full width so the mask is a
                 # no-op and already-zero padding columns pass through.
@@ -133,6 +152,7 @@ def build_pipeline_params(stages: Sequence[StageSpec], dtype=jnp.float32) -> Pip
         act=tuple(map(tuple, act.tolist())),
         act_logits=tuple(map(tuple, act_logits.tolist())),
         width=tuple(map(tuple, width.tolist())),
+        in_width=tuple(map(tuple, in_width.tolist())),
         in_dim=stages[0].expected_input_dim,
         final_dim=final_dim,
         num_stages=S,
@@ -158,7 +178,7 @@ def _masked_activation(z: jax.Array, act_id: jax.Array, width: jax.Array) -> jax
     # Same id-ordered table as the single-chip path, with only the
     # softmax slot overridden by the width-masked variant.
     branches = activation_branches()
-    branches[_SOFTMAX_ID] = _masked_softmax
+    branches[SOFTMAX_ID] = _masked_softmax
     y = lax.switch(act_id, branches, z)
     return jnp.where(mask, y, jnp.zeros((), z.dtype))
 
@@ -209,7 +229,7 @@ def _pipeline_device_fn(xs, w, b, act, width, *, num_stages, num_microbatches):
 
 
 @functools.lru_cache(maxsize=64)
-def _compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: bool, dtype):
+def compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: bool, dtype):
     """Build + jit the shard_mapped pipeline executor for one config."""
     act = jnp.asarray(meta.act_array(logits))
     width = jnp.asarray(meta.width_array())
@@ -240,6 +260,26 @@ def _compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: 
     return run
 
 
+def pad_batch(meta: PipelineMeta, x, num_microbatches: int, data_size: int, dtype):
+    """Pad a batch for the pipeline executor.
+
+    Features pad to the uniform stage width, rows to a multiple of
+    ``num_microbatches * data_size``; returns ``(xs, n)`` where ``xs`` is
+    ``(M, B, D)`` and ``n`` the original row count. Shared by inference
+    and training so the two paths cannot drift.
+    """
+    x = jnp.asarray(x, dtype)
+    if x.ndim != 2 or x.shape[1] != meta.in_dim:
+        raise ValueError(
+            f"expected input of shape (N, {meta.in_dim}), got {tuple(x.shape)}"
+        )
+    n = x.shape[0]
+    m = num_microbatches
+    n_pad = -n % (m * data_size)
+    x = jnp.pad(x, ((0, n_pad), (0, meta.max_dim - meta.in_dim)))
+    return x.reshape(m, (n + n_pad) // m, meta.max_dim), n
+
+
 def pipeline_forward(
     mesh,
     params: PipelineParams,
@@ -261,21 +301,62 @@ def pipeline_forward(
             f"pipeline has {meta.num_stages} stages but the mesh '{AXIS_STAGE}' "
             f"axis has size {stage_size}"
         )
-    x = jnp.asarray(x, weights.w.dtype)
-    if x.ndim != 2 or x.shape[1] != meta.in_dim:
-        raise ValueError(
-            f"expected input of shape (N, {meta.in_dim}), got {tuple(x.shape)}"
-        )
-    n = x.shape[0]
-    data_size = mesh.shape[AXIS_DATA]
-    m = num_microbatches
-    chunk = m * data_size
-    n_pad = -n % chunk
-    x = jnp.pad(x, ((0, n_pad), (0, meta.max_dim - meta.in_dim)))
-    xs = x.reshape(m, (n + n_pad) // m, meta.max_dim)
-    run = _compiled_pipeline(mesh, meta, m, logits, weights.w.dtype)
+    xs, n = pad_batch(
+        meta, x, num_microbatches, mesh.shape[AXIS_DATA], weights.w.dtype
+    )
+    run = compiled_pipeline(mesh, meta, num_microbatches, logits, weights.w.dtype)
     out = run(weights, xs)
     return out[:n]
+
+
+def extract_model(params: PipelineParams, template, distribution) -> "ModelSpec":
+    """Slice trained stage blocks back into a ModelSpec.
+
+    ``template`` supplies structure (activations, type tags); weights and
+    biases are replaced by the trained values. Inverse of
+    ``partition_model`` + ``build_pipeline_params`` — the export leg of
+    the training path (the reference's notebook cell 10 equivalent).
+    """
+    import dataclasses as _dc
+
+    from tpu_dist_nn.core.schema import ModelSpec, validate_distribution
+
+    weights, meta = params
+    validate_distribution(distribution, len(template.layers))
+    if len(distribution) != meta.num_stages:
+        raise ValueError(
+            f"distribution has {len(distribution)} stages but params were "
+            f"built with {meta.num_stages}"
+        )
+    # The template must describe the same stage/layer geometry the params
+    # were built with, or the slices below would silently read padding.
+    layer_idx0 = 0
+    for si, count in enumerate(int(d) for d in distribution):
+        for li in range(count):
+            tl = template.layers[layer_idx0]
+            if (tl.in_dim, tl.out_dim) != (meta.in_width[si][li], meta.width[si][li]):
+                raise ValueError(
+                    f"template layer {layer_idx0} has dims "
+                    f"({tl.in_dim}, {tl.out_dim}) but stage {si} slot {li} was "
+                    f"built as ({meta.in_width[si][li]}, {meta.width[si][li]})"
+                )
+            layer_idx0 += 1
+    w = np.asarray(weights.w, np.float64)
+    b = np.asarray(weights.b, np.float64)
+    new_layers = []
+    layer_idx = 0
+    for si, count in enumerate(int(d) for d in distribution):
+        for li in range(count):
+            old = template.layers[layer_idx]
+            new_layers.append(
+                _dc.replace(
+                    old,
+                    weights=w[si, li, : old.in_dim, : old.out_dim].copy(),
+                    biases=b[si, li, : old.out_dim].copy(),
+                )
+            )
+            layer_idx += 1
+    return ModelSpec(layers=new_layers, metadata=dict(template.metadata))
 
 
 def pipeline_spec_summary(params: PipelineParams) -> dict:

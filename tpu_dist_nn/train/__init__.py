@@ -1,0 +1,16 @@
+from tpu_dist_nn.train.metrics import (  # noqa: F401
+    accuracy,
+    classification_metrics,
+)
+from tpu_dist_nn.train.trainer import (  # noqa: F401
+    TrainConfig,
+    cross_entropy,
+    evaluate_fcnn,
+    export_model,
+    train_fcnn,
+)
+from tpu_dist_nn.train.pipeline_trainer import (  # noqa: F401
+    make_pipeline_train_step,
+    prepare_pipeline_batch,
+    train_pipelined,
+)

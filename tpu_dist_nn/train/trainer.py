@@ -1,0 +1,143 @@
+"""Native on-TPU training for FCNN models — the capability the reference
+only has centrally.
+
+The reference trains in Keras/torch on the host and exports weights
+(SURVEY.md §3.5); its recipes are Adam lr=1e-3 + cross-entropy, batch 64
+(``generate_mnist_pytorch.py:37-52``), 5-30 epochs (notebook cell 8).
+This module reproduces that recipe as a jit-compiled optax loop on the
+single-chip params layout; :mod:`tpu_dist_nn.train.pipeline_trainer`
+trains the pipelined layout across a mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpu_dist_nn.core.schema import ModelSpec, save_model
+from tpu_dist_nn.data.datasets import Dataset
+from tpu_dist_nn.data.feed import batch_iterator
+from tpu_dist_nn.models.fcnn import forward, forward_logits, spec_from_params
+from tpu_dist_nn.train.metrics import classification_metrics
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Reference training recipe defaults (generate_mnist_pytorch.py:12,37-38)."""
+
+    learning_rate: float = 1e-3
+    epochs: int = 5
+    batch_size: int = 64
+    seed: int = 0
+    log_every: int = 0  # batches; 0 = epoch-level only
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy from raw logits (sparse labels)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return -ll.mean()
+
+
+def _split_params(params):
+    """Split the params pytree into trainable {w,b} and static act ids —
+    optax must never touch the int32 activation leaves."""
+    wb = [{"w": p["w"], "b": p["b"]} for p in params]
+    acts = [p["act"] for p in params]
+    return wb, acts
+
+
+def _join_params(wb, acts):
+    return [{"w": p["w"], "b": p["b"], "act": a} for p, a in zip(wb, acts)]
+
+
+def make_train_step(acts, optimizer):
+    """Build the jitted SGD step for the single-chip layout."""
+
+    def loss_fn(wb, x, y):
+        return cross_entropy(forward_logits(_join_params(wb, acts), x), y)
+
+    @jax.jit
+    def step(wb, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(wb, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, wb)
+        wb = optax.apply_updates(wb, updates)
+        return wb, opt_state, loss
+
+    return step
+
+
+def train_fcnn(
+    params,
+    train_data: Dataset,
+    config: TrainConfig = TrainConfig(),
+    eval_data: Dataset | None = None,
+):
+    """Train a params pytree; returns (params, history).
+
+    History records per-epoch mean loss, wall time, and (if eval data is
+    given) eval accuracy — the counters the reference printed per run
+    (run_grpc_inference.py:213-216, generate_mnist_pytorch.py:50-52).
+    """
+    wb, acts = _split_params(params)
+    optimizer = optax.adam(config.learning_rate)
+    opt_state = optimizer.init(wb)
+    step = make_train_step(acts, optimizer)
+
+    history = []
+    for epoch in range(config.epochs):
+        t0 = time.monotonic()
+        losses = []
+        batches = batch_iterator(
+            train_data.x,
+            train_data.y,
+            config.batch_size,
+            shuffle=True,
+            seed=config.seed + epoch,
+            drop_remainder=True,  # stable shapes: one compiled step
+        )
+        for bx, by in batches:
+            wb, opt_state, loss = step(
+                wb, opt_state, jnp.asarray(bx, jnp.float32), jnp.asarray(by)
+            )
+            losses.append(loss)
+        record = {
+            "epoch": epoch,
+            "loss": float(jnp.stack(losses).mean()),
+            "seconds": time.monotonic() - t0,
+        }
+        if eval_data is not None:
+            record["eval"] = evaluate_fcnn(_join_params(wb, acts), eval_data)
+        history.append(record)
+    return _join_params(wb, acts), history
+
+
+def evaluate_fcnn(params, data: Dataset, batch_size: int = 1024) -> dict:
+    """Full classification metrics over a dataset."""
+    preds = []
+    apply = jax.jit(forward)
+    for bx in batch_iterator(data.x, batch_size=batch_size):
+        preds.append(np.asarray(apply(params, jnp.asarray(bx, jnp.float32))).argmax(-1))
+    return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
+
+
+def export_model(
+    params,
+    activations,
+    path,
+    metrics: dict | None = None,
+    extra_metadata: dict | None = None,
+) -> ModelSpec:
+    """Export trained params to the public JSON schema, embedding eval
+    metrics under ``inference_metrics`` (notebook cell 10 parity)."""
+    metadata = dict(extra_metadata or {})
+    if metrics is not None:
+        metadata["inference_metrics"] = metrics
+    spec = spec_from_params(params, activations, metadata)
+    save_model(spec, path)
+    return spec
