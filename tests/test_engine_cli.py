@@ -1,0 +1,157 @@
+"""Engine + CLI surface tests (the reference's L3/L4 behaviors)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.api.engine import Engine
+from tpu_dist_nn.cli import main as cli_main
+from tpu_dist_nn.core.schema import load_model, save_examples, save_model
+from tpu_dist_nn.data.datasets import synthetic_mnist
+from tpu_dist_nn.testing.factories import random_inputs, random_model
+from tpu_dist_nn.testing.oracle import oracle_forward_batch
+from tpu_dist_nn.train.trainer import TrainConfig
+
+
+@pytest.fixture
+def model_file(tmp_path):
+    model = random_model([12, 10, 8, 4], seed=0)
+    p = tmp_path / "model.json"
+    save_model(model, p)
+    return p
+
+
+@pytest.fixture
+def inputs_file(tmp_path):
+    x = random_inputs(20, 12, seed=1)
+    y = np.random.default_rng(2).integers(0, 4, 20)
+    p = tmp_path / "inputs.json"
+    save_examples(x, y, p)
+    return p
+
+
+def test_engine_up_single_chip(model_file):
+    engine = Engine.up(model_file)
+    assert engine.setup_seconds is not None
+    place = engine.placement()
+    assert place["num_stages"] == 1 and not place["pipelined"]
+    out = engine.infer(random_inputs(5, 12))
+    assert out.shape == (5, 4)
+    np.testing.assert_allclose(out.sum(-1), np.ones(5), rtol=1e-5)
+
+
+def test_engine_up_pipelined_matches_oracle(model_file):
+    engine = Engine.up(model_file, [1, 1, 1], num_microbatches=2)
+    assert engine.placement()["pipelined"]
+    x = random_inputs(9, 12, seed=3)
+    got = engine.infer(x)
+    want = oracle_forward_batch(load_model(model_file), x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_engine_data_parallel_single_stage(model_file):
+    # Pure DP: batch sharded over 4 devices, params replicated.
+    engine = Engine.up(model_file, [3], data_parallel=4)
+    assert engine.data_sharded and not engine.pipelined
+    x = random_inputs(10, 12, seed=7)  # not divisible by 4 -> padded
+    got = engine.infer(x)
+    want = oracle_forward_batch(load_model(model_file), x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_engine_collapses_when_too_many_stages(model_file):
+    # 99 stages can't fit 8 devices -> single-chip executor.
+    model = load_model(model_file)
+    engine = Engine.up(model, [1, 1, 1], data_parallel=99)
+    assert not engine.pipelined
+    assert engine.placement()["num_stages"] == 1
+
+
+def test_engine_distribution_from_metadata(model_file, tmp_path):
+    model = load_model(model_file)
+    model.metadata["layer_distribution"] = [1, 2]
+    p = tmp_path / "with_dist.json"
+    save_model(model, p)
+    engine = Engine.up(p)
+    assert engine.distribution == [1, 2]
+    assert engine.placement()["num_stages"] == 2
+
+
+def test_engine_invalid_distribution(model_file):
+    with pytest.raises(ValueError):
+        Engine.up(model_file, [1, 1])
+
+
+def test_engine_run_inference_chunked(model_file, inputs_file):
+    from tpu_dist_nn.core.schema import load_examples
+
+    engine = Engine.up(model_file)
+    x, y = load_examples(inputs_file)
+    result = engine.run_inference(x, labels=y, batch_size=8)
+    assert result.outputs.shape == (20, 4)
+    assert len(result.batch_seconds) == 3  # ceil(20/8)
+    assert result.metrics is not None and 0 <= result.metrics["accuracy"] <= 1
+
+
+def test_engine_train_and_export_round_trip(tmp_path):
+    data = synthetic_mnist(400, num_classes=4, dim=16, noise=0.25, seed=0)
+    train, test = data.split(0.8, seed=1)
+    model = random_model([16, 12, 4], seed=4, scale=1.0)
+    engine = Engine.up(model, [1, 1], num_microbatches=2)
+    history = engine.train(train, TrainConfig(epochs=30, batch_size=32), eval_data=test)
+    assert history[-1]["loss"] < history[0]["loss"]
+    out_path = tmp_path / "trained.json"
+    engine.export(out_path, metrics=history[-1]["eval"])
+    reloaded = load_model(out_path)
+    assert reloaded.metadata["layer_distribution"] == [1, 1]
+    assert "inference_metrics" in reloaded.metadata
+    # Reloaded weights reproduce the engine's own outputs.
+    x = test.x[:6]
+    np.testing.assert_allclose(
+        engine.infer(x), oracle_forward_batch(reloaded, x), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_cli_infer_single_and_batch(model_file, inputs_file, capsys):
+    rc = cli_main(["infer", "2", "--config", str(model_file), "--inputs", str(inputs_file)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Inference time" in out and "Output:" in out
+
+    rc = cli_main([
+        "infer", "--config", str(model_file), "--inputs", str(inputs_file),
+        "--batch-size", "8", "--port", "5101", "--timeout", "10",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Total inference time" in out and "Correct predictions" in out
+
+
+def test_cli_up_smoke(model_file, inputs_file, capsys):
+    rc = cli_main(["up", "--config", str(model_file), "--inputs", str(inputs_file),
+                   "--distribution", "1,1,1"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["ready"] is True and lines[0]["placement"]["num_stages"] == 3
+    assert len(lines[1]["smoke_inference"]) == 4
+
+
+def test_cli_train_synthetic(tmp_path, capsys):
+    out_file = tmp_path / "m.json"
+    rc = cli_main([
+        "train", "--layers", "16,8,4", "--data", "synthetic",
+        "--num-examples", "300", "--epochs", "2", "--batch-size", "32",
+        "--out", str(out_file),
+    ])
+    assert rc == 0
+    trained = load_model(out_file)
+    assert trained.layer_sizes == [16, 8, 4]
+    assert "inference_metrics" in trained.metadata
+
+
+def test_cli_oracle(model_file, inputs_file, capsys):
+    rc = cli_main(["oracle", "--config", str(model_file), "--inputs", str(inputs_file)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Average inference time" in out

@@ -1,0 +1,24 @@
+"""The driver hooks must stay importable and runnable on a CPU mesh."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_is_jittable():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (64, 10)
+
+
+def test_dryrun_multichip_eight_devices():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_odd_device_count():
+    graft.dryrun_multichip(1)

@@ -134,15 +134,10 @@ def test_pipelined_training_learns_and_exports(tmp_path):
 
 
 def test_metrics_match_sklearn():
-    pytest_skip = False
-    try:
-        from sklearn.metrics import f1_score, precision_score, recall_score
-    except ImportError:  # pragma: no cover
-        pytest_skip = True
-    if pytest_skip:
-        import pytest
+    import pytest
 
-        pytest.skip("sklearn unavailable")
+    sk = pytest.importorskip("sklearn.metrics")
+    f1_score, precision_score, recall_score = sk.f1_score, sk.precision_score, sk.recall_score
     from tpu_dist_nn.train.metrics import classification_metrics
 
     rng = np.random.default_rng(0)

@@ -14,6 +14,14 @@ Public surface:
     the public contract shared with the reference
     (``config/config_sample.json``).
   - :mod:`tpu_dist_nn.models.fcnn` — pure-functional forward pass.
+  - :mod:`tpu_dist_nn.parallel` — mesh construction and the pipelined
+    (shard_map + ppermute) stage executor.
+  - :mod:`tpu_dist_nn.train` — native training (Adam + cross-entropy),
+    single-chip and pipelined, metrics, and export.
+  - :mod:`tpu_dist_nn.data` — synthetic/IDX datasets and device feeding.
+  - :mod:`tpu_dist_nn.api.engine` — the orchestrator/client surface
+    (``up`` / ``infer`` / ``train`` / ``export`` / ``down``).
+  - :mod:`tpu_dist_nn.cli` — the ``tdn`` command-line drivers.
   - :mod:`tpu_dist_nn.testing` — the float64 numpy oracle and fixtures.
 """
 

@@ -1,0 +1,1 @@
+from tpu_dist_nn.api.engine import Engine, InferenceResult  # noqa: F401

@@ -1,0 +1,289 @@
+"""The Engine: orchestrator + client surface in one object.
+
+Replaces both reference drivers with a single-controller JAX program:
+
+* ``run_grpc_fcnn.py`` (orchestrator): validate the distribution, infer
+  the input dim, place stages, readiness-check, teardown — here
+  ``Engine.up()`` validates, builds the mesh, compiles the executor
+  (compilation *is* the readiness gate; there is no daemon to babysit,
+  so the reference's supervisor sleep loop and container sweeps
+  disappear), and ``setup_seconds`` mirrors its bring-up timing
+  (run_grpc_fcnn.py:321-322).
+* ``run_grpc_inference.py`` (client): single / whole-set / chunked-batch
+  inference with accuracy + latency reporting
+  (run_grpc_inference.py:162-216).
+
+Placement semantics: ``layer_distribution`` comes from the model file's
+metadata (the reference reads it from the same config JSON,
+run_grpc_fcnn.py:266) or the caller. When the distribution names more
+stages than there are devices, the engine collapses to the single-chip
+executor — the TPU analogue of the reference running N containers on
+one box — and notes it in the placement summary. A single-stage plan
+always uses the unpadded single-chip path (no reason to pay padded
+uniform-width matmuls on one device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.core.schema import (
+    ModelSpec,
+    load_examples,
+    load_model,
+    partition_model,
+)
+from tpu_dist_nn.data.datasets import Dataset
+from tpu_dist_nn.data.feed import batch_iterator
+from tpu_dist_nn.models.fcnn import params_from_spec
+from tpu_dist_nn.train.trainer import jitted_forward
+from tpu_dist_nn.parallel.mesh import MeshSpec, batch_sharding, build_mesh, replicated
+from tpu_dist_nn.parallel.pipeline import (
+    build_pipeline_params,
+    extract_model,
+    pipeline_forward,
+    pipeline_spec_summary,
+)
+from tpu_dist_nn.train.metrics import classification_metrics
+from tpu_dist_nn.train.trainer import TrainConfig, train_fcnn
+from tpu_dist_nn.train.pipeline_trainer import train_pipelined
+
+log = logging.getLogger("tpu_dist_nn.engine")
+
+
+@dataclasses.dataclass
+class InferenceResult:
+    """Client-side report (run_grpc_inference.py:185-216)."""
+
+    outputs: np.ndarray
+    seconds: float
+    batch_seconds: list[float]
+    metrics: dict | None = None
+
+    @property
+    def predictions(self) -> np.ndarray:
+        return self.outputs.argmax(-1)
+
+
+class Engine:
+    """A brought-up model: placed, compiled, ready to serve or train."""
+
+    def __init__(self, model: ModelSpec, distribution, mesh_spec: MeshSpec,
+                 num_microbatches: int, dtype):
+        self.model = model
+        self.distribution = list(distribution)
+        self.mesh_spec = mesh_spec
+        self.num_microbatches = num_microbatches
+        self.dtype = dtype
+        self.pipelined = mesh_spec.stage > 1
+        self.mesh = build_mesh(mesh_spec)
+        # Pure data parallelism on a single-stage plan: batch sharded
+        # over the data axis, params replicated.
+        self.data_sharded = not self.pipelined and mesh_spec.data > 1
+        if self.pipelined:
+            stages = partition_model(model, self.distribution)
+            self._pp = build_pipeline_params(stages, dtype)
+            self._params = None
+        else:
+            self._pp = None
+            self._params = params_from_spec(model, dtype)
+            if self.data_sharded:
+                self._params = jax.device_put(self._params, replicated(self.mesh))
+        self.setup_seconds: float | None = None
+
+    # ---------------------------------------------------------------- up
+
+    @classmethod
+    def up(
+        cls,
+        model,
+        distribution=None,
+        *,
+        data_parallel: int = 1,
+        num_microbatches: int = 4,
+        dtype=jnp.float32,
+        devices=None,
+        warmup: bool = True,
+    ) -> "Engine":
+        """Validate, place, compile; returns a ready engine.
+
+        ``model`` is a path or a ModelSpec. Bring-up wall time lands in
+        ``engine.setup_seconds`` (run_grpc_fcnn.py:321-322 parity).
+        """
+        t0 = time.monotonic()
+        if not isinstance(model, ModelSpec):
+            model = load_model(model)
+        if distribution is None:
+            distribution = model.metadata.get("layer_distribution")
+        if distribution is None:
+            distribution = [len(model.layers)]
+        # Fail fast on an invalid plan (run_grpc_fcnn.py:182-183).
+        partition_model(model, distribution)
+
+        n_devices = len(devices or jax.devices())
+        stages = len(distribution)
+        if stages * data_parallel > n_devices:
+            log.info(
+                "placement: %d stages x %d data shards exceed %d device(s); "
+                "collapsing to the single-chip executor",
+                stages, data_parallel, n_devices,
+            )
+            mesh_spec = MeshSpec(stage=1, data=1)
+            distribution = [len(model.layers)]
+        else:
+            mesh_spec = MeshSpec(stage=stages, data=data_parallel)
+        if mesh_spec.stage == 1:
+            distribution = [len(model.layers)]
+
+        engine = cls(model, distribution, mesh_spec, num_microbatches, dtype)
+        if warmup:
+            # Compilation is the readiness check (the analogue of the
+            # orchestrator's TCP poll, run_grpc_fcnn.py:157-172).
+            engine.infer(np.zeros((1, model.input_dim)))
+        engine.setup_seconds = time.monotonic() - t0
+        log.info("engine up in %.2fs: %s", engine.setup_seconds, engine.placement())
+        return engine
+
+    def placement(self) -> dict:
+        """Placement summary — the spawn-log analogue (run_grpc_fcnn.py:133-143)."""
+        base = {
+            "devices": self.mesh_spec.num_devices,
+            "distribution": self.distribution,
+            "data_parallel": self.mesh_spec.data,
+            "pipelined": self.pipelined,
+        }
+        if self.pipelined:
+            base.update(pipeline_spec_summary(self._pp))
+        else:
+            base.update(
+                {
+                    "num_stages": 1,
+                    "input_dim": self.model.input_dim,
+                    "output_dim": self.model.output_dim,
+                }
+            )
+        return base
+
+    # ------------------------------------------------------------- infer
+
+    def infer(self, x) -> np.ndarray:
+        """Forward a batch → (N, out_dim) probabilities."""
+        x = np.asarray(x, dtype=np.float64).reshape(-1, self.model.input_dim)
+        if self.pipelined:
+            out = pipeline_forward(
+                self.mesh, self._pp, x, num_microbatches=self.num_microbatches
+            )
+        elif self.data_sharded:
+            n = len(x)
+            shards = self.mesh_spec.data
+            xb = np.pad(x, ((0, -n % shards), (0, 0))).astype(self.dtype)
+            xb = jax.device_put(xb, batch_sharding(self.mesh))
+            out = jitted_forward(self._params, xb)[:n]
+        else:
+            out = jitted_forward(self._params, jnp.asarray(x, self.dtype))
+        return np.asarray(out)
+
+    def infer_single(self, x) -> tuple[np.ndarray, float]:
+        """One example, with its wall time (run_grpc_inference.py:54-99)."""
+        t0 = time.monotonic()
+        out = self.infer(np.asarray(x).reshape(1, -1))[0]
+        return out, time.monotonic() - t0
+
+    def run_inference(
+        self,
+        inputs,
+        labels=None,
+        *,
+        batch_size: int | None = None,
+        num_classes: int | None = None,
+    ) -> InferenceResult:
+        """Whole-set or chunked-batch inference with accuracy + latency —
+        the reference client's main loop (run_grpc_inference.py:185-216)."""
+        inputs = np.asarray(inputs)
+        t0 = time.monotonic()
+        outputs = []
+        batch_seconds = []
+        if batch_size is None:
+            bt0 = time.monotonic()
+            outputs.append(self.infer(inputs))
+            batch_seconds.append(time.monotonic() - bt0)
+        else:
+            for bx in batch_iterator(inputs, batch_size=batch_size):
+                bt0 = time.monotonic()
+                outputs.append(self.infer(bx))
+                batch_seconds.append(time.monotonic() - bt0)
+        outputs = np.concatenate(outputs)
+        seconds = time.monotonic() - t0
+        metrics = None
+        if labels is not None:
+            metrics = classification_metrics(outputs, labels, num_classes)
+        return InferenceResult(outputs, seconds, batch_seconds, metrics)
+
+    # ------------------------------------------------------------- train
+
+    def train(
+        self,
+        train_data: Dataset,
+        config: TrainConfig = TrainConfig(),
+        eval_data: Dataset | None = None,
+    ) -> list[dict]:
+        """Train in place (pipelined if placed that way); returns history."""
+        if self.pipelined:
+            self._pp, history = train_pipelined(
+                self._pp,
+                self.mesh,
+                train_data,
+                config,
+                num_microbatches=self.num_microbatches,
+                eval_data=eval_data,
+            )
+            self.model = extract_model(self._pp, self.model, self.distribution)
+        else:
+            self._params, history = train_fcnn(
+                self._params, train_data, config, eval_data=eval_data
+            )
+            trained = [
+                {"weights": np.asarray(p["w"], np.float64),
+                 "biases": np.asarray(p["b"], np.float64)}
+                for p in self._params
+            ]
+            new_layers = [
+                dataclasses.replace(l, weights=t["weights"], biases=t["biases"])
+                for l, t in zip(self.model.layers, trained)
+            ]
+            self.model = ModelSpec(new_layers, dict(self.model.metadata))
+        return history
+
+    # ------------------------------------------------------------ export
+
+    def export(self, path, metrics: dict | None = None) -> ModelSpec:
+        """Write the current weights to the public JSON schema, embedding
+        metrics under inference_metrics (notebook cell 10 parity)."""
+        from tpu_dist_nn.core.schema import save_model
+
+        if metrics is not None:
+            self.model.metadata["inference_metrics"] = metrics
+        if "layer_distribution" not in self.model.metadata and self.pipelined:
+            self.model.metadata["layer_distribution"] = self.distribution
+        save_model(self.model, path)
+        return self.model
+
+    # -------------------------------------------------------------- down
+
+    def down(self) -> None:
+        """Release references. Idempotent; relaunch = ``Engine.up`` again
+        from the JSON model (the reference's clean-teardown/stateless-
+        relaunch contract, run_grpc_fcnn.py:329-344)."""
+        self._pp = None
+        self._params = None
+
+
+def load_inputs(path) -> tuple[np.ndarray, np.ndarray]:
+    """Examples-file loader re-export for driver code."""
+    return load_examples(path)

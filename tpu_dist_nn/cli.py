@@ -1,0 +1,237 @@
+"""Command-line drivers, mirroring the reference's CLI surface.
+
+``tdn up``     — orchestrator (run_grpc_fcnn.py:347-363: ``--config --inputs``)
+``tdn infer``  — client (run_grpc_inference.py:218-252:
+                 ``[input_index] --inputs --port --timeout --batch-size``;
+                 ``--port``/``--timeout`` are accepted for drop-in
+                 compatibility but are no-ops — there are no sockets in
+                 the data path)
+``tdn train``  — the native training path (subsumes the reference's
+                 offline scripts/generate_mnist_*.py + notebook recipes)
+``tdn oracle`` — scripts/manual_nn.py analogue: single-process float64
+                 forward with per-example latency printout
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+import numpy as np
+
+logging.basicConfig(
+    level=logging.INFO, format="%(asctime)s - %(levelname)s - %(message)s"
+)
+log = logging.getLogger("tpu_dist_nn.cli")
+
+
+def _parse_distribution(text):
+    if text is None:
+        return None
+    return [int(t) for t in text.replace(",", " ").split()]
+
+
+def _add_up_args(p):
+    p.add_argument("--config", required=True, help="model JSON file")
+    p.add_argument("--inputs", help="example inputs JSON file")
+    p.add_argument("--distribution", help="layer distribution, e.g. 1,1,1")
+    p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--microbatches", type=int, default=4)
+
+
+def _engine_from_args(args, warmup=True):
+    from tpu_dist_nn.api.engine import Engine
+
+    return Engine.up(
+        args.config,
+        _parse_distribution(getattr(args, "distribution", None)),
+        data_parallel=getattr(args, "data_parallel", 1),
+        num_microbatches=getattr(args, "microbatches", 4),
+        warmup=warmup,
+    )
+
+
+def cmd_up(args) -> int:
+    engine = _engine_from_args(args)
+    print(json.dumps({"ready": True, "setup_seconds": engine.setup_seconds,
+                      "placement": engine.placement()}))
+    if args.inputs:
+        from tpu_dist_nn.core.schema import load_examples
+
+        x, y = load_examples(args.inputs)
+        result = engine.run_inference(x[:1])
+        print(json.dumps({"smoke_inference": result.outputs[0].tolist()}))
+    return 0
+
+
+def cmd_infer(args) -> int:
+    from tpu_dist_nn.core.schema import load_examples
+
+    engine = _engine_from_args(args)
+    x, y = load_examples(args.inputs)
+    if args.input_index is not None:
+        # Single-example path (run_grpc_inference.py:174-178).
+        out, seconds = engine.infer_single(x[args.input_index])
+        print(f"Output: {out.tolist()}")
+        print(f"Inference time: {seconds:.4f} seconds")
+        if y[args.input_index] >= 0:
+            print(f"Label: {y[args.input_index]}  predicted: {int(out.argmax())}")
+        return 0
+    result = engine.run_inference(
+        x, labels=y if (y >= 0).all() else None, batch_size=args.batch_size
+    )
+    for i, bs in enumerate(result.batch_seconds):
+        log.info("batch %d took %.4f seconds", i, bs)
+    n = len(x)
+    if result.metrics:
+        correct = int(round(result.metrics["accuracy"] * n))
+        # The client's closing report (run_grpc_inference.py:206-216).
+        print(f"Correct predictions: {correct}/{n} "
+              f"(accuracy {result.metrics['accuracy']:.4f})")
+        print(f"Metrics: {json.dumps(result.metrics)}")
+    print(f"Total inference time: {result.seconds:.4f} seconds "
+          f"({n / result.seconds:.1f} samples/sec)")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from tpu_dist_nn.core.schema import load_model
+    from tpu_dist_nn.data.datasets import load_mnist_idx, synthetic_mnist
+    from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
+    from tpu_dist_nn.train.trainer import TrainConfig
+    import jax
+
+    if args.config:
+        model = load_model(args.config)
+    else:
+        sizes = _parse_distribution(args.layers)
+        acts = ["relu"] * (len(sizes) - 2) + ["softmax"]
+        params = init_fcnn(jax.random.key(args.seed), sizes, acts)
+        model = spec_from_params(params, acts)
+
+    if args.data.startswith("idx:"):
+        data = load_mnist_idx(args.data[4:], "train")
+        eval_data = load_mnist_idx(args.data[4:], "test")
+    elif args.data.startswith("json:"):
+        from tpu_dist_nn.core.schema import load_examples
+        from tpu_dist_nn.data.datasets import Dataset
+
+        x, y = load_examples(args.data[5:])
+        if (y < 0).any():
+            # load_examples marks missing labels with -1 (fine for pure
+            # inference, cmd_infer guards on it) — training on the
+            # sentinel would silently push everything to the last class.
+            raise ValueError(
+                f"{args.data[5:]}: examples without labels cannot be trained on"
+            )
+        full = Dataset(x, y, int(y.max()) + 1)
+        data, eval_data = full.split(0.9, seed=args.seed)
+    else:  # synthetic
+        full = synthetic_mnist(
+            args.num_examples, dim=model.input_dim,
+            num_classes=model.output_dim, seed=args.seed,
+        )
+        data, eval_data = full.split(0.9, seed=args.seed)
+
+    from tpu_dist_nn.api.engine import Engine
+
+    engine = Engine.up(
+        model,
+        _parse_distribution(args.distribution),
+        data_parallel=args.data_parallel,
+        num_microbatches=args.microbatches,
+    )
+    cfg = TrainConfig(
+        learning_rate=args.lr, epochs=args.epochs,
+        batch_size=args.batch_size, seed=args.seed,
+    )
+    history = engine.train(data, cfg, eval_data=eval_data)
+    for h in history:
+        msg = f"epoch {h['epoch']}: loss {h['loss']:.4f} ({h['seconds']:.2f}s)"
+        if "eval" in h:
+            msg += f" eval_acc {h['eval']['accuracy']:.4f}"
+        log.info(msg)
+    metrics = history[-1].get("eval")
+    if args.out:
+        engine.export(args.out, metrics=metrics)
+        log.info("exported trained model to %s", args.out)
+    return 0
+
+
+def cmd_oracle(args) -> int:
+    """Single-process float64 baseline (scripts/manual_nn.py:88-99)."""
+    from tpu_dist_nn.core.schema import load_examples, load_model
+    from tpu_dist_nn.testing.oracle import oracle_forward
+
+    model = load_model(args.config)
+    x, _ = load_examples(args.inputs)
+    total = 0.0
+    for example in x:
+        t0 = time.monotonic()
+        oracle_forward(model, example)
+        dt = time.monotonic() - t0
+        total += dt
+        print(f"Inference time: {dt:.4f} seconds")
+    print(f"Total inference time: {total:.4f} seconds")
+    print(f"Average inference time: {total / len(x):.4f} seconds")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="tdn", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("up", help="validate, place, compile (orchestrator)")
+    _add_up_args(p)
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("infer", help="run inference (client)")
+    p.add_argument("input_index", nargs="?", type=int, default=None)
+    _add_up_args(p)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--port", type=int, default=None,
+                   help="compat no-op (no sockets in the data path)")
+    p.add_argument("--timeout", type=float, default=None, help="compat no-op")
+    p.set_defaults(fn=cmd_infer)
+
+    p = sub.add_parser("train", help="native on-TPU training")
+    p.add_argument("--config", help="start from an existing model JSON")
+    p.add_argument("--layers", default="784,128,64,10",
+                   help="fresh model sizes (generate_mnist_pytorch.py:25-27)")
+    p.add_argument("--data", default="synthetic",
+                   help="synthetic | idx:DIR | json:FILE")
+    p.add_argument("--num-examples", type=int, default=12000)
+    p.add_argument("--distribution")
+    p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--microbatches", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", help="export trained model JSON here")
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")
+    p.add_argument("--config", required=True)
+    p.add_argument("--inputs", required=True)
+    p.set_defaults(fn=cmd_oracle)
+
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (ValueError, FileNotFoundError) as e:
+        # Config/placement errors are user errors, not crashes — the
+        # analogue of the reference's fail-fast validation messages.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

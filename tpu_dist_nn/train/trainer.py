@@ -117,12 +117,18 @@ def train_fcnn(
     return _join_params(wb, acts), history
 
 
+# One process-wide jitted forward: a fresh jax.jit(...) per call would
+# carry a fresh trace cache and recompile on every use.
+jitted_forward = jax.jit(forward)
+
+
 def evaluate_fcnn(params, data: Dataset, batch_size: int = 1024) -> dict:
     """Full classification metrics over a dataset."""
     preds = []
-    apply = jax.jit(forward)
     for bx in batch_iterator(data.x, batch_size=batch_size):
-        preds.append(np.asarray(apply(params, jnp.asarray(bx, jnp.float32))).argmax(-1))
+        preds.append(
+            np.asarray(jitted_forward(params, jnp.asarray(bx, jnp.float32))).argmax(-1)
+        )
     return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
 
 
