@@ -1,0 +1,149 @@
+"""Conv/pool layer family: parity, round-trip, training, engine routing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist_nn.api.engine import Engine
+from tpu_dist_nn.core.schema import Conv2DSpec, MaxPool2DSpec, load_model, save_model
+from tpu_dist_nn.data.datasets import synthetic_mnist
+from tpu_dist_nn.models.network import (
+    build_network,
+    init_conv_mlp,
+    network_forward,
+    network_logits,
+    network_model_from_params,
+)
+from tpu_dist_nn.testing.oracle import oracle_forward_batch
+from tpu_dist_nn.train import TrainConfig
+from tpu_dist_nn.train.trainer import train_network
+
+
+@pytest.fixture
+def conv_model():
+    # Tiny CIFAR-style hybrid: conv-pool-conv-pool-dense-dense.
+    return init_conv_mlp(
+        jax.random.key(0),
+        in_shape=(8, 8, 3),
+        conv_filters=(4, 8),
+        hidden=(16,),
+        num_classes=4,
+    )
+
+
+def test_conv_model_structure(conv_model):
+    kinds = [l.kind for l in conv_model.layers]
+    assert kinds == ["conv2d", "maxpool2d", "conv2d", "maxpool2d", "dense", "dense"]
+    conv_model.validate_chain()
+    assert not conv_model.is_dense
+    assert conv_model.input_dim == 8 * 8 * 3
+    assert conv_model.output_dim == 4
+
+
+def test_conv_forward_matches_oracle(conv_model):
+    plan, params = build_network(conv_model)
+    x = np.random.default_rng(0).uniform(size=(5, conv_model.input_dim))
+    got = np.asarray(jax.jit(lambda p, v: network_forward(plan, p, v))(params, jnp.asarray(x, jnp.float32)))
+    want = oracle_forward_batch(conv_model, x)
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-5)
+    np.testing.assert_allclose(got.sum(-1), np.ones(5), rtol=1e-5)
+
+
+def test_conv_strided_valid_padding():
+    spec = Conv2DSpec(
+        in_shape=(7, 7, 2),
+        weights=np.random.default_rng(1).normal(size=(3, 3, 2, 5)) * 0.3,
+        biases=np.random.default_rng(2).normal(size=5) * 0.1,
+        stride=(2, 2),
+        padding="valid",
+        activation="tanh",
+    )
+    from tpu_dist_nn.core.schema import ModelSpec
+
+    model = ModelSpec(layers=[spec])
+    assert spec.out_shape == (3, 3, 5)
+    plan, params = build_network(model)
+    x = np.random.default_rng(3).uniform(size=(3, spec.in_dim))
+    got = np.asarray(network_forward(plan, params, jnp.asarray(x, jnp.float32)))
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-5)
+
+
+def test_conv_json_round_trip(conv_model, tmp_path):
+    p = tmp_path / "conv.json"
+    save_model(conv_model, p)
+    loaded = load_model(p)
+    assert [l.kind for l in loaded.layers] == [l.kind for l in conv_model.layers]
+    x = np.random.default_rng(4).uniform(size=(2, conv_model.input_dim))
+    np.testing.assert_allclose(
+        oracle_forward_batch(loaded, x), oracle_forward_batch(conv_model, x)
+    )
+
+
+def test_conv_validation_errors():
+    with pytest.raises(ValueError, match="channels"):
+        Conv2DSpec.from_json(
+            {"in_shape": [4, 4, 3], "weights": np.zeros((3, 3, 2, 4)).tolist(),
+             "bias": [0.0] * 4}
+        )
+    with pytest.raises(ValueError, match="padding"):
+        Conv2DSpec(
+            in_shape=(4, 4, 2), weights=np.zeros((3, 3, 2, 4)),
+            biases=np.zeros(4), padding="reflect",
+        ).validate()
+
+
+def test_conv_training_learns():
+    model = init_conv_mlp(
+        jax.random.key(1), in_shape=(6, 6, 1), conv_filters=(4,),
+        hidden=(16,), num_classes=3,
+    )
+    data = synthetic_mnist(400, num_classes=3, dim=36, noise=0.25, seed=7)
+    train, test = data.split(0.8, seed=1)
+    plan, params = build_network(model)
+    params, history = train_network(
+        plan, params, train, TrainConfig(epochs=25, batch_size=32), eval_data=test
+    )
+    assert history[-1]["loss"] < history[0]["loss"] * 0.7
+    assert history[-1]["eval"]["accuracy"] > 0.8
+    trained = network_model_from_params(model, params)
+    # Pool layers keep their (parameterless) spec; conv weights updated.
+    assert trained.layers[1].kind == "maxpool2d"
+    assert not np.allclose(trained.layers[0].weights, model.layers[0].weights)
+
+
+def test_engine_routes_conv_model(conv_model):
+    # A pipelined placement request on a conv model falls back to the
+    # single-program executor rather than the dense SPMD pipeline.
+    engine = Engine.up(conv_model, [3, 3])
+    assert not engine.pipelined
+    x = np.random.default_rng(5).uniform(size=(4, conv_model.input_dim))
+    got = engine.infer(x)
+    want = oracle_forward_batch(conv_model, x)
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=1e-5)
+
+
+def test_engine_trains_conv_model(tmp_path):
+    model = init_conv_mlp(
+        jax.random.key(2), in_shape=(6, 6, 1), conv_filters=(4,),
+        hidden=(8,), num_classes=3,
+    )
+    data = synthetic_mnist(200, num_classes=3, dim=36, noise=0.3, seed=8)
+    engine = Engine.up(model)
+    history = engine.train(data, TrainConfig(epochs=3, batch_size=32))
+    assert history[-1]["loss"] < history[0]["loss"]
+    out = tmp_path / "conv_trained.json"
+    engine.export(out)
+    reloaded = load_model(out)
+    x = np.random.default_rng(6).uniform(size=(3, 36))
+    np.testing.assert_allclose(
+        engine.infer(x), oracle_forward_batch(reloaded, x), rtol=5e-4, atol=1e-5
+    )
+
+
+def test_maxpool_spec_round_trip():
+    spec = MaxPool2DSpec(in_shape=(8, 8, 4), window=(2, 2))
+    back = MaxPool2DSpec.from_json(spec.to_json())
+    assert back.out_shape == (4, 4, 4)
+    assert back.in_dim == 256 and back.out_dim == 64

@@ -42,7 +42,12 @@ from tpu_dist_nn.core.schema import (
 from tpu_dist_nn.data.datasets import Dataset
 from tpu_dist_nn.data.feed import batch_iterator
 from tpu_dist_nn.models.fcnn import params_from_spec
-from tpu_dist_nn.train.trainer import jitted_forward
+from tpu_dist_nn.models.network import (
+    build_network,
+    jitted_network_forward,
+    network_model_from_params,
+)
+from tpu_dist_nn.train.trainer import jitted_forward, train_network
 from tpu_dist_nn.parallel.mesh import MeshSpec, batch_sharding, build_mesh, replicated
 from tpu_dist_nn.parallel.pipeline import (
     build_pipeline_params,
@@ -75,24 +80,30 @@ class Engine:
     """A brought-up model: placed, compiled, ready to serve or train."""
 
     def __init__(self, model: ModelSpec, distribution, mesh_spec: MeshSpec,
-                 num_microbatches: int, dtype):
-        self.model = model
+                 num_microbatches: int, dtype, devices=None):
+        # Copy metadata so export()'s annotations never mutate a
+        # ModelSpec the caller still holds.
+        self.model = ModelSpec(model.layers, dict(model.metadata))
         self.distribution = list(distribution)
         self.mesh_spec = mesh_spec
         self.num_microbatches = num_microbatches
         self.dtype = dtype
         self.pipelined = mesh_spec.stage > 1
-        self.mesh = build_mesh(mesh_spec)
+        self.mesh = build_mesh(mesh_spec, devices)
         # Pure data parallelism on a single-stage plan: batch sharded
         # over the data axis, params replicated.
         self.data_sharded = not self.pipelined and mesh_spec.data > 1
+        self._plan = None  # mixed-layer (conv/pool) networks only
         if self.pipelined:
             stages = partition_model(model, self.distribution)
             self._pp = build_pipeline_params(stages, dtype)
             self._params = None
         else:
             self._pp = None
-            self._params = params_from_spec(model, dtype)
+            if model.is_dense:
+                self._params = params_from_spec(model, dtype)
+            else:
+                self._plan, self._params = build_network(model, dtype)
             if self.data_sharded:
                 self._params = jax.device_put(self._params, replicated(self.mesh))
         self.setup_seconds: float | None = None
@@ -128,6 +139,17 @@ class Engine:
 
         n_devices = len(devices or jax.devices())
         stages = len(distribution)
+        if stages > 1 and not model.is_dense:
+            # The uniform-width SPMD pipeline executor only covers dense
+            # chains; conv/pool models run single-chip or data-parallel
+            # (per-stage heterogeneous pipelining is a planned executor).
+            log.info(
+                "placement: model has non-dense layers; using the "
+                "single-program executor instead of %d pipeline stages",
+                stages,
+            )
+            distribution = [len(model.layers)]
+            stages = 1
         if stages * data_parallel > n_devices:
             log.info(
                 "placement: %d stages x %d data shards exceed %d device(s); "
@@ -141,7 +163,7 @@ class Engine:
         if mesh_spec.stage == 1:
             distribution = [len(model.layers)]
 
-        engine = cls(model, distribution, mesh_spec, num_microbatches, dtype)
+        engine = cls(model, distribution, mesh_spec, num_microbatches, dtype, devices)
         if warmup:
             # Compilation is the readiness check (the analogue of the
             # orchestrator's TCP poll, run_grpc_fcnn.py:157-172).
@@ -179,14 +201,20 @@ class Engine:
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
-        elif self.data_sharded:
+            return np.asarray(out)
+        apply = (
+            jitted_forward
+            if self._plan is None
+            else jitted_network_forward(self._plan)
+        )
+        if self.data_sharded:
             n = len(x)
             shards = self.mesh_spec.data
             xb = np.pad(x, ((0, -n % shards), (0, 0))).astype(self.dtype)
             xb = jax.device_put(xb, batch_sharding(self.mesh))
-            out = jitted_forward(self._params, xb)[:n]
+            out = apply(self._params, xb)[:n]
         else:
-            out = jitted_forward(self._params, jnp.asarray(x, self.dtype))
+            out = apply(self._params, jnp.asarray(x, self.dtype))
         return np.asarray(out)
 
     def infer_single(self, x) -> tuple[np.ndarray, float]:
@@ -244,6 +272,11 @@ class Engine:
                 eval_data=eval_data,
             )
             self.model = extract_model(self._pp, self.model, self.distribution)
+        elif self._plan is not None:
+            self._params, history = train_network(
+                self._plan, self._params, train_data, config, eval_data=eval_data
+            )
+            self.model = network_model_from_params(self.model, self._params)
         else:
             self._params, history = train_fcnn(
                 self._params, train_data, config, eval_data=eval_data

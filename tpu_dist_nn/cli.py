@@ -70,6 +70,8 @@ def cmd_up(args) -> int:
 def cmd_infer(args) -> int:
     from tpu_dist_nn.core.schema import load_examples
 
+    if not args.inputs:
+        raise ValueError("tdn infer requires --inputs (an examples JSON file)")
     engine = _engine_from_args(args)
     x, y = load_examples(args.inputs)
     if args.input_index is not None:

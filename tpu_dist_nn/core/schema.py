@@ -140,6 +140,12 @@ class ModelSpec:
     def layer_sizes(self) -> list[int]:
         return [self.input_dim] + [l.out_dim for l in self.layers]
 
+    @property
+    def is_dense(self) -> bool:
+        """True when every layer is a plain dense layer (the reference's
+        only layer family); gates the uniform-width SPMD pipeline."""
+        return all(l.kind == "dense" for l in self.layers)
+
     def validate_chain(self) -> None:
         """Check inter-layer dim consistency (the reference checks this
         per-forward at grpc_node.py:83-84; we fail fast at load)."""
@@ -155,14 +161,14 @@ class ModelSpec:
     def from_json_dict(cls, obj: dict) -> "ModelSpec":
         if not obj.get("layers"):
             raise ValueError("model has no layers")
-        layers = [LayerSpec.from_neurons(lj) for lj in obj["layers"]]
+        layers = [_layer_from_json(lj) for lj in obj["layers"]]
         metadata = {k: v for k, v in obj.items() if k != "layers"}
         spec = cls(layers=layers, metadata=metadata)
         spec.validate_chain()
         return spec
 
     def to_json_dict(self) -> dict:
-        out: dict[str, Any] = {"layers": [l.to_neurons() for l in self.layers]}
+        out: dict[str, Any] = {"layers": [_layer_to_json(l) for l in self.layers]}
         out.update(self.metadata)
         return out
 
@@ -175,6 +181,174 @@ def load_model(path: str | Path) -> ModelSpec:
 def save_model(model: ModelSpec, path: str | Path) -> None:
     with open(path, "w") as f:
         json.dump(model.to_json_dict(), f)
+
+
+@dataclasses.dataclass
+class Conv2DSpec:
+    """A 2-D convolution layer — the CIFAR extension (BASELINE configs[3]).
+
+    The reference has no conv type (its node computes only dense chains,
+    grpc_node.py:75-97); this extends the JSON schema with
+    ``{"type": "conv2d", "in_shape": [H,W,C], "kernel_size": [kh,kw],
+    "stride": [sh,sw], "padding": "same"|"valid", "weights": nested
+    (kh,kw,cin,cout), "bias": [cout], "activation": ...}``. Activations
+    stay flat vectors at layer boundaries (the reference's Matrix wire
+    shape); the layer reshapes to NHWC internally.
+    """
+
+    in_shape: tuple[int, int, int]  # (H, W, C)
+    weights: np.ndarray  # (kh, kw, cin, cout)
+    biases: np.ndarray  # (cout,)
+    stride: tuple[int, int] = (1, 1)
+    padding: str = "same"
+    activation: str = "relu"
+    type_tag: str = "conv2d"
+    kind: str = "conv2d"
+
+    @property
+    def out_shape(self) -> tuple[int, int, int]:
+        h, w, _ = self.in_shape
+        kh, kw, _, cout = self.weights.shape
+        sh, sw = self.stride
+        if self.padding.lower() == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, cout)
+
+    @property
+    def in_dim(self) -> int:
+        h, w, c = self.in_shape
+        return h * w * c
+
+    @property
+    def out_dim(self) -> int:
+        oh, ow, oc = self.out_shape
+        return oh * ow * oc
+
+    def validate(self) -> None:
+        if self.weights.ndim != 4:
+            raise ValueError(f"conv2d weights must be 4-D, got {self.weights.shape}")
+        if self.weights.shape[2] != self.in_shape[2]:
+            raise ValueError(
+                f"conv2d kernel expects {self.weights.shape[2]} input channels "
+                f"but in_shape has {self.in_shape[2]}"
+            )
+        if self.biases.shape != (self.weights.shape[3],):
+            raise ValueError(
+                f"conv2d bias shape {self.biases.shape} does not match "
+                f"{self.weights.shape[3]} filters"
+            )
+        if self.padding.lower() not in ("same", "valid"):
+            raise ValueError(f"conv2d padding must be same|valid, got {self.padding!r}")
+        oh, ow, _ = self.out_shape
+        if oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"conv2d kernel {self.weights.shape[:2]} with stride "
+                f"{self.stride} does not fit input {self.in_shape} "
+                f"(output would be {oh}x{ow})"
+            )
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Conv2DSpec":
+        spec = cls(
+            in_shape=tuple(obj["in_shape"]),
+            weights=np.asarray(obj["weights"], dtype=np.float64),
+            biases=np.asarray(obj["bias"], dtype=np.float64),
+            stride=tuple(obj.get("stride", (1, 1))),
+            padding=obj.get("padding", "same"),
+            activation=obj.get("activation", "relu"),
+        )
+        spec.validate()
+        return spec
+
+    def to_json(self) -> dict:
+        return {
+            "type": "conv2d",
+            "in_shape": list(self.in_shape),
+            "kernel_size": [int(self.weights.shape[0]), int(self.weights.shape[1])],
+            "filters": int(self.weights.shape[3]),
+            "stride": list(self.stride),
+            "padding": self.padding,
+            "activation": self.activation,
+            "weights": self.weights.tolist(),
+            "bias": self.biases.tolist(),
+        }
+
+
+@dataclasses.dataclass
+class MaxPool2DSpec:
+    """Max pooling over NHWC windows (flat-vector boundaries like conv)."""
+
+    in_shape: tuple[int, int, int]
+    window: tuple[int, int] = (2, 2)
+    stride: tuple[int, int] | None = None  # defaults to window
+    type_tag: str = "maxpool2d"
+    kind: str = "maxpool2d"
+    activation: str = "linear"
+
+    @property
+    def eff_stride(self) -> tuple[int, int]:
+        return tuple(self.stride) if self.stride else tuple(self.window)
+
+    @property
+    def out_shape(self) -> tuple[int, int, int]:
+        h, w, c = self.in_shape
+        sh, sw = self.eff_stride
+        kh, kw = self.window
+        return ((h - kh) // sh + 1, (w - kw) // sw + 1, c)
+
+    @property
+    def in_dim(self) -> int:
+        h, w, c = self.in_shape
+        return h * w * c
+
+    @property
+    def out_dim(self) -> int:
+        oh, ow, oc = self.out_shape
+        return oh * ow * oc
+
+    def validate(self) -> None:
+        if any(k <= 0 for k in self.window):
+            raise ValueError(f"maxpool2d window must be positive, got {self.window}")
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "MaxPool2DSpec":
+        spec = cls(
+            in_shape=tuple(obj["in_shape"]),
+            window=tuple(obj.get("window", (2, 2))),
+            stride=tuple(obj["stride"]) if "stride" in obj else None,
+        )
+        spec.validate()
+        return spec
+
+    def to_json(self) -> dict:
+        out = {
+            "type": "maxpool2d",
+            "in_shape": list(self.in_shape),
+            "window": list(self.window),
+        }
+        if self.stride:
+            out["stride"] = list(self.stride)
+        return out
+
+
+def _layer_from_json(obj: dict):
+    """Dispatch a layer JSON object to its spec class by ``type``."""
+    kind = obj.get("type", "hidden")
+    if kind == "conv2d":
+        return Conv2DSpec.from_json(obj)
+    if kind == "maxpool2d":
+        return MaxPool2DSpec.from_json(obj)
+    # "hidden" / "output" / anything neuron-shaped: the reference's dense
+    # format (grpc_node.py:44-55).
+    return LayerSpec.from_neurons(obj)
+
+
+def _layer_to_json(layer) -> dict:
+    if isinstance(layer, LayerSpec):
+        return layer.to_neurons()
+    return layer.to_json()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,209 @@
+"""Mixed-layer network execution: dense + conv2d + maxpool2d chains.
+
+Layer *structure* (kinds, shapes, strides) is static — captured in a
+``plan`` tuple the jitted forward closes over — while weights live in a
+params pytree. Conv layers reshape their flat input to NHWC, run
+``lax.conv_general_dilated`` (which XLA lowers onto the MXU), and
+flatten back, so every layer boundary stays a flat vector exactly like
+the reference's Matrix wire shape and the dense pipeline's hand-offs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_dist_nn.core.activations import activation_id, apply_activation_by_id
+from tpu_dist_nn.core.schema import Conv2DSpec, LayerSpec, MaxPool2DSpec, ModelSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerPlan:
+    """Static per-layer structure (hashable; closed over by jit)."""
+
+    kind: str
+    activation: str
+    in_shape: tuple | None = None  # conv/pool: (H, W, C)
+    stride: tuple | None = None
+    padding: str | None = None
+    window: tuple | None = None
+
+
+def build_network(model: ModelSpec, dtype=jnp.float32):
+    """ModelSpec -> (plan, params): static structure + trainable pytree."""
+    plan = []
+    params = []
+    for layer in model.layers:
+        if isinstance(layer, LayerSpec):
+            plan.append(LayerPlan(kind="dense", activation=layer.activation))
+            params.append(
+                {
+                    "w": jnp.asarray(layer.weights, dtype),
+                    "b": jnp.asarray(layer.biases, dtype),
+                }
+            )
+        elif isinstance(layer, Conv2DSpec):
+            plan.append(
+                LayerPlan(
+                    kind="conv2d",
+                    activation=layer.activation,
+                    in_shape=tuple(layer.in_shape),
+                    stride=tuple(layer.stride),
+                    padding=layer.padding.upper(),
+                )
+            )
+            params.append(
+                {
+                    "w": jnp.asarray(layer.weights, dtype),
+                    "b": jnp.asarray(layer.biases, dtype),
+                }
+            )
+        elif isinstance(layer, MaxPool2DSpec):
+            plan.append(
+                LayerPlan(
+                    kind="maxpool2d",
+                    activation="linear",
+                    in_shape=tuple(layer.in_shape),
+                    stride=tuple(layer.eff_stride),
+                    window=tuple(layer.window),
+                )
+            )
+            params.append({})
+        else:
+            raise ValueError(f"unsupported layer kind: {layer.kind}")
+    return tuple(plan), params
+
+
+def _apply_layer(p: LayerPlan, w: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """One layer on a flat batch ``x: (B, in_dim)`` -> (B, out_dim)."""
+    act = jnp.asarray(activation_id(p.activation), jnp.int32)
+    if p.kind == "dense":
+        return apply_activation_by_id(x @ w["w"] + w["b"], act)
+    if p.kind == "conv2d":
+        h, wd, c = p.in_shape
+        imgs = x.reshape(-1, h, wd, c)
+        out = lax.conv_general_dilated(
+            imgs,
+            w["w"],
+            window_strides=p.stride,
+            padding=p.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        out = apply_activation_by_id(out + w["b"], act)
+        return out.reshape(out.shape[0], -1)
+    if p.kind == "maxpool2d":
+        h, wd, c = p.in_shape
+        imgs = x.reshape(-1, h, wd, c)
+        out = lax.reduce_window(
+            imgs,
+            -jnp.inf,
+            lax.max,
+            window_dimensions=(1, *p.window, 1),
+            window_strides=(1, *p.stride, 1),
+            padding="VALID",
+        )
+        return out.reshape(out.shape[0], -1)
+    raise ValueError(f"unsupported layer kind: {p.kind}")
+
+
+def network_forward(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.ndarray:
+    for p, w in zip(plan, params):
+        x = _apply_layer(p, w, x)
+    return x
+
+
+def network_logits(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.ndarray:
+    """Forward with the final layer's activation skipped (for CE loss)."""
+    for p, w in zip(plan[:-1], params[:-1]):
+        x = _apply_layer(p, w, x)
+    last = dataclasses.replace(plan[-1], activation="linear")
+    return _apply_layer(last, params[-1], x)
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_network_forward(plan):
+    """Process-wide cached jitted forward per plan (plans are hashable)."""
+    return jax.jit(functools.partial(network_forward, plan))
+
+
+def network_model_from_params(model: ModelSpec, params) -> ModelSpec:
+    """Write trained params back into a copy of the spec (export leg)."""
+    new_layers = []
+    for layer, w in zip(model.layers, params):
+        if w:
+            new_layers.append(
+                dataclasses.replace(
+                    layer,
+                    weights=np.asarray(w["w"], np.float64),
+                    biases=np.asarray(w["b"], np.float64),
+                )
+            )
+        else:
+            new_layers.append(layer)
+    return ModelSpec(new_layers, dict(model.metadata))
+
+
+def init_conv_mlp(
+    key,
+    *,
+    in_shape=(32, 32, 3),
+    conv_filters=(16, 32),
+    kernel_size=(3, 3),
+    hidden=(64,),
+    num_classes=10,
+    pool_after_conv=True,
+    dtype=jnp.float32,
+) -> ModelSpec:
+    """Random CIFAR-style conv+MLP hybrid (BASELINE configs[3] shape):
+    [conv-relu(-maxpool)]* -> dense-relu* -> dense-softmax."""
+    layers = []
+    h, w, c = in_shape
+    keys = jax.random.split(key, len(conv_filters) + len(hidden) + 1)
+    ki = 0
+    for f in conv_filters:
+        kh, kw = kernel_size
+        fan_in = kh * kw * c
+        wts = np.asarray(
+            jax.random.normal(keys[ki], (kh, kw, c, f)) * np.sqrt(2.0 / fan_in),
+            np.float64,
+        )
+        ki += 1
+        layers.append(
+            Conv2DSpec(
+                in_shape=(h, w, c),
+                weights=wts,
+                biases=np.zeros(f),
+                stride=(1, 1),
+                padding="same",
+                activation="relu",
+            )
+        )
+        h, w, c = layers[-1].out_shape
+        if pool_after_conv:
+            layers.append(MaxPool2DSpec(in_shape=(h, w, c), window=(2, 2)))
+            h, w, c = layers[-1].out_shape
+    dim = h * w * c
+    sizes = [dim, *hidden, num_classes]
+    for i in range(len(sizes) - 1):
+        wts = np.asarray(
+            jax.random.normal(keys[ki], (sizes[i], sizes[i + 1]))
+            * np.sqrt(2.0 / sizes[i]),
+            np.float64,
+        )
+        ki += 1
+        last = i == len(sizes) - 2
+        layers.append(
+            LayerSpec(
+                weights=wts,
+                biases=np.zeros(sizes[i + 1]),
+                activation="softmax" if last else "relu",
+                type_tag="output" if last else "hidden",
+            )
+        )
+    return ModelSpec(layers=layers)

@@ -1,0 +1,99 @@
+"""Generic GPipe schedule over the stage mesh axis.
+
+The schedule is model-agnostic: any ``stage_fn(stage_params, x) -> y``
+with ``y.shape == x.shape[… uniform across stages]`` can ride it — the
+dense chain executor (:mod:`tpu_dist_nn.parallel.pipeline`), the
+transformer per-block pipeline, or anything else with uniform inter-
+stage activations. Microbatch ``m`` enters stage 0 at step ``m`` and
+exits stage ``S-1`` at step ``m + S - 1`` (T = M + S - 1 steps total);
+hand-off is a single ``lax.ppermute`` hop per step over ICI
+(the reference's per-hop gRPC + 2x proto ser/de, SURVEY.md §2.4,
+reduced to a device-to-device copy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
+
+
+def gpipe_device_fn(
+    stage_fn: Callable,
+    num_stages: int,
+    num_microbatches: int,
+    extra_vary_axes: tuple[str, ...] = (),
+):
+    """Build the per-device body to run under shard_map.
+
+    ``xs``: (M, *microbatch_shape) input microbatches, replicated over
+    the stage axis (only stage 0 consumes them). ``stage_params``: any
+    pytree whose leaves carry a leading length-1 stage-shard axis.
+    """
+    S, M = num_stages, num_microbatches
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    vary_axes = (AXIS_STAGE, AXIS_DATA, *extra_vary_axes)
+
+    def device_fn(xs, stage_params):
+        params = jax.tree.map(lambda a: a[0], stage_params)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        # The carry is typed as varying over the mapped axes (its value
+        # genuinely differs per stage/data coordinate once the schedule
+        # runs).
+        state0 = lax.pcast(jnp.zeros(xs.shape[1:], xs.dtype), vary_axes, to="varying")
+
+        def step(state, t):
+            inp = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            x = jnp.where(s_idx == 0, inp, state)
+            y = stage_fn(params, x)
+            nxt = lax.ppermute(y, AXIS_STAGE, fwd_perm) if fwd_perm else y
+            return nxt, y
+
+        _, ys = lax.scan(step, state0, jnp.arange(S + M - 1))
+        outs = ys[S - 1 :]  # microbatch m exits the tail at t = m + S - 1
+        # Only the tail stage's emissions are the model output; psum
+        # replicates them to every stage coordinate.
+        outs = jnp.where(s_idx == S - 1, outs, jnp.zeros((), outs.dtype))
+        return lax.psum(outs, AXIS_STAGE)
+
+    return device_fn
+
+
+def make_gpipe(
+    mesh,
+    stage_fn: Callable,
+    num_stages: int,
+    num_microbatches: int,
+    *,
+    microbatch_spec: P | None = None,
+):
+    """shard_map the schedule over the mesh.
+
+    ``microbatch_spec`` partitions one microbatch (without the leading M
+    axis); default shards the batch dim over the data axis. Returns
+    ``f(xs, stage_params) -> (M, *microbatch_shape) outputs``.
+    """
+    if microbatch_spec is None:
+        microbatch_spec = P(AXIS_DATA)
+    xs_spec = P(None, *microbatch_spec)
+    extra = tuple(
+        ax
+        for part in microbatch_spec
+        if part is not None
+        for ax in ((part,) if isinstance(part, str) else tuple(part))
+        if ax != AXIS_DATA
+    )
+    device_fn = gpipe_device_fn(stage_fn, num_stages, num_microbatches, extra)
+    return jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(xs_spec, P(AXIS_STAGE)),
+        out_specs=xs_spec,
+    )

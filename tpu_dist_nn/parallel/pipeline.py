@@ -196,61 +196,34 @@ def _stage_apply(w, b, act, width, x):
     return x
 
 
-def _pipeline_device_fn(xs, w, b, act, width, *, num_stages, num_microbatches):
-    """Per-device body under shard_map: the GPipe schedule.
-
-    ``xs``: (M, mb, D) microbatches (replicated over the stage axis;
-    only stage 0 consumes them). ``w``/``b``/``act``/``width`` arrive
-    with a leading length-1 stage-shard axis.
-    """
-    w, b, act, width = w[0], b[0], act[0], width[0]
-    S, M = num_stages, num_microbatches
-    s_idx = lax.axis_index(AXIS_STAGE)
-    # The carry must be typed as varying over the mapped axes (its value
-    # genuinely differs per stage/data coordinate once the schedule runs).
-    state0 = lax.pcast(
-        jnp.zeros(xs.shape[1:], xs.dtype), (AXIS_STAGE, AXIS_DATA), to="varying"
-    )
-    fwd_perm = [(i, i + 1) for i in range(S - 1)]
-
-    def step(state, t):
-        inp = lax.dynamic_index_in_dim(xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-        x = jnp.where(s_idx == 0, inp, state)
-        y = _stage_apply(w, b, act, width, x)
-        nxt = lax.ppermute(y, AXIS_STAGE, fwd_perm) if fwd_perm else y
-        return nxt, y
-
-    _, ys = lax.scan(step, state0, jnp.arange(S + M - 1))
-    outs = ys[S - 1 :]  # (M, mb, D); microbatch m exits the tail at t = m+S-1
-    # Only the tail stage's emissions are the model output; psum
-    # replicates them to every stage coordinate.
-    outs = jnp.where(s_idx == S - 1, outs, jnp.zeros((), outs.dtype))
-    return lax.psum(outs, AXIS_STAGE)
-
-
 @functools.lru_cache(maxsize=64)
 def compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: bool, dtype):
-    """Build + jit the shard_mapped pipeline executor for one config."""
+    """Build + jit the shard_mapped pipeline executor for one config.
+
+    The dense chain rides the generic GPipe schedule
+    (:mod:`tpu_dist_nn.parallel.gpipe`) with the per-stage layer chain
+    as the stage function.
+    """
+    from tpu_dist_nn.parallel.gpipe import make_gpipe
+
     act = jnp.asarray(meta.act_array(logits))
     width = jnp.asarray(meta.width_array())
 
-    stage_spec = P(AXIS_STAGE)
-    xs_spec = P(None, AXIS_DATA, None)
-    device_fn = functools.partial(
-        _pipeline_device_fn,
-        num_stages=meta.num_stages,
-        num_microbatches=num_microbatches,
-    )
-    mapped = jax.shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=(xs_spec, stage_spec, stage_spec, stage_spec, stage_spec),
-        out_specs=xs_spec,
+    def stage_fn(params, x):
+        return _stage_apply(params["w"], params["b"], params["act"], params["width"], x)
+
+    mapped = make_gpipe(
+        mesh,
+        stage_fn,
+        meta.num_stages,
+        num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None),
     )
 
     @jax.jit
     def run(weights: PipelineWeights, xs):
-        out = mapped(xs, weights.w, weights.b, act, width)
+        stage_params = {"w": weights.w, "b": weights.b, "act": act, "width": width}
+        out = mapped(xs, stage_params)
         # (M, B, D) -> (M*B, final_dim): slice off feature padding and
         # merge microbatches inside jit so XLA handles the reshard of the
         # data-sharded batch axis.

@@ -53,8 +53,47 @@ _SCALAR_ACTIVATIONS = {
 }
 
 
+def _same_pad(size: int, k: int, s: int) -> tuple[int, int]:
+    """XLA SAME-padding split (lo = total // 2)."""
+    total = max((-(-size // s) - 1) * s + k - size, 0)
+    return total // 2, total - total // 2
+
+
+def _conv2d_np(x: np.ndarray, w: np.ndarray, stride, padding) -> np.ndarray:
+    """Direct float64 conv: x (H,W,C), w (kh,kw,cin,cout) -> (OH,OW,cout)."""
+    kh, kw, _, cout = w.shape
+    sh, sw = stride
+    if padding.lower() == "same":
+        (pt, pb), (pl, pr) = _same_pad(x.shape[0], kh, sh), _same_pad(x.shape[1], kw, sw)
+        x = np.pad(x, ((pt, pb), (pl, pr), (0, 0)))
+    oh = (x.shape[0] - kh) // sh + 1
+    ow = (x.shape[1] - kw) // sw + 1
+    out = np.zeros((oh, ow, cout))
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[i * sh : i * sh + kh, j * sw : j * sw + kw, :]
+            out[i, j] = np.tensordot(patch, w, axes=([0, 1, 2], [0, 1, 2]))
+    return out
+
+
+def _maxpool2d_np(x: np.ndarray, window, stride) -> np.ndarray:
+    kh, kw = window
+    sh, sw = stride
+    oh = (x.shape[0] - kh) // sh + 1
+    ow = (x.shape[1] - kw) // sw + 1
+    out = np.zeros((oh, ow, x.shape[2]))
+    for i in range(oh):
+        for j in range(ow):
+            out[i, j] = x[i * sh : i * sh + kh, j * sw : j * sw + kw, :].max(axis=(0, 1))
+    return out
+
+
 def oracle_forward(model: ModelSpec, input_vector) -> np.ndarray:
-    """Single-example forward, per-neuron loop, float64 (manual_nn.py:23-70)."""
+    """Single-example forward, per-neuron loop, float64 (manual_nn.py:23-70).
+
+    Extended beyond the reference with conv2d/maxpool2d layers (flat
+    vectors at every boundary, matching the framework's wire shape).
+    """
     a = np.asarray(input_vector, dtype=np.float64).reshape(-1)
     for idx, layer in enumerate(model.layers):
         if layer.in_dim != a.shape[0]:
@@ -62,12 +101,24 @@ def oracle_forward(model: ModelSpec, input_vector) -> np.ndarray:
                 f"Dimension mismatch in layer {idx}: input dimension {a.shape[0]} "
                 f"does not match number of weights {layer.in_dim}"
             )
-        # Per-neuron dot products (column j of the (in,out) matrix is
-        # neuron j's weight row, schema.LayerSpec.from_neurons).
-        z = np.array(
-            [np.dot(a, layer.weights[:, j]) + layer.biases[j] for j in range(layer.out_dim)]
-        )
         act = layer.activation.lower()
+        if layer.kind == "conv2d":
+            img = a.reshape(layer.in_shape)
+            z = (_conv2d_np(img, layer.weights, layer.stride, layer.padding)
+                 + layer.biases).reshape(-1)
+        elif layer.kind == "maxpool2d":
+            img = a.reshape(layer.in_shape)
+            a = _maxpool2d_np(img, layer.window, layer.eff_stride).reshape(-1)
+            continue
+        else:
+            # Per-neuron dot products (column j of the (in,out) matrix is
+            # neuron j's weight row, schema.LayerSpec.from_neurons).
+            z = np.array(
+                [
+                    np.dot(a, layer.weights[:, j]) + layer.biases[j]
+                    for j in range(layer.out_dim)
+                ]
+            )
         if act == "softmax":
             a = _np_softmax(z)
         else:

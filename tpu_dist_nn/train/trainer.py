@@ -12,6 +12,7 @@ trains the pipelined layout across a mesh.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 
 import jax
@@ -72,23 +73,14 @@ def make_train_step(acts, optimizer):
     return step
 
 
-def train_fcnn(
-    params,
-    train_data: Dataset,
-    config: TrainConfig = TrainConfig(),
-    eval_data: Dataset | None = None,
-):
-    """Train a params pytree; returns (params, history).
+def run_training_loop(step, params, opt_state, train_data, config, eval_fn=None):
+    """Generic epoch/batch loop shared by every trainer flavor.
 
-    History records per-epoch mean loss, wall time, and (if eval data is
-    given) eval accuracy — the counters the reference printed per run
+    ``step(params, opt_state, x, y) -> (params, opt_state, loss)`` must
+    be jitted by the caller. History records per-epoch mean loss, wall
+    time, and eval metrics — the counters the reference printed per run
     (run_grpc_inference.py:213-216, generate_mnist_pytorch.py:50-52).
     """
-    wb, acts = _split_params(params)
-    optimizer = optax.adam(config.learning_rate)
-    opt_state = optimizer.init(wb)
-    step = make_train_step(acts, optimizer)
-
     history = []
     for epoch in range(config.epochs):
         t0 = time.monotonic()
@@ -102,8 +94,8 @@ def train_fcnn(
             drop_remainder=True,  # stable shapes: one compiled step
         )
         for bx, by in batches:
-            wb, opt_state, loss = step(
-                wb, opt_state, jnp.asarray(bx, jnp.float32), jnp.asarray(by)
+            params, opt_state, loss = step(
+                params, opt_state, jnp.asarray(bx, jnp.float32), jnp.asarray(by)
             )
             losses.append(loss)
         record = {
@@ -111,9 +103,27 @@ def train_fcnn(
             "loss": float(jnp.stack(losses).mean()),
             "seconds": time.monotonic() - t0,
         }
-        if eval_data is not None:
-            record["eval"] = evaluate_fcnn(_join_params(wb, acts), eval_data)
+        if eval_fn is not None:
+            record["eval"] = eval_fn(params)
         history.append(record)
+    return params, history
+
+
+def train_fcnn(
+    params,
+    train_data: Dataset,
+    config: TrainConfig = TrainConfig(),
+    eval_data: Dataset | None = None,
+):
+    """Train a dense params pytree; returns (params, history)."""
+    wb, acts = _split_params(params)
+    optimizer = optax.adam(config.learning_rate)
+    opt_state = optimizer.init(wb)
+    step = make_train_step(acts, optimizer)
+    eval_fn = None
+    if eval_data is not None:
+        eval_fn = lambda wb_: evaluate_fcnn(_join_params(wb_, acts), eval_data)
+    wb, history = run_training_loop(step, wb, opt_state, train_data, config, eval_fn)
     return _join_params(wb, acts), history
 
 
@@ -129,6 +139,50 @@ def evaluate_fcnn(params, data: Dataset, batch_size: int = 1024) -> dict:
         preds.append(
             np.asarray(jitted_forward(params, jnp.asarray(bx, jnp.float32))).argmax(-1)
         )
+    return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
+
+
+def make_network_train_step(plan, optimizer):
+    """Jitted step for mixed-layer (dense/conv/pool) networks."""
+    from tpu_dist_nn.models.network import network_logits
+
+    def loss_fn(params, x, y):
+        return cross_entropy(network_logits(plan, params, x), y)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def train_network(
+    plan,
+    params,
+    train_data: Dataset,
+    config: TrainConfig = TrainConfig(),
+    eval_data: Dataset | None = None,
+):
+    """Train a mixed-layer network; returns (params, history)."""
+    optimizer = optax.adam(config.learning_rate)
+    opt_state = optimizer.init(params)
+    step = make_network_train_step(plan, optimizer)
+    eval_fn = None
+    if eval_data is not None:
+        eval_fn = lambda p: evaluate_network(plan, p, eval_data)
+    return run_training_loop(step, params, opt_state, train_data, config, eval_fn)
+
+
+def evaluate_network(plan, params, data: Dataset, batch_size: int = 1024) -> dict:
+    from tpu_dist_nn.models.network import jitted_network_forward
+
+    apply = jitted_network_forward(plan)
+    preds = []
+    for bx in batch_iterator(data.x, batch_size=batch_size):
+        preds.append(np.asarray(apply(params, jnp.asarray(bx, jnp.float32))).argmax(-1))
     return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
 
 
