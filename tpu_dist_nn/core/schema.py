@@ -311,6 +311,20 @@ class MaxPool2DSpec:
     def validate(self) -> None:
         if any(k <= 0 for k in self.window):
             raise ValueError(f"maxpool2d window must be positive, got {self.window}")
+        if any(s <= 0 for s in self.eff_stride):
+            raise ValueError(
+                f"maxpool2d stride must be positive, got {self.eff_stride}"
+            )
+        if any(d <= 0 for d in self.in_shape):
+            raise ValueError(
+                f"maxpool2d in_shape must be positive, got {self.in_shape}"
+            )
+        oh, ow, _ = self.out_shape
+        if oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"maxpool2d window {self.window} does not fit input "
+                f"{self.in_shape} (output shape {self.out_shape})"
+            )
 
     @classmethod
     def from_json(cls, obj: dict) -> "MaxPool2DSpec":

@@ -1,0 +1,125 @@
+"""Text corpus + LM batching for the Tiny-Transformer config.
+
+BASELINE.json configs[4] names WikiText-2 as the workload. The build
+environment has zero network egress, so corpus acquisition is gated:
+:func:`load_corpus` reads a real on-disk corpus when one is present
+(``TDN_WIKITEXT_PATH`` or a conventional path), and otherwise generates
+a deterministic synthetic Wikipedia-markup-like corpus with matched
+surface statistics (articles, headings, punctuation, a Zipfian word
+distribution) so training/eval pipelines run identically either way —
+the same pattern as :func:`tpu_dist_nn.data.datasets.synthetic_mnist`
+vs. the reference's real-MNIST scripts (generate_mnist_pytorch.py:14-20).
+
+Tokenization is byte-level (vocab 256): no vocabulary file to ship,
+fully reversible, and the Tiny-Transformer target is architecture/
+throughput parity, not leaderboard perplexity.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+VOCAB_SIZE = 256
+
+_WIKITEXT_ENV = "TDN_WIKITEXT_PATH"
+_DEFAULT_PATHS = (
+    "/root/data/wikitext-2/wiki.train.tokens",
+    "/root/data/wikitext-2-raw/wiki.train.raw",
+)
+
+# Word stems for the synthetic corpus; frequencies get a Zipf tail.
+_STEMS = (
+    "the of and in to a is was for on as by with at from it an be are "
+    "this that were which or had its not also has have but one two first "
+    "new time year city state war world part name known work made used "
+    "century north south system group number station game song film album "
+    "series team season league player club county town river road church "
+    "school university company government president member history family"
+).split()
+
+
+def encode(text: str) -> np.ndarray:
+    """UTF-8 bytes as int32 token ids."""
+    return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(np.int32)
+
+
+def decode(tokens: np.ndarray) -> str:
+    return bytes(np.asarray(tokens, dtype=np.uint8)).decode("utf-8", errors="replace")
+
+
+def synthetic_wikitext(n_chars: int = 500_000, seed: int = 0) -> str:
+    """Deterministic corpus with WikiText-like surface structure."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, len(_STEMS) + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    out: list[str] = []
+    total = 0
+    article = 0
+    while total < n_chars:
+        article += 1
+        title = " ".join(
+            w.capitalize() for w in rng.choice(_STEMS, size=rng.integers(1, 4), p=probs)
+        )
+        out.append(f"\n = {title} = \n\n")
+        for _ in range(int(rng.integers(2, 6))):  # sections
+            if rng.random() < 0.5:
+                sub = " ".join(rng.choice(_STEMS, size=2, p=probs))
+                out.append(f" = = {sub} = = \n\n")
+            for _ in range(int(rng.integers(1, 4))):  # paragraphs
+                n_words = int(rng.integers(30, 120))
+                words = rng.choice(_STEMS, size=n_words, p=probs).tolist()
+                for i in range(0, n_words, int(rng.integers(8, 16))):
+                    if i:
+                        words[i] = words[i] + " ,"
+                sent = " ".join(words)
+                out.append(sent + " . \n")
+            out.append("\n")
+        total = sum(len(s) for s in out)
+    return "".join(out)[:n_chars]
+
+
+def load_corpus(path: str | os.PathLike | None = None, *,
+                synthetic_chars: int = 500_000, seed: int = 0) -> tuple[str, str]:
+    """-> (text, source): a real corpus when available, else synthetic.
+
+    Lookup order: explicit ``path`` arg, ``$TDN_WIKITEXT_PATH``, the
+    conventional on-disk locations, then the synthetic generator.
+    """
+    candidates = []
+    if path is not None:
+        candidates.append(Path(path))
+    if os.environ.get(_WIKITEXT_ENV):
+        candidates.append(Path(os.environ[_WIKITEXT_ENV]))
+    candidates.extend(Path(p) for p in _DEFAULT_PATHS)
+    for cand in candidates:
+        if cand.is_file():
+            return cand.read_text(encoding="utf-8", errors="replace"), str(cand)
+    return synthetic_wikitext(synthetic_chars, seed), "synthetic"
+
+
+def lm_sequences(tokens: np.ndarray, seq_len: int) -> np.ndarray:
+    """Chunk a token stream into ``(N, seq_len + 1)`` training rows.
+
+    Each row holds ``seq_len`` inputs plus the shifted target for the
+    last position (the +1); the tail remainder is dropped (static
+    shapes — no ragged batches under jit).
+    """
+    row = seq_len + 1
+    n = len(tokens) // row
+    return tokens[: n * row].reshape(n, row)
+
+
+def lm_batches(rows: np.ndarray, batch_size: int, *, seed: int = 0,
+               epochs: int | None = 1) -> Iterator[np.ndarray]:
+    """Shuffled ``(batch_size, seq_len+1)`` batches; partial tails dropped."""
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(len(rows))
+        for i in range(0, len(rows) - batch_size + 1, batch_size):
+            yield rows[order[i : i + batch_size]]
+        epoch += 1

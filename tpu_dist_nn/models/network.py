@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tpu_dist_nn.core.activations import activation_id, apply_activation_by_id
+from tpu_dist_nn.core.activations import apply_activation
 from tpu_dist_nn.core.schema import Conv2DSpec, LayerSpec, MaxPool2DSpec, ModelSpec
 
 
@@ -82,9 +82,11 @@ def build_network(model: ModelSpec, dtype=jnp.float32):
 
 def _apply_layer(p: LayerPlan, w: dict, x: jnp.ndarray) -> jnp.ndarray:
     """One layer on a flat batch ``x: (B, in_dim)`` -> (B, out_dim)."""
-    act = jnp.asarray(activation_id(p.activation), jnp.int32)
+    # Activation is static in the hashable plan — dispatch directly
+    # rather than through the lax.switch id path (that machinery exists
+    # for the SPMD pipeline where the activation rides as traced data).
     if p.kind == "dense":
-        return apply_activation_by_id(x @ w["w"] + w["b"], act)
+        return apply_activation(x @ w["w"] + w["b"], p.activation)
     if p.kind == "conv2d":
         h, wd, c = p.in_shape
         imgs = x.reshape(-1, h, wd, c)
@@ -95,7 +97,7 @@ def _apply_layer(p: LayerPlan, w: dict, x: jnp.ndarray) -> jnp.ndarray:
             padding=p.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        out = apply_activation_by_id(out + w["b"], act)
+        out = apply_activation(out + w["b"], p.activation)
         return out.reshape(out.shape[0], -1)
     if p.kind == "maxpool2d":
         h, wd, c = p.in_shape

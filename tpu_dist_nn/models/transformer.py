@@ -1,0 +1,181 @@
+"""Tiny-Transformer LM: the BASELINE configs[4] model family.
+
+The reference has no attention anywhere (SURVEY.md §5 "long-context:
+entirely absent"); this family exists because BASELINE.json configs[4]
+names "Tiny-Transformer encoder on WikiText-2 (per-block pipeline stage
+over ICI)" as a target workload. Design is TPU-first:
+
+* Blocks are **stacked**: every parameter leaf carries a leading
+  ``(n_layers, ...)`` axis, so the single-chip forward is a
+  ``lax.scan`` over one traced block (one compile, MXU-shaped matmuls)
+  and the pipelined forward shards the same axis over the ``stage``
+  mesh axis and rides the generic GPipe schedule
+  (:mod:`tpu_dist_nn.parallel.gpipe`) unchanged — one block group per
+  stage, hand-off = ``ppermute`` of the ``(batch, seq, d_model)``
+  activation over ICI.
+* Pre-LayerNorm residual blocks (attn then MLP), GELU MLP, learned
+  positional embeddings, tied LM head — the standard small-LM recipe.
+* Causality is a static flag: the mask is built at trace time, no
+  dynamic shapes.
+
+Attention is factored out (:func:`dot_product_attention`) so the
+sequence-parallel ring executor (:mod:`tpu_dist_nn.parallel.ring_attention`)
+can swap in blockwise attention while reusing everything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Static architecture description (hashable; closed over by jit)."""
+
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 512
+    max_seq_len: int = 256
+    causal: bool = True
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by n_heads={self.n_heads}"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_transformer(key: jax.Array, cfg: TransformerConfig, dtype=jnp.float32):
+    """Params pytree; block leaves are stacked on a leading n_layers axis."""
+    k_tok, k_pos, k_blocks = jax.random.split(key, 3)
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    s_embed = 1.0 / np.sqrt(D)
+
+    def dense(k, shape, scale):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    bk = jax.random.split(k_blocks, 6 * L).reshape(L, 6)
+    blocks = {
+        "ln1_g": jnp.ones((L, D), dtype),
+        "ln1_b": jnp.zeros((L, D), dtype),
+        # qkv fused: one (D, 3D) matmul feeds the MXU better than three
+        # (D, D) ones.
+        "w_qkv": jnp.stack([dense(bk[i, 0], (D, 3 * D), s_embed) for i in range(L)]),
+        "b_qkv": jnp.zeros((L, 3 * D), dtype),
+        "w_o": jnp.stack([dense(bk[i, 1], (D, D), s_embed / np.sqrt(2 * L)) for i in range(L)]),
+        "b_o": jnp.zeros((L, D), dtype),
+        "ln2_g": jnp.ones((L, D), dtype),
+        "ln2_b": jnp.zeros((L, D), dtype),
+        "w_up": jnp.stack([dense(bk[i, 2], (D, F), s_embed) for i in range(L)]),
+        "b_up": jnp.zeros((L, F), dtype),
+        "w_down": jnp.stack(
+            [dense(bk[i, 3], (F, D), (1.0 / np.sqrt(F)) / np.sqrt(2 * L)) for i in range(L)]
+        ),
+        "b_down": jnp.zeros((L, D), dtype),
+    }
+    return {
+        "tok_embed": dense(k_tok, (cfg.vocab_size, D), s_embed),
+        "pos_embed": dense(k_pos, (cfg.max_seq_len, D), 0.01),
+        "blocks": blocks,
+        "lnf_g": jnp.ones((D,), dtype),
+        "lnf_b": jnp.zeros((D,), dtype),
+        # LM head tied to tok_embed (logits = x @ tok_embed.T).
+    }
+
+
+def layer_norm(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def dot_product_attention(q, k, v, *, causal: bool):
+    """Standard softmax attention.
+
+    ``q,k,v: (..., T, H, Dh)`` -> ``(..., T, H, Dh)``. Scores accumulate
+    in f32 regardless of input dtype (bf16-safe on the MXU).
+    """
+    dtype = q.dtype
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t_q, t_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+def block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
+                attn_fn=dot_product_attention) -> jnp.ndarray:
+    """One pre-LN residual block: ``x: (batch, T, D) -> (batch, T, D)``.
+
+    ``block`` holds *unstacked* leaves (no leading layer axis) — a scan
+    carry slice single-chip, or one stage's shard in the pipeline.
+    """
+    B, T, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    h = layer_norm(x, block["ln1_g"], block["ln1_b"])
+    qkv = h @ block["w_qkv"] + block["b_qkv"]
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, Dh), 3, axis=2)
+    o = attn_fn(q, k, v, causal=cfg.causal).reshape(B, T, D)
+    x = x + o @ block["w_o"] + block["b_o"]
+
+    h = layer_norm(x, block["ln2_g"], block["ln2_b"])
+    h = jax.nn.gelu(h @ block["w_up"] + block["b_up"])
+    return x + h @ block["w_down"] + block["b_down"]
+
+
+def embed(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """``tokens: (batch, T) int32 -> (batch, T, D)`` activations."""
+    T = tokens.shape[-1]
+    return params["tok_embed"][tokens] + params["pos_embed"][:T]
+
+
+def unembed(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Final LN + tied LM head: ``(batch, T, D) -> (batch, T, V)``."""
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["tok_embed"].T
+
+
+def forward(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
+            attn_fn=dot_product_attention) -> jnp.ndarray:
+    """Full LM forward: ``(batch, T) tokens -> (batch, T, vocab) logits``.
+
+    The block stack runs as ``lax.scan`` over the stacked layer axis —
+    one traced block body regardless of depth.
+    """
+    x = embed(params, tokens)
+
+    def body(carry, block):
+        return block_apply(block, carry, cfg, attn_fn), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    return unembed(params, x)
+
+
+def lm_loss(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
+            attn_fn=dot_product_attention) -> jnp.ndarray:
+    """Next-token cross-entropy (mean nats/token) on ``(batch, T)`` tokens."""
+    logits = forward(params, tokens[:, :-1], cfg, attn_fn)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def num_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

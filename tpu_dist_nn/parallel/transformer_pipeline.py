@@ -1,0 +1,102 @@
+"""Per-block transformer pipeline over the stage mesh axis.
+
+BASELINE.json configs[4]: "Tiny-Transformer encoder ... per-block
+pipeline stage over ICI". Blocks have uniform ``(batch, T, d_model)``
+inter-stage activations, so they ride the generic GPipe schedule
+(:mod:`tpu_dist_nn.parallel.gpipe`) directly — no padding/masking
+machinery (that exists only for the FCNN pipeline's ragged widths,
+SURVEY.md §7 hard part 1). Embedding and the tied LM head run outside
+the stage loop, sharded over the ``data`` axis; the block stack's
+leading layer axis is resharded ``(n_layers, ...) -> (S, L/S, ...)``
+so each stage scans its local block group.
+
+Gradients flow through the schedule by differentiating the shard_map'd
+scan: the backward of ``ppermute`` is the reverse ``ppermute``, so the
+backward pipeline runs the chain in reverse automatically (SURVEY.md §7
+hard part 2) — no hand-written backward schedule.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    block_apply,
+    embed,
+    unembed,
+)
+from tpu_dist_nn.parallel.gpipe import make_gpipe
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.parallel.mesh import AXIS_DATA
+
+
+def shard_blocks(blocks: dict, num_stages: int) -> dict:
+    """Regroup stacked block leaves ``(L, ...) -> (S, L/S, ...)``."""
+    L = jax.tree.leaves(blocks)[0].shape[0]
+    if L % num_stages:
+        raise ValueError(
+            f"n_layers={L} not divisible by num_stages={num_stages}"
+        )
+    return jax.tree.map(
+        lambda a: a.reshape(num_stages, L // num_stages, *a.shape[1:]), blocks
+    )
+
+
+def unshard_blocks(staged: dict) -> dict:
+    """Inverse of :func:`shard_blocks`: ``(S, L/S, ...) -> (L, ...)``."""
+    return jax.tree.map(lambda a: a.reshape(-1, *a.shape[2:]), staged)
+
+
+def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
+                             num_microbatches: int):
+    """-> ``fn(params, tokens) -> logits`` with blocks pipelined.
+
+    ``params`` is the standard transformer pytree but with
+    ``params["blocks"]`` regrouped by :func:`shard_blocks`.
+    ``tokens: (B, T)`` with ``B`` divisible by
+    ``num_microbatches * mesh data size``.
+    """
+
+    def stage_fn(stage_blocks, x):
+        # stage_blocks leaves: (L/S, ...); scan the local block group.
+        def body(carry, block):
+            return block_apply(block, carry, cfg), None
+
+        y, _ = lax.scan(body, x, stage_blocks)
+        return y
+
+    gpipe = make_gpipe(
+        mesh, stage_fn, num_stages, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None, None),
+    )
+
+    def fn(params, tokens):
+        B, T = tokens.shape
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        x = embed(params, tokens)
+        xs = x.reshape(M, B // M, T, cfg.d_model)
+        ys = gpipe(xs, params["blocks"])
+        return unembed(params, ys.reshape(B, T, cfg.d_model))
+
+    return fn
+
+
+def make_pipeline_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
+                          num_microbatches: int):
+    """-> ``loss_fn(params, tokens) -> scalar`` next-token CE through the pipeline."""
+    fwd = make_pipeline_lm_forward(mesh, cfg, num_stages, num_microbatches)
+
+    def loss_fn(params, tokens):
+        logits = fwd(params, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    return loss_fn

@@ -104,8 +104,16 @@ def oracle_forward(model: ModelSpec, input_vector) -> np.ndarray:
         act = layer.activation.lower()
         if layer.kind == "conv2d":
             img = a.reshape(layer.in_shape)
-            z = (_conv2d_np(img, layer.weights, layer.stride, layer.padding)
-                 + layer.biases).reshape(-1)
+            z_img = _conv2d_np(img, layer.weights, layer.stride, layer.padding) + layer.biases
+            # Softmax on a conv layer normalizes each pixel's channel
+            # vector (the framework applies activations over the last
+            # axis of the NHWC image, network.py:_apply_layer), so the
+            # oracle must act on the image, not the flattened vector.
+            if act == "softmax":
+                a = _np_softmax(z_img).reshape(-1)
+            else:
+                a = _SCALAR_ACTIVATIONS.get(act, lambda x: x)(z_img).reshape(-1)
+            continue
         elif layer.kind == "maxpool2d":
             img = a.reshape(layer.in_shape)
             a = _maxpool2d_np(img, layer.window, layer.eff_stride).reshape(-1)

@@ -1,0 +1,124 @@
+"""Language-model training: single-chip and pipelined Tiny-Transformer.
+
+The native-training analogue of the reference's centralized recipes
+(Adam + CE, generate_mnist_pytorch.py:37-52) applied to the
+BASELINE.json configs[4] LM workload: next-token cross-entropy, Adam,
+jit-compiled steps; the pipelined variant differentiates straight
+through the GPipe schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpu_dist_nn.models.transformer import TransformerConfig, lm_loss
+from tpu_dist_nn.parallel.transformer_pipeline import (
+    make_pipeline_lm_loss,
+    shard_blocks,
+    unshard_blocks,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LMTrainConfig:
+    learning_rate: float = 1e-3
+    steps: int = 200
+    batch_size: int = 16
+    seq_len: int = 128
+    log_every: int = 50
+
+
+def make_lm_train_step(cfg: TransformerConfig, optimizer):
+    """jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
+                                num_microbatches: int, optimizer):
+    """Pipelined train step; ``params["blocks"]`` must be stage-grouped
+    (:func:`tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`)."""
+    loss_fn = make_pipeline_lm_loss(mesh, cfg, num_stages, num_microbatches)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
+             train_cfg: LMTrainConfig, *, mesh=None, num_stages: int = 1,
+             num_microbatches: int = 1):
+    """Run the training loop; pipelined when ``mesh``+``num_stages>1``.
+
+    Returns ``(params, history)`` with params in standard (unstaged)
+    layout either way.
+    """
+    optimizer = optax.adam(train_cfg.learning_rate)
+    pipelined = mesh is not None and num_stages > 1
+    if pipelined:
+        params = dict(params, blocks=shard_blocks(params["blocks"], num_stages))
+        step = make_pipeline_lm_train_step(
+            mesh, cfg, num_stages, num_microbatches, optimizer
+        )
+    else:
+        step = make_lm_train_step(cfg, optimizer)
+    opt_state = optimizer.init(params)
+
+    history = []
+    t0 = time.monotonic()
+    for i, batch in enumerate(batches):
+        if i >= train_cfg.steps:
+            break
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(batch))
+        if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
+            history.append(
+                {"step": i + 1, "loss": float(loss),
+                 "seconds": time.monotonic() - t0}
+            )
+    if pipelined:
+        params = dict(params, blocks=unshard_blocks(params["blocks"]))
+    return params, history
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_lm_loss(cfg: TransformerConfig):
+    """Process-wide cached jitted loss per config (configs are hashable) —
+    a fresh jax.jit per eval call would recompile every time."""
+    return jax.jit(functools.partial(lm_loss, cfg=cfg))
+
+
+def evaluate_lm(params, cfg: TransformerConfig, rows: np.ndarray,
+                batch_size: int = 16) -> dict:
+    """Mean next-token CE + perplexity + bits/byte over ``(N, T+1)`` rows."""
+    loss_fn = _jitted_lm_loss(cfg)
+    losses, weights = [], []
+    for i in range(0, len(rows) - batch_size + 1, batch_size):
+        batch = jnp.asarray(rows[i : i + batch_size])
+        losses.append(float(loss_fn(params, batch)))
+        weights.append(len(batch))
+    if not losses:
+        raise ValueError("not enough rows for one eval batch")
+    loss = float(np.average(losses, weights=weights))
+    return {
+        "loss_nats_per_token": loss,
+        "perplexity": float(np.exp(loss)),
+        "bits_per_byte": loss / np.log(2),
+    }

@@ -132,14 +132,19 @@ def train_fcnn(
 jitted_forward = jax.jit(forward)
 
 
-def evaluate_fcnn(params, data: Dataset, batch_size: int = 1024) -> dict:
-    """Full classification metrics over a dataset."""
+def _evaluate_classifier(apply, params, data: Dataset, batch_size: int) -> dict:
+    """Shared eval loop: batch-iterate, argmax, classification metrics."""
     preds = []
     for bx in batch_iterator(data.x, batch_size=batch_size):
         preds.append(
-            np.asarray(jitted_forward(params, jnp.asarray(bx, jnp.float32))).argmax(-1)
+            np.asarray(apply(params, jnp.asarray(bx, jnp.float32))).argmax(-1)
         )
     return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
+
+
+def evaluate_fcnn(params, data: Dataset, batch_size: int = 1024) -> dict:
+    """Full classification metrics over a dataset."""
+    return _evaluate_classifier(jitted_forward, params, data, batch_size)
 
 
 def make_network_train_step(plan, optimizer):
@@ -179,11 +184,7 @@ def train_network(
 def evaluate_network(plan, params, data: Dataset, batch_size: int = 1024) -> dict:
     from tpu_dist_nn.models.network import jitted_network_forward
 
-    apply = jitted_network_forward(plan)
-    preds = []
-    for bx in batch_iterator(data.x, batch_size=batch_size):
-        preds.append(np.asarray(apply(params, jnp.asarray(bx, jnp.float32))).argmax(-1))
-    return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
+    return _evaluate_classifier(jitted_network_forward(plan), params, data, batch_size)
 
 
 def export_model(
