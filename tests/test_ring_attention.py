@@ -1,0 +1,146 @@
+"""Ring attention / sequence parallelism: parity with full attention,
+gradient flow, combined data+seq meshes."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    dot_product_attention,
+    forward,
+    init_transformer,
+    lm_loss,
+)
+from tpu_dist_nn.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_SEQ,
+    MeshSpec,
+    build_mesh,
+)
+from tpu_dist_nn.parallel.ring_attention import (
+    make_seq_parallel_lm_forward,
+    make_seq_parallel_lm_loss,
+    ring_attention,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq_len=64
+)
+
+
+def _qkv(b=2, t=32, h=4, dh=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(b, t, h, dh)), jnp.float32) for _ in range(3)
+    )
+
+
+def _ring_apply(mesh, q, k, v, causal):
+    fn = jax.shard_map(
+        functools.partial(ring_attention, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, AXIS_SEQ), P(None, AXIS_SEQ), P(None, AXIS_SEQ)),
+        out_specs=P(None, AXIS_SEQ),
+    )
+    return np.asarray(fn(q, k, v))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("seq_devices", [2, 4, 8])
+    def test_matches_full_attention(self, causal, seq_devices):
+        mesh = build_mesh(MeshSpec(seq=seq_devices))
+        q, k, v = _qkv()
+        want = np.asarray(dot_product_attention(q, k, v, causal=causal))
+        got = _ring_apply(mesh, q, k, v, causal)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+    def test_single_device_degenerates(self):
+        mesh = build_mesh(MeshSpec(seq=1))
+        q, k, v = _qkv(t=16)
+        want = np.asarray(dot_product_attention(q, k, v, causal=True))
+        got = _ring_apply(mesh, q, k, v, True)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+    def test_gradients_match(self):
+        """d(sum(out))/d(q,k,v) through the ring == through full attention."""
+        mesh = build_mesh(MeshSpec(seq=4))
+        q, k, v = _qkv(t=16)
+
+        def full_loss(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+        ring = jax.shard_map(
+            functools.partial(ring_attention, causal=True),
+            mesh=mesh,
+            in_specs=(P(None, AXIS_SEQ),) * 3,
+            out_specs=P(None, AXIS_SEQ),
+        )
+
+        def ring_loss(q, k, v):
+            return jnp.sum(ring(q, k, v) ** 2)
+
+        g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr in zip(g_full, g_ring):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gf), atol=5e-5, rtol=1e-3
+            )
+
+
+class TestSeqParallelLM:
+    @pytest.mark.parametrize("spec", [MeshSpec(seq=4), MeshSpec(seq=2, data=2),
+                                      MeshSpec(seq=2, data=4)])
+    def test_forward_matches_single_chip(self, spec):
+        mesh = build_mesh(spec)
+        params = init_transformer(jax.random.key(0), CFG)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (4, 32)), jnp.int32)
+        want = np.asarray(forward(params, tokens, CFG))
+        fwd = make_seq_parallel_lm_forward(mesh, CFG)
+        got = np.asarray(jax.jit(fwd)(params, tokens))
+        np.testing.assert_allclose(got, want, atol=3e-4, rtol=1e-3)
+
+    def test_indivisible_seq_raises(self):
+        mesh = build_mesh(MeshSpec(seq=4))
+        fwd = make_seq_parallel_lm_forward(mesh, CFG)
+        params = init_transformer(jax.random.key(0), CFG)
+        tokens = jnp.zeros((2, 30), jnp.int32)
+        with pytest.raises(ValueError, match="not divisible"):
+            fwd(params, tokens)
+
+    def test_loss_matches_single_chip(self):
+        mesh = build_mesh(MeshSpec(seq=4, data=2))
+        params = init_transformer(jax.random.key(1), CFG)
+        rng = np.random.default_rng(1)
+        tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (4, 33)), jnp.int32)
+        # Single-chip lm_loss scores tokens[:, :-1] -> targets[:, 1:];
+        # the seq-parallel loss feeds the full (divisible) sequence and
+        # masks internally — compare against the same formulation.
+        T = 32
+        loss_fn = make_seq_parallel_lm_loss(mesh, CFG)
+        got = float(loss_fn(params, tokens[:, : T]))
+
+        logits = forward(params, tokens[:, :T], CFG)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(
+            logp, tokens[:, 1:T, None], axis=-1
+        )[..., 0]
+        want = float(-jnp.mean(ll))
+        assert abs(got - want) < 1e-4
+
+    def test_loss_gradients_flow(self):
+        mesh = build_mesh(MeshSpec(seq=2, data=2))
+        params = init_transformer(jax.random.key(2), CFG)
+        rng = np.random.default_rng(2)
+        tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (4, 32)), jnp.int32)
+        loss_fn = make_seq_parallel_lm_loss(mesh, CFG)
+        grads = jax.jit(jax.grad(loss_fn))(params, tokens)
+        gnorm = sum(float(jnp.sum(g**2)) for g in jax.tree.leaves(grads))
+        assert np.isfinite(gnorm) and gnorm > 0

@@ -1,0 +1,162 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context support the reference entirely lacks (SURVEY.md §5
+"long-context: entirely absent") but BASELINE configs[4] and the build
+brief make first-class. The sequence axis is sharded over mesh axis
+``seq``: each device holds one block of Q and one block of K/V. The
+kernel runs ``N`` steps: attend the local Q block against the resident
+K/V block with numerically-stable *online softmax* accumulation
+(running max / denominator, flash-attention style, f32 accumulators),
+then rotate K/V one hop around the ICI ring with ``lax.ppermute`` —
+compute overlaps naturally with the hand-off under XLA's async
+collectives, total memory is O(T/N) per device, and no device ever
+materializes the full (T, T) score matrix.
+
+Causality uses *global* positions (block start = ring index × block
+length), so block pairs below the diagonal are fully live, the
+diagonal block is triangular, and above-diagonal blocks contribute
+zero mass — all through one uniform masked compute (SPMD: every step
+runs the same program).
+
+The per-device function matches the
+:func:`tpu_dist_nn.models.transformer.dot_product_attention` signature
+(plus the axis name), so transformer blocks swap it in unchanged via
+``block_apply(..., attn_fn=...)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    block_apply,
+    layer_norm,
+)
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_SEQ
+
+
+def ring_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ):
+    """Blockwise ring attention for use under ``shard_map``.
+
+    ``q, k, v: (B, T_local, H, Dh)`` — this device's sequence block.
+    Returns ``(B, T_local, H, Dh)``, exactly
+    ``dot_product_attention`` on the gathered sequence, computed
+    without ever gathering it.
+    """
+    out_dtype = q.dtype
+    B, Tq, H, Dh = q.shape
+    N = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    scale = 1.0 / np.sqrt(Dh)
+    q32 = q.astype(jnp.float32)
+    q_pos = idx * Tq + jnp.arange(Tq)
+
+    ring_perm = [(i, (i + 1) % N) for i in range(N)]
+
+    # Derive the accumulators from q so they inherit its varying-axes
+    # type (shard_map's scan requires carry types stable across steps).
+    zero_bhq = jnp.swapaxes(q32[..., 0], 1, 2) * 0.0  # (B, H, Tq)
+    m0 = zero_bhq - jnp.inf
+    l0 = zero_bhq
+    acc0 = q32 * 0.0  # (B, Tq, H, Dh)
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        # After s forward rotations, this device holds block (idx - s).
+        kv_idx = (idx - s) % N
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        )
+        if causal:
+            k_pos = kv_idx * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+            mask = k_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        block_m = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, block_m)
+        # A fully-masked row keeps new_m = -inf; exponentiate against a
+        # safe stand-in so its probabilities come out exactly 0, not NaN.
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.exp(scores - safe_m[..., None])
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        k_blk = lax.ppermute(k_blk, axis_name, ring_perm)
+        v_blk = lax.ppermute(v_blk, axis_name, ring_perm)
+        return (k_blk, v_blk, new_m, l, acc), None
+
+    (k, v, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(N))
+    # Causal self-attention always has the diagonal live, so l > 0.
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(out_dtype)
+
+
+def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig):
+    """-> ``fn(params, tokens) -> logits`` with the sequence axis sharded.
+
+    Embedding, LayerNorm, and the MLP are position-local, so they run
+    on seq-sharded activations untouched; only attention needs the
+    ring. Positional embeddings are indexed at global positions
+    (ring index × local length + local offset). The batch axis rides
+    the ``data`` mesh axis simultaneously.
+    """
+    seq_devices = mesh.shape[AXIS_SEQ]
+    attn_fn = functools.partial(ring_attention, axis_name=AXIS_SEQ)
+
+    def device_fn(params, tokens):
+        # tokens: (B_local, T_local) — this device's shard.
+        idx = lax.axis_index(AXIS_SEQ)
+        T_loc = tokens.shape[1]
+        pos = idx * T_loc + jnp.arange(T_loc)
+        x = params["tok_embed"][tokens] + params["pos_embed"][pos]
+
+        def body(carry, block):
+            return block_apply(block, carry, cfg, attn_fn=attn_fn), None
+
+        x, _ = lax.scan(body, x, params["blocks"])
+        x = layer_norm(x, params["lnf_g"], params["lnf_b"])
+        return x @ params["tok_embed"].T
+
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_DATA, AXIS_SEQ)),
+        out_specs=P(AXIS_DATA, AXIS_SEQ, None),
+    )
+
+    def forward(params, tokens):
+        T = tokens.shape[1]
+        if T % seq_devices:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis {seq_devices}"
+            )
+        return fn(params, tokens)
+
+    return forward
+
+
+def make_seq_parallel_lm_loss(mesh, cfg: TransformerConfig):
+    """Next-token CE through the sequence-parallel forward.
+
+    The shifted slice ``tokens[:, :-1]`` breaks seq-divisibility, so the
+    loss masks position 0 instead: feed the full sequence, score
+    predictions at positions ``0..T-2`` against targets ``1..T-1``.
+    """
+    fwd = make_seq_parallel_lm_forward(mesh, cfg)
+
+    def loss_fn(params, tokens):
+        logits = fwd(params, tokens)  # (B, T, V)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        targets = tokens[:, 1:]
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    return loss_fn
