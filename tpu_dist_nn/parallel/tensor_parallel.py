@@ -1,0 +1,258 @@
+"""Tensor parallelism over the ``model`` mesh axis.
+
+The reference keeps every layer's full weight matrix in one container
+(``grpc_node.py:51``; SURVEY.md §2.3 "TP: No"); here intra-layer
+parallelism is a first-class mesh axis:
+
+* **Transformer blocks** — the Megatron split: attention heads shard
+  over ``model`` (column-parallel fused QKV, row-parallel output
+  projection + ``psum``), MLP is column-parallel up / row-parallel
+  down + ``psum``. GELU runs on the column-parallel shard (exact —
+  elementwise), LayerNorm and residuals stay replicated. Two ``psum``s
+  per block, both riding ICI.
+* **Dense (FCNN) chains** — column-parallel every layer: each device
+  computes a slice of the layer's output neurons, an ``all_gather``
+  rebuilds the full activation vector (softmax and the next layer need
+  every column). Ragged widths (784-128-64-10) are zero-padded up to a
+  multiple of the axis size and sliced back after the gather.
+
+Shard layouts are materialized host-side by ``tp_shard_*`` helpers into
+leaves with a leading ``(N, ...)`` model-axis dim — the same convention
+the GPipe executor uses for the stage axis — so ``shard_map`` sees one
+uniform program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.core.activations import apply_activation_by_id
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    dot_product_attention,
+    layer_norm,
+)
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+
+# ---------------------------------------------------------------------------
+# Transformer blocks: Megatron split
+# ---------------------------------------------------------------------------
+
+#: Leaves that stay replicated (no leading model-axis dim): LayerNorm
+#: params and the biases added *after* each psum. Keeping them
+#: unsharded lets the vma type system see that block outputs are
+#: invariant over the model axis (psum is variant->invariant).
+TP_REPLICATED = frozenset({"ln1_g", "ln1_b", "ln2_g", "ln2_b", "b_o", "b_down"})
+
+
+def tp_shard_blocks(blocks: dict, cfg: TransformerConfig, n: int) -> dict:
+    """Stacked block leaves ``(L, ...) -> (N, L, ...)`` Megatron layout.
+
+    QKV columns and output-projection rows regroup by attention head;
+    MLP up columns / down rows split contiguously; LN and the psum-side
+    biases stay replicated ``(L, ...)`` (see :data:`TP_REPLICATED`).
+    """
+    L, D, F, H, Dh = (
+        jax.tree.leaves(blocks)[0].shape[0],
+        cfg.d_model,
+        cfg.d_ff,
+        cfg.n_heads,
+        cfg.head_dim,
+    )
+    if H % n:
+        raise ValueError(f"n_heads={H} not divisible by model axis {n}")
+    if F % n:
+        raise ValueError(f"d_ff={F} not divisible by model axis {n}")
+    Hl = H // n
+
+    def shard_qkv(a):  # (L, D, 3D) or (L, 3D)
+        a = a.reshape(*a.shape[:-1], 3, n, Hl * Dh)
+        return jnp.moveaxis(a, -2, 0).reshape(n, *a.shape[:-3], 3 * Hl * Dh)
+
+    return {
+        "ln1_g": blocks["ln1_g"],
+        "ln1_b": blocks["ln1_b"],
+        "w_qkv": shard_qkv(blocks["w_qkv"]),
+        "b_qkv": shard_qkv(blocks["b_qkv"]),
+        "w_o": jnp.moveaxis(
+            blocks["w_o"].reshape(L, n, Hl * Dh, D), 1, 0
+        ),
+        "b_o": blocks["b_o"],
+        "ln2_g": blocks["ln2_g"],
+        "ln2_b": blocks["ln2_b"],
+        "w_up": jnp.moveaxis(blocks["w_up"].reshape(L, D, n, F // n), 2, 0),
+        "b_up": jnp.moveaxis(blocks["b_up"].reshape(L, n, F // n), 1, 0),
+        "w_down": jnp.moveaxis(blocks["w_down"].reshape(L, n, F // n, D), 1, 0),
+        "b_down": blocks["b_down"],
+    }
+
+
+def tp_unshard_blocks(staged: dict, cfg: TransformerConfig) -> dict:
+    """Inverse of :func:`tp_shard_blocks`."""
+    n = staged["w_qkv"].shape[0]
+    L, D, F, Dh = (
+        staged["w_qkv"].shape[1],
+        cfg.d_model,
+        cfg.d_ff,
+        cfg.head_dim,
+    )
+    Hl = cfg.n_heads // n
+
+    def unshard_qkv(a):  # (N, L?, D?, 3*Hl*Dh)
+        a = a.reshape(n, *a.shape[1:-1], 3, Hl * Dh)
+        return jnp.moveaxis(a, 0, -2).reshape(*a.shape[1:-2], 3 * cfg.n_heads * Dh)
+
+    return {
+        "ln1_g": staged["ln1_g"],
+        "ln1_b": staged["ln1_b"],
+        "w_qkv": unshard_qkv(staged["w_qkv"]),
+        "b_qkv": unshard_qkv(staged["b_qkv"]),
+        "w_o": jnp.moveaxis(staged["w_o"], 0, 1).reshape(L, D, D),
+        "b_o": staged["b_o"],
+        "ln2_g": staged["ln2_g"],
+        "ln2_b": staged["ln2_b"],
+        "w_up": jnp.moveaxis(staged["w_up"], 0, 2).reshape(L, D, F),
+        "b_up": jnp.moveaxis(staged["b_up"], 0, 1).reshape(L, F),
+        "w_down": jnp.moveaxis(staged["w_down"], 0, 1).reshape(L, F, D),
+        "b_down": staged["b_down"],
+    }
+
+
+def tp_block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
+                   n_shards: int, attn_fn=dot_product_attention) -> jnp.ndarray:
+    """One Megatron-sharded block on replicated ``x: (B, T, D)``.
+
+    ``block`` holds this device's shard (unstacked). Two psums: after
+    the attention output projection and after the MLP down projection.
+    """
+    B, T, D = x.shape
+    Hl, Dh = cfg.n_heads // n_shards, cfg.head_dim
+
+    h = layer_norm(x, block["ln1_g"], block["ln1_b"])
+    qkv = h @ block["w_qkv"] + block["b_qkv"]  # (B, T, 3*Hl*Dh)
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * Hl, Dh), 3, axis=2)
+    o = attn_fn(q, k, v, causal=cfg.causal).reshape(B, T, Hl * Dh)
+    attn_out = lax.psum(o @ block["w_o"], AXIS_MODEL) + block["b_o"]
+    x = x + attn_out
+
+    h = layer_norm(x, block["ln2_g"], block["ln2_b"])
+    up = jax.nn.gelu(h @ block["w_up"] + block["b_up"])  # (B, T, F/N)
+    down = lax.psum(up @ block["w_down"], AXIS_MODEL) + block["b_down"]
+    return x + down
+
+
+def make_tp_lm_forward(mesh, cfg: TransformerConfig, attn_fn=dot_product_attention):
+    """-> ``fn(params_tp, tokens) -> logits`` with blocks tensor-parallel.
+
+    ``params_tp["blocks"]`` must come from :func:`tp_shard_blocks`;
+    embedding/unembed stay replicated, batch shards over ``data``.
+    """
+    n = mesh.shape[AXIS_MODEL]
+
+    def device_fn(embed_params, blocks_tp, tokens):
+        blocks = {
+            k: (v if k in TP_REPLICATED else v[0]) for k, v in blocks_tp.items()
+        }
+        T = tokens.shape[1]
+        x = embed_params["tok_embed"][tokens] + embed_params["pos_embed"][:T]
+
+        def body(carry, block):
+            return tp_block_apply(block, carry, cfg, n, attn_fn), None
+
+        x, _ = lax.scan(body, x, blocks)
+        x = layer_norm(x, embed_params["lnf_g"], embed_params["lnf_b"])
+        return x @ embed_params["tok_embed"].T
+
+    blocks_specs = {
+        k: (P() if k in TP_REPLICATED else P(AXIS_MODEL))
+        for k in ("ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_o", "b_o",
+                  "ln2_g", "ln2_b", "w_up", "b_up", "w_down", "b_down")
+    }
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), blocks_specs, P(AXIS_DATA)),
+        out_specs=P(AXIS_DATA),
+    )
+
+    def forward(params_tp, tokens):
+        embed_params = {k: v for k, v in params_tp.items() if k != "blocks"}
+        return fn(embed_params, params_tp["blocks"], tokens)
+
+    return forward
+
+
+# ---------------------------------------------------------------------------
+# FCNN chains: padded column parallelism
+# ---------------------------------------------------------------------------
+
+def tp_shard_fcnn(params: list[dict], n: int) -> tuple[list[dict], tuple[int, ...]]:
+    """Column-shard each dense layer: ``w (Din, Dout) -> (N, Din, ⌈Dout/N⌉)``.
+
+    Output widths are zero-padded to a multiple of ``n``. Returns the
+    sharded params plus the static tuple of true output widths (the
+    forward slices the gathered activation back to them).
+    """
+    out, true_dims = [], []
+    for p in params:
+        w, b = np.asarray(p["w"]), np.asarray(p["b"])
+        din, dout = w.shape
+        pad = (-dout) % n
+        wp = np.pad(w, ((0, 0), (0, pad)))
+        bp = np.pad(b, (0, pad))
+        out.append(
+            {
+                "w": jnp.asarray(wp.reshape(din, n, -1).transpose(1, 0, 2)),
+                "b": jnp.asarray(bp.reshape(n, -1)),
+                "act": p["act"],
+            }
+        )
+        true_dims.append(dout)
+    return out, tuple(true_dims)
+
+
+def make_tp_fcnn_forward(mesh, true_dims: tuple[int, ...]):
+    """-> ``fn(params_tp, x) -> y`` column-parallel dense chain.
+
+    Each device computes its slice of every layer's neurons; an
+    place-and-``psum`` rebuilds the full activation (the next layer and
+    softmax need all columns), then the zero-padding is sliced off and
+    the activation applied on the replicated vector — numerically
+    identical to the single-chip chain.
+    """
+    n_shards = mesh.shape[AXIS_MODEL]
+
+    def device_fn(params_tp, x):
+        idx = lax.axis_index(AXIS_MODEL)
+        for p, dout in zip(params_tp, true_dims):
+            z_loc = x @ p["w"][0] + p["b"][0]  # (B, Dout_pad/N)
+            w_loc = z_loc.shape[-1]
+            # Place the local column slice into the padded full width and
+            # psum: variant->invariant, so the replicated activation is
+            # visible to the type system (all_gather would stay varying).
+            z_place = lax.dynamic_update_slice(
+                jnp.zeros((*z_loc.shape[:-1], w_loc * n_shards), z_loc.dtype),
+                z_loc,
+                (0, idx * w_loc),
+            )
+            z = lax.psum(z_place, AXIS_MODEL)
+            x = apply_activation_by_id(z[..., :dout], p["act"])
+        return x
+
+    layer_spec = {"w": P(AXIS_MODEL), "b": P(AXIS_MODEL), "act": P()}
+
+    def forward(params_tp, x):
+        fn = jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=([dict(layer_spec) for _ in params_tp], P(AXIS_DATA)),
+            out_specs=P(AXIS_DATA),
+        )
+        return fn(params_tp, x)
+
+    return forward
