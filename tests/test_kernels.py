@@ -1,0 +1,90 @@
+"""Pallas fused dense kernels: parity with the jnp chain (interpret mode
+on CPU; the same kernels compile to Mosaic on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist_nn.kernels import fcnn_fused_forward, fused_dense
+from tpu_dist_nn.kernels.fused_dense import chain_fits_vmem
+from tpu_dist_nn.models.fcnn import forward, init_fcnn
+
+
+def _xw(m, k, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(m, k)), jnp.float32),
+        jnp.asarray(rng.normal(size=(k, n)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(n,)) * 0.1, jnp.float32),
+    )
+
+
+class TestFusedDense:
+    @pytest.mark.parametrize("activation", ["linear", "relu", "sigmoid",
+                                            "tanh", "gelu", "softmax"])
+    def test_matches_jnp(self, activation):
+        from tpu_dist_nn.core.activations import apply_activation
+
+        x, w, b = _xw(32, 24, 16)
+        want = np.asarray(apply_activation(x @ w + b, activation))
+        got = np.asarray(fused_dense(x, w, b, activation=activation))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_tiled_grid(self):
+        """M and N larger than the block sizes exercise the grid."""
+        x, w, b = _xw(300, 64, 200, seed=1)
+        want = np.asarray(jnp.maximum(x @ w + b, 0))
+        got = np.asarray(
+            fused_dense(x, w, b, activation="relu", block_m=128, block_n=128)
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_shape_mismatch_raises(self):
+        x, w, b = _xw(8, 12, 6)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            fused_dense(x, w, jnp.zeros((7,), jnp.float32))
+
+
+class TestFusedChain:
+    def test_matches_unfused_mnist_shape(self):
+        """The reference's torch model size (784-128-64-10)."""
+        params = init_fcnn(jax.random.key(0), [784, 128, 64, 10])
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.uniform(0, 1, (256, 784)), jnp.float32)
+        want = np.asarray(forward(params, x))
+        got = np.asarray(fcnn_fused_forward(params, x))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+        np.testing.assert_allclose(got.sum(-1), 1.0, atol=1e-5)
+
+    def test_batch_tiling_with_remainder(self):
+        params = init_fcnn(jax.random.key(1), [12, 8, 4])
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(70, 12)), jnp.float32)  # 70 % 32 != 0
+        want = np.asarray(forward(params, x))
+        got = np.asarray(fcnn_fused_forward(params, x, block_b=32))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+
+    def test_vmem_budget_fallback(self):
+        """Oversized chains fall back to the jnp path, same numbers."""
+        params = init_fcnn(jax.random.key(2), [1024, 1024])
+        assert chain_fits_vmem(params)  # 4 MB of weights fits the budget
+        big = init_fcnn(jax.random.key(2), [2048, 2048, 1024])
+        # (2048*2048 + 2048*1024) * 4B ≈ 25 MB > 8 MB budget
+        assert not chain_fits_vmem(big)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(4, 2048)), jnp.float32)
+        want = np.asarray(forward(big, x))
+        got = np.asarray(fcnn_fused_forward(big, x))
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+    def test_explicit_activation_names(self):
+        params = init_fcnn(jax.random.key(3), [10, 8, 6],
+                           activations=["tanh", "sigmoid"])
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(16, 10)), jnp.float32)
+        want = np.asarray(forward(params, x))
+        got = np.asarray(
+            fcnn_fused_forward(params, x, activations=["tanh", "sigmoid"])
+        )
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)

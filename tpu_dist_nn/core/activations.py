@@ -25,6 +25,9 @@ _ACTIVATION_ORDER = ("linear", "relu", "sigmoid", "softmax", "tanh", "gelu")
 
 ACTIVATION_IDS = {name: i for i, name in enumerate(_ACTIVATION_ORDER)}
 
+#: Public id -> name view (index == activation id).
+ACTIVATION_NAMES = _ACTIVATION_ORDER
+
 
 def _linear(x):
     return x

@@ -1,0 +1,16 @@
+"""Pallas TPU kernels: the hand-tuned hot path.
+
+The reference leaned on vendored OpenBLAS for every FLOP
+(``grpc_node.py:87``, SURVEY.md §2.2); the TPU build's equivalent lever
+is Pallas kernels that shape data movement for the MXU/VMEM hierarchy
+where it pays: the fused FCNN chain keeps inter-layer activations in
+VMEM instead of round-tripping HBM between layers (XLA fuses
+elementwise into matmuls but not matmul→matmul chains).
+"""
+
+from tpu_dist_nn.kernels.fused_dense import (
+    fcnn_fused_forward,
+    fused_dense,
+)
+
+__all__ = ["fcnn_fused_forward", "fused_dense"]
