@@ -260,8 +260,14 @@ class Engine:
         train_data: Dataset,
         config: TrainConfig = TrainConfig(),
         eval_data: Dataset | None = None,
+        checkpoints=None,
     ) -> list[dict]:
-        """Train in place (pipelined if placed that way); returns history."""
+        """Train in place (pipelined if placed that way); returns history.
+
+        ``checkpoints`` (a :class:`tpu_dist_nn.checkpoint.CheckpointManager`)
+        turns on epoch-level save + resume for whichever trainer flavor
+        this engine's placement selects.
+        """
         if self.pipelined:
             self._pp, history = train_pipelined(
                 self._pp,
@@ -270,16 +276,19 @@ class Engine:
                 config,
                 num_microbatches=self.num_microbatches,
                 eval_data=eval_data,
+                checkpoints=checkpoints,
             )
             self.model = extract_model(self._pp, self.model, self.distribution)
         elif self._plan is not None:
             self._params, history = train_network(
-                self._plan, self._params, train_data, config, eval_data=eval_data
+                self._plan, self._params, train_data, config,
+                eval_data=eval_data, checkpoints=checkpoints,
             )
             self.model = network_model_from_params(self.model, self._params)
         else:
             self._params, history = train_fcnn(
-                self._params, train_data, config, eval_data=eval_data
+                self._params, train_data, config,
+                eval_data=eval_data, checkpoints=checkpoints,
             )
             trained = [
                 {"weights": np.asarray(p["w"], np.float64),

@@ -1,0 +1,20 @@
+"""Checkpoint / resume subsystem.
+
+The reference has **no** checkpointing in the distributed system: nodes
+reload weights from the JSON config at every start (``grpc_node.py:23-55``)
+and the only persistence is the toolchain's JSON export (notebook cell
+10), which makes *the JSON model file the checkpoint format*
+(SURVEY.md §5).  This package keeps that contract — the JSON schema in
+:mod:`tpu_dist_nn.core.schema` remains the public interchange/checkpoint
+format — and adds the native fast path the reference lacks: training
+state (params + optimizer state + progress counters) saved as a msgpack
+pytree with atomic writes, retention, and epoch-level resume.
+"""
+
+from tpu_dist_nn.checkpoint.store import (
+    CheckpointManager,
+    restore_pytree,
+    save_pytree,
+)
+
+__all__ = ["CheckpointManager", "save_pytree", "restore_pytree"]

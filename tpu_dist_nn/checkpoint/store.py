@@ -1,0 +1,168 @@
+"""Native checkpoint store: msgpack pytrees, atomic writes, retention.
+
+Layout of a checkpoint directory::
+
+    ckpt_00000003.msgpack     one file per step (msgpack-encoded pytree)
+    manifest.json             {"latest_step": 3, "steps": [1, 2, 3]}
+
+Restore is template-based (the idiomatic JAX pattern): the caller
+rebuilds the state skeleton (``init_params`` + ``optimizer.init``) and
+the stored bytes are poured into it, so device placement/sharding of
+the restored leaves follows the template, not the file.
+
+The reference's equivalent is "reload the JSON model at node start"
+(``grpc_node.py:23-55``); JSON import/export stays in
+:mod:`tpu_dist_nn.core.schema` — this module only adds the fast native
+path for *training* state, which the reference never persisted at all
+(its training was centralized and throwaway, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+_MANIFEST = "manifest.json"
+_PREFIX = "ckpt_"
+_SUFFIX = ".msgpack"
+
+
+def _atomic_write_bytes(path: Path, data: bytes) -> None:
+    """Write-then-rename so a crash never leaves a torn checkpoint."""
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=".tmp_ckpt_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def save_pytree(state: Any, path: str | Path) -> None:
+    """Serialize one pytree to a msgpack file (host-side copy included)."""
+    state = jax.device_get(state)
+    _atomic_write_bytes(Path(path), serialization.to_bytes(state))
+
+
+def restore_pytree(template: Any, path: str | Path) -> Any:
+    """Restore a pytree into ``template``'s structure from a msgpack file."""
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints with retention and a JSON manifest.
+
+    ``save`` is atomic per file; the manifest is rewritten after the
+    checkpoint lands, so ``latest_step`` never points at a torn file.
+    ``keep`` bounds disk use by deleting the oldest checkpoints.
+    """
+
+    def __init__(self, directory: str | Path, keep: int = 3):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    def _path(self, step: int) -> Path:
+        return self.directory / f"{_PREFIX}{step:08d}{_SUFFIX}"
+
+    def _manifest_path(self) -> Path:
+        return self.directory / _MANIFEST
+
+    def _read_manifest(self) -> dict:
+        p = self._manifest_path()
+        if not p.exists():
+            return {"latest_step": None, "steps": []}
+        with open(p) as f:
+            return json.load(f)
+
+    def _write_manifest(self, manifest: dict) -> None:
+        _atomic_write_bytes(
+            self._manifest_path(), json.dumps(manifest).encode("utf-8")
+        )
+
+    def steps(self) -> list[int]:
+        return list(self._read_manifest()["steps"])
+
+    def latest_step(self) -> int | None:
+        return self._read_manifest()["latest_step"]
+
+    def save(self, step: int, state: Any, metadata: dict | None = None) -> Path:
+        """Persist ``state`` under ``step``; prunes beyond ``keep``."""
+        step = int(step)
+        path = self._path(step)
+        save_pytree(state, path)
+        manifest = self._read_manifest()
+        steps = sorted(set(manifest["steps"]) | {step})
+        while len(steps) > self.keep:
+            victim = steps.pop(0)
+            vpath = self._path(victim)
+            if vpath.exists():
+                vpath.unlink()
+            manifest.get("metadata", {}).pop(str(victim), None)
+        manifest.update({"latest_step": max(steps), "steps": steps})
+        if metadata:
+            manifest.setdefault("metadata", {})[str(step)] = metadata
+        self._write_manifest(manifest)
+        return path
+
+    def restore(self, template: Any, step: int | None = None) -> tuple[int, Any]:
+        """Restore ``step`` (default: newest intact) into ``template``.
+
+        Returns ``(step, state)``. Raises ``FileNotFoundError`` when the
+        directory holds no checkpoints — callers treat that as "start
+        fresh", the reference's only mode (grpc_node.py:23-55). When the
+        manifest lists steps but every listed file is missing, raises
+        ``RuntimeError`` instead: that is corruption, not a fresh start,
+        and silently retraining would overwrite the evidence.
+        """
+        if step is not None:
+            path = self._path(int(step))
+            if not path.exists():
+                raise FileNotFoundError(
+                    f"no checkpoint for step {step} in {self.directory}"
+                )
+            return int(step), restore_pytree(template, path)
+        steps = self.steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        # Fall back past a torn/lost newest file to the newest intact one.
+        for candidate in sorted(steps, reverse=True):
+            path = self._path(candidate)
+            if path.exists():
+                return int(candidate), restore_pytree(template, path)
+        raise RuntimeError(
+            f"manifest in {self.directory} lists steps {steps} but no "
+            "checkpoint files exist — refusing to restart from scratch"
+        )
+
+    def restore_or_none(self, template: Any) -> tuple[int, Any] | None:
+        try:
+            return self.restore(template)
+        except FileNotFoundError:
+            return None
+
+
+def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
+    """Shared trainer resume step: restore the newest checkpoint into
+    ``state``'s structure, or keep ``state`` as-is when none exists.
+    Returns ``(completed_epochs, state)``."""
+    if checkpoints is None:
+        return 0, state
+    restored = checkpoints.restore_or_none(state)
+    if restored is None:
+        return 0, state
+    return restored

@@ -150,13 +150,18 @@ def cmd_train(args) -> int:
         learning_rate=args.lr, epochs=args.epochs,
         batch_size=args.batch_size, seed=args.seed,
     )
-    history = engine.train(data, cfg, eval_data=eval_data)
+    checkpoints = None
+    if args.checkpoint_dir:
+        from tpu_dist_nn.checkpoint import CheckpointManager
+
+        checkpoints = CheckpointManager(args.checkpoint_dir, keep=args.keep_checkpoints)
+    history = engine.train(data, cfg, eval_data=eval_data, checkpoints=checkpoints)
     for h in history:
         msg = f"epoch {h['epoch']}: loss {h['loss']:.4f} ({h['seconds']:.2f}s)"
         if "eval" in h:
             msg += f" eval_acc {h['eval']['accuracy']:.4f}"
         log.info(msg)
-    metrics = history[-1].get("eval")
+    metrics = history[-1].get("eval") if history else None
     if args.out:
         engine.export(args.out, metrics=metrics)
         log.info("exported trained model to %s", args.out)
@@ -214,6 +219,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", help="export trained model JSON here")
+    p.add_argument("--checkpoint-dir",
+                   help="save per-epoch training state here and resume from it")
+    p.add_argument("--keep-checkpoints", type=int, default=3)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")

@@ -90,16 +90,28 @@ def train_pipelined(
     *,
     num_microbatches: int = 4,
     eval_data: Dataset | None = None,
+    checkpoints=None,
 ):
-    """Train pipelined weights over the mesh; returns (params, history)."""
+    """Train pipelined weights over the mesh; returns (params, history).
+
+    ``checkpoints`` enables epoch-level save/resume of (weights,
+    opt_state) — see :mod:`tpu_dist_nn.checkpoint`. Restored leaves are
+    re-placed onto the mesh by the step function's shardings.
+    """
     weights, meta = params
     data_size = mesh.shape[AXIS_DATA]
     optimizer = optax.adam(config.learning_rate)
     opt_state = optimizer.init(weights)
     step = make_pipeline_train_step(mesh, meta, num_microbatches, optimizer, weights.w.dtype)
 
+    from tpu_dist_nn.checkpoint.store import resume_or_init
+
     history = []
-    for epoch in range(config.epochs):
+    start_epoch, state = resume_or_init(
+        checkpoints, {"weights": weights, "opt_state": opt_state}
+    )
+    weights, opt_state = state["weights"], state["opt_state"]
+    for epoch in range(start_epoch, config.epochs):
         t0 = time.monotonic()
         losses = []
         batches = batch_iterator(
@@ -129,6 +141,12 @@ def train_pipelined(
                 new_params, mesh, eval_data, num_microbatches=num_microbatches
             )
         history.append(record)
+        if checkpoints is not None:
+            checkpoints.save(
+                epoch + 1,
+                {"weights": weights, "opt_state": opt_state},
+                metadata=record,
+            )
     return PipelineParams(weights=weights, meta=meta), history
 
 

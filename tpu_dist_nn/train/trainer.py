@@ -73,16 +73,30 @@ def make_train_step(acts, optimizer):
     return step
 
 
-def run_training_loop(step, params, opt_state, train_data, config, eval_fn=None):
+def run_training_loop(
+    step, params, opt_state, train_data, config, eval_fn=None, checkpoints=None
+):
     """Generic epoch/batch loop shared by every trainer flavor.
 
     ``step(params, opt_state, x, y) -> (params, opt_state, loss)`` must
     be jitted by the caller. History records per-epoch mean loss, wall
     time, and eval metrics — the counters the reference printed per run
     (run_grpc_inference.py:213-216, generate_mnist_pytorch.py:50-52).
+
+    ``checkpoints`` (a :class:`tpu_dist_nn.checkpoint.CheckpointManager`)
+    enables epoch-level save + resume: the latest checkpoint, if any, is
+    restored into the caller's (params, opt_state) template and training
+    continues from the next epoch. The checkpoint step index counts
+    *completed* epochs, so step k resumes at epoch k.
     """
+    from tpu_dist_nn.checkpoint.store import resume_or_init
+
     history = []
-    for epoch in range(config.epochs):
+    start_epoch, state = resume_or_init(
+        checkpoints, {"params": params, "opt_state": opt_state}
+    )
+    params, opt_state = state["params"], state["opt_state"]
+    for epoch in range(start_epoch, config.epochs):
         t0 = time.monotonic()
         losses = []
         batches = batch_iterator(
@@ -106,6 +120,12 @@ def run_training_loop(step, params, opt_state, train_data, config, eval_fn=None)
         if eval_fn is not None:
             record["eval"] = eval_fn(params)
         history.append(record)
+        if checkpoints is not None:
+            checkpoints.save(
+                epoch + 1,
+                {"params": params, "opt_state": opt_state},
+                metadata=record,
+            )
     return params, history
 
 
@@ -114,6 +134,7 @@ def train_fcnn(
     train_data: Dataset,
     config: TrainConfig = TrainConfig(),
     eval_data: Dataset | None = None,
+    checkpoints=None,
 ):
     """Train a dense params pytree; returns (params, history)."""
     wb, acts = _split_params(params)
@@ -123,7 +144,9 @@ def train_fcnn(
     eval_fn = None
     if eval_data is not None:
         eval_fn = lambda wb_: evaluate_fcnn(_join_params(wb_, acts), eval_data)
-    wb, history = run_training_loop(step, wb, opt_state, train_data, config, eval_fn)
+    wb, history = run_training_loop(
+        step, wb, opt_state, train_data, config, eval_fn, checkpoints=checkpoints
+    )
     return _join_params(wb, acts), history
 
 
@@ -170,6 +193,7 @@ def train_network(
     train_data: Dataset,
     config: TrainConfig = TrainConfig(),
     eval_data: Dataset | None = None,
+    checkpoints=None,
 ):
     """Train a mixed-layer network; returns (params, history)."""
     optimizer = optax.adam(config.learning_rate)
@@ -178,7 +202,9 @@ def train_network(
     eval_fn = None
     if eval_data is not None:
         eval_fn = lambda p: evaluate_network(plan, p, eval_data)
-    return run_training_loop(step, params, opt_state, train_data, config, eval_fn)
+    return run_training_loop(
+        step, params, opt_state, train_data, config, eval_fn, checkpoints=checkpoints
+    )
 
 
 def evaluate_network(plan, params, data: Dataset, batch_size: int = 1024) -> dict:
