@@ -157,3 +157,12 @@ def test_metadata_pruned_with_checkpoint(tmp_path):
     manifest = json.loads((tmp_path / "manifest.json").read_text())
     assert "1" not in manifest.get("metadata", {})
     assert manifest["metadata"]["2"]["loss"] == 0.5
+
+
+def test_save_older_than_retention_window_rejected(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=2)
+    mgr.save(5, {"x": np.zeros(1)})
+    mgr.save(6, {"x": np.zeros(1)})
+    with pytest.raises(ValueError, match="retention window"):
+        mgr.save(1, {"x": np.zeros(1)})
+    assert mgr.steps() == [5, 6]

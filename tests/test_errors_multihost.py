@@ -1,0 +1,81 @@
+"""Structured errors, engine health/relaunch, multi-host topology tests
+(SURVEY.md §5: failure detection = fail fast, propagate, clean restart)."""
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.api.engine import Engine
+from tpu_dist_nn.parallel.multihost import (
+    current_topology,
+    initialize_multihost,
+)
+from tpu_dist_nn.testing.factories import random_model
+from tpu_dist_nn.utils.errors import (
+    FrameworkError,
+    InternalError,
+    InvalidArgumentError,
+    UnavailableError,
+    check_input_dim,
+)
+
+
+def test_error_codes_match_reference_status_names():
+    assert InvalidArgumentError.code == "INVALID_ARGUMENT"
+    assert InternalError.code == "INTERNAL"
+    assert UnavailableError.code == "UNAVAILABLE"
+    # Migrating client code can catch stdlib types (grpc_node.py raised
+    # through ValueError-shaped paths).
+    assert issubclass(InvalidArgumentError, ValueError)
+    assert issubclass(InternalError, RuntimeError)
+
+
+def test_check_input_dim_messages():
+    check_input_dim(4, 4)
+    with pytest.raises(InvalidArgumentError, match=r"\[stage 2\] Expected input dimension 4, got 7"):
+        check_input_dim(4, 7, stage=2)
+
+
+def test_engine_dim_mismatch_is_invalid_argument():
+    model = random_model([6, 5, 3], seed=0)
+    engine = Engine.up(model, warmup=False)
+    with pytest.raises(InvalidArgumentError):
+        engine.infer(np.zeros((2, 9)))
+    with pytest.raises(InvalidArgumentError):
+        engine.infer(np.zeros(9))
+
+
+def test_engine_down_then_unavailable_then_relaunch():
+    """down() → UNAVAILABLE; relaunch from the same spec serves again —
+    the reference's clean-teardown/stateless-relaunch contract."""
+    model = random_model([6, 5, 3], seed=0)
+    engine = Engine.up(model, warmup=False)
+    want = engine.infer(np.zeros((1, 6)))
+    engine.down()
+    engine.down()  # idempotent
+    with pytest.raises(UnavailableError):
+        engine.infer(np.zeros((1, 6)))
+    relaunched = Engine.up(model, warmup=False)
+    np.testing.assert_array_equal(relaunched.infer(np.zeros((1, 6))), want)
+
+
+def test_engine_health_probe():
+    model = random_model([6, 5, 3], seed=0)
+    engine = Engine.up(model, warmup=False)
+    status = engine.health()
+    assert status["ready"] and status["probe_ok"]
+    engine.down()
+    assert engine.health()["ready"] is False
+
+
+def test_framework_error_catch_all():
+    with pytest.raises(FrameworkError):
+        raise UnavailableError("nope")
+
+
+def test_single_process_topology_noop():
+    topo = initialize_multihost()
+    assert topo.num_processes == 1
+    assert topo.process_id == 0
+    assert not topo.is_multihost
+    assert topo.local_device_count == topo.global_device_count == 8
+    assert current_topology() == topo

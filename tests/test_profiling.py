@@ -1,0 +1,79 @@
+"""Profiling/tracing subsystem tests (SURVEY.md §5: the reference has
+ad-hoc monotonic timers only; the build adds device traces + percentile
+counters)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist_nn.utils.profiling import (
+    LatencyStats,
+    annotate,
+    capture_trace,
+    host_span,
+    timed,
+)
+
+
+def test_latency_stats_summary():
+    stats = LatencyStats("step")
+    for s in [0.1, 0.2, 0.3, 0.4]:
+        stats.record(s)
+    out = stats.summary()
+    assert out["count"] == 4
+    np.testing.assert_allclose(out["total_s"], 1.0)
+    np.testing.assert_allclose(out["p50_s"], 0.25)
+    np.testing.assert_allclose(out["mean_s"], 0.25)
+    assert out["min_s"] == 0.1 and out["max_s"] == 0.4
+    np.testing.assert_allclose(stats.percentile(50), 0.25)
+
+
+def test_latency_stats_empty_and_timer():
+    stats = LatencyStats("empty")
+    assert stats.summary() == {"name": "empty", "count": 0}
+    with pytest.raises(ValueError):
+        stats.percentile(50)
+    with stats.time():
+        pass
+    assert len(stats) == 1 and stats.samples_s[0] >= 0
+
+
+def test_timed_span():
+    with timed() as t:
+        assert t["seconds"] is None
+    assert t["seconds"] >= 0
+
+
+def test_annotate_inside_jit():
+    """annotate() must be legal inside traced code (named_scope)."""
+
+    @jax.jit
+    def f(x):
+        with annotate("double"):
+            return x * 2
+
+    np.testing.assert_array_equal(np.asarray(f(jnp.arange(4))), [0, 2, 4, 6])
+
+
+def test_host_span_runs():
+    with host_span("client_batch"):
+        pass
+
+
+def test_capture_trace_writes_profile(tmp_path):
+    """A trace capture around a jitted call produces profile artifacts."""
+    with capture_trace(tmp_path):
+        jax.block_until_ready(jax.jit(lambda x: x @ x)(jnp.eye(8)))
+    produced = list(tmp_path.rglob("*"))
+    assert any(p.is_file() for p in produced), "no trace files written"
+
+
+def test_inference_result_latency_summary():
+    from tpu_dist_nn.api.engine import InferenceResult
+
+    r = InferenceResult(np.zeros((4, 2)), 1.0, [0.2, 0.4])
+    s = r.latency_summary()
+    assert s["count"] == 2
+    np.testing.assert_allclose(s["p50_s"], 0.3)

@@ -75,6 +75,13 @@ class InferenceResult:
     def predictions(self) -> np.ndarray:
         return self.outputs.argmax(-1)
 
+    def latency_summary(self) -> dict:
+        """Percentiles over per-batch wall times — the structured form of
+        the per-batch seconds the reference printed and discarded."""
+        from tpu_dist_nn.utils.profiling import LatencyStats
+
+        return LatencyStats("batch_infer", list(self.batch_seconds)).summary()
+
 
 class Engine:
     """A brought-up model: placed, compiled, ready to serve or train."""
@@ -195,8 +202,27 @@ class Engine:
     # ------------------------------------------------------------- infer
 
     def infer(self, x) -> np.ndarray:
-        """Forward a batch → (N, out_dim) probabilities."""
-        x = np.asarray(x, dtype=np.float64).reshape(-1, self.model.input_dim)
+        """Forward a batch → (N, out_dim) probabilities.
+
+        Raises :class:`~tpu_dist_nn.utils.errors.InvalidArgumentError` on
+        a feature-dim mismatch (the reference's per-forward check,
+        grpc_node.py:83-84 → INVALID_ARGUMENT) and
+        :class:`~tpu_dist_nn.utils.errors.UnavailableError` after
+        :meth:`down` (the reference's dead-channel UNAVAILABLE).
+        """
+        from tpu_dist_nn.utils.errors import UnavailableError, check_input_dim
+
+        if self._pp is None and self._params is None:
+            raise UnavailableError(
+                "engine is down; relaunch with Engine.up from the model JSON"
+            )
+        x = np.asarray(x, dtype=np.float64)
+        in_dim = self.model.input_dim
+        if x.ndim >= 2:
+            check_input_dim(in_dim, int(x.shape[-1]), stage=0)
+        elif x.size != in_dim:
+            check_input_dim(in_dim, int(x.size), stage=0)
+        x = x.reshape(-1, in_dim)
         if self.pipelined:
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
@@ -324,6 +350,28 @@ class Engine:
         relaunch contract, run_grpc_fcnn.py:329-344)."""
         self._pp = None
         self._params = None
+
+    # ------------------------------------------------------------ health
+
+    def health(self) -> dict:
+        """Structured readiness report — the reference's TCP readiness
+        poll (run_grpc_fcnn.py:157-172) as an inspectable status."""
+        ready = self._pp is not None or self._params is not None
+        status = {
+            "ready": ready,
+            "devices": self.mesh_spec.num_devices,
+            "pipelined": self.pipelined,
+            "setup_seconds": self.setup_seconds,
+        }
+        if ready:
+            try:
+                probe = np.zeros((1, self.model.input_dim))
+                out = self.infer(probe)
+                status["probe_ok"] = bool(np.isfinite(out).all())
+            except Exception as e:  # a failing probe is the finding, not a crash
+                status["probe_ok"] = False
+                status["probe_error"] = repr(e)
+        return status
 
 
 def load_inputs(path) -> tuple[np.ndarray, np.ndarray]:

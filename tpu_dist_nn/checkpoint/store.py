@@ -101,12 +101,23 @@ class CheckpointManager:
         return self._read_manifest()["latest_step"]
 
     def save(self, step: int, state: Any, metadata: dict | None = None) -> Path:
-        """Persist ``state`` under ``step``; prunes beyond ``keep``."""
+        """Persist ``state`` under ``step``; prunes beyond ``keep``.
+
+        A ``step`` older than the oldest retained step would be pruned by
+        its own save — that is a caller bug, so it is rejected instead.
+        """
         step = int(step)
-        path = self._path(step)
-        save_pytree(state, path)
         manifest = self._read_manifest()
         steps = sorted(set(manifest["steps"]) | {step})
+        if len(steps) > self.keep and step in steps[: len(steps) - self.keep]:
+            raise ValueError(
+                f"step {step} is older than the retention window "
+                f"(keep={self.keep}, existing steps {manifest['steps']})"
+            )
+        path = self._path(step)
+        save_pytree(state, path)
+        if metadata:
+            manifest.setdefault("metadata", {})[str(step)] = metadata
         while len(steps) > self.keep:
             victim = steps.pop(0)
             vpath = self._path(victim)
@@ -114,8 +125,6 @@ class CheckpointManager:
                 vpath.unlink()
             manifest.get("metadata", {}).pop(str(victim), None)
         manifest.update({"latest_step": max(steps), "steps": steps})
-        if metadata:
-            manifest.setdefault("metadata", {})[str(step)] = metadata
         self._write_manifest(manifest)
         return path
 

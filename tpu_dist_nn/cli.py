@@ -82,11 +82,19 @@ def cmd_infer(args) -> int:
         if y[args.input_index] >= 0:
             print(f"Label: {y[args.input_index]}  predicted: {int(out.argmax())}")
         return 0
-    result = engine.run_inference(
-        x, labels=y if (y >= 0).all() else None, batch_size=args.batch_size
-    )
+    labels = y if (y >= 0).all() else None
+    if args.profile_dir:
+        from tpu_dist_nn.utils.profiling import capture_trace
+
+        with capture_trace(args.profile_dir):
+            result = engine.run_inference(x, labels=labels, batch_size=args.batch_size)
+        log.info("device trace written to %s", args.profile_dir)
+    else:
+        result = engine.run_inference(x, labels=labels, batch_size=args.batch_size)
     for i, bs in enumerate(result.batch_seconds):
         log.info("batch %d took %.4f seconds", i, bs)
+    if len(result.batch_seconds) > 1:
+        log.info("batch latency: %s", json.dumps(result.latency_summary()))
     n = len(x)
     if result.metrics:
         correct = int(round(result.metrics["accuracy"] * n))
@@ -202,6 +210,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=None,
                    help="compat no-op (no sockets in the data path)")
     p.add_argument("--timeout", type=float, default=None, help="compat no-op")
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler device trace here")
     p.set_defaults(fn=cmd_infer)
 
     p = sub.add_parser("train", help="native on-TPU training")

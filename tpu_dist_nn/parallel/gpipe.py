@@ -52,8 +52,13 @@ def gpipe_device_fn(
                 xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             x = jnp.where(s_idx == 0, inp, state)
-            y = stage_fn(params, x)
-            nxt = lax.ppermute(y, AXIS_STAGE, fwd_perm) if fwd_perm else y
+            # named_scopes label the per-stage compute and the ICI hop in
+            # device traces (utils.profiling.capture_trace) — the trace-
+            # level analogue of the reference's per-hop RPC timers.
+            with jax.named_scope("gpipe_stage_compute"):
+                y = stage_fn(params, x)
+            with jax.named_scope("gpipe_ppermute_hop"):
+                nxt = lax.ppermute(y, AXIS_STAGE, fwd_perm) if fwd_perm else y
             return nxt, y
 
         _, ys = lax.scan(step, state0, jnp.arange(S + M - 1))

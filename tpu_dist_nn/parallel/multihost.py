@@ -1,0 +1,90 @@
+"""Multi-host (multi-process) initialization over DCN.
+
+The reference scales by spawning more Docker containers on one bridge
+network (``run_grpc_fcnn.py:83-155``); its cross-"host" transport is
+gRPC. The TPU-native equivalent of adding hosts is JAX multi-process:
+each host runs the same SPMD program, ``jax.distributed.initialize``
+wires the processes together, and ``jax.devices()`` becomes the global
+device list — the same ``Mesh``/``shard_map`` code then spans hosts,
+with XLA routing collectives over ICI within a slice and DCN across
+slices. No framework code changes between 1 host and N hosts; mesh axis
+layout (``mesh.py``) keeps DCN-tolerant axes (data) outermost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """One process's view of the multi-host job."""
+
+    process_id: int
+    num_processes: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> HostTopology:
+    """Join (or skip joining) a multi-process JAX job; idempotent.
+
+    With no arguments and no cluster environment this is a no-op
+    single-process topology — the moral equivalent of the reference
+    running all containers on one machine. With arguments (or under a
+    TPU pod environment where JAX auto-detects them), wires this
+    process into the job before any backend use.
+    """
+    explicit = coordinator_address is not None
+    auto_env = any(
+        v in os.environ
+        for v in ("COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID")
+    )
+    # NB: nothing before this point may touch the backend (even
+    # jax.process_count() initializes it, which would make
+    # jax.distributed.initialize fail with "must be called before any
+    # JAX computations" on every multi-host launch).
+    if explicit or auto_env:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:
+            # Second call in the same process (idempotent relaunch, the
+            # reference's sweep-and-respawn contract run_grpc_fcnn.py:64-81).
+            if "already" not in str(e).lower():
+                raise
+    return current_topology()
+
+
+def current_topology() -> HostTopology:
+    return HostTopology(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def assert_same_across_hosts_note() -> str:
+    """The invariant multi-host callers must hold: every process runs the
+    same program with the same mesh spec (single-controller-per-host
+    SPMD). Returned as text so CLIs can print it in --help/errors."""
+    return (
+        "All hosts must execute the same program with identical mesh axes; "
+        "per-host differences belong in data loading (process_id-sharded "
+        "input files), never in model or mesh construction."
+    )

@@ -1,0 +1,36 @@
+"""Cross-cutting utilities: profiling/tracing, structured errors, logging.
+
+The reference's observability is ad-hoc wall-clock timing plus bare
+prints (SURVEY.md §5); this package gives the framework first-class
+equivalents — device-level trace capture, latency percentile counters,
+and structured error types — without changing the client-facing
+counters the reference printed.
+"""
+
+from tpu_dist_nn.utils.errors import (
+    FrameworkError,
+    InternalError,
+    InvalidArgumentError,
+    UnavailableError,
+    check_input_dim,
+)
+from tpu_dist_nn.utils.profiling import (
+    LatencyStats,
+    annotate,
+    capture_trace,
+    host_span,
+    timed,
+)
+
+__all__ = [
+    "FrameworkError",
+    "InternalError",
+    "InvalidArgumentError",
+    "UnavailableError",
+    "check_input_dim",
+    "LatencyStats",
+    "host_span",
+    "annotate",
+    "capture_trace",
+    "timed",
+]

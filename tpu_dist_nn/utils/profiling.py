@@ -1,0 +1,117 @@
+"""Tracing and latency profiling.
+
+The reference measures everything with bare ``time.monotonic()`` spans
+around RPCs (``run_grpc_inference.py:71,89,139-148``) and never records
+the results (SURVEY.md §6). This module keeps those wall-clock counters
+as a first-class object (:class:`LatencyStats` — the source of the
+BASELINE "p50 per-stage pipeline step latency" metric) and adds what the
+reference could not have: XLA device-level traces via ``jax.profiler``
+(:func:`capture_trace`) and named sub-spans inside compiled programs
+(:func:`annotate`), viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass
+class LatencyStats:
+    """Wall-clock samples with percentile summaries.
+
+    The structured replacement for the reference's printed per-batch
+    seconds (``run_grpc_inference.py:195,211,213-215``).
+    """
+
+    name: str = "latency"
+    samples_s: list[float] = dataclasses.field(default_factory=list)
+
+    def record(self, seconds: float) -> None:
+        self.samples_s.append(float(seconds))
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.record(time.monotonic() - t0)
+
+    def __len__(self) -> int:
+        return len(self.samples_s)
+
+    @property
+    def total_s(self) -> float:
+        return float(sum(self.samples_s))
+
+    def percentile(self, q: float) -> float:
+        if not self.samples_s:
+            raise ValueError(f"{self.name}: no samples recorded")
+        return float(np.percentile(np.asarray(self.samples_s), q))
+
+    def summary(self) -> dict:
+        """p50/p90/p99/mean/min/max/total over the recorded spans."""
+        if not self.samples_s:
+            return {"name": self.name, "count": 0}
+        arr = np.asarray(self.samples_s)
+        return {
+            "name": self.name,
+            "count": int(arr.size),
+            "total_s": float(arr.sum()),
+            "mean_s": float(arr.mean()),
+            "min_s": float(arr.min()),
+            "max_s": float(arr.max()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p90_s": float(np.percentile(arr, 90)),
+            "p99_s": float(np.percentile(arr, 99)),
+        }
+
+
+def annotate(name: str):
+    """Named sub-span usable both inside and outside compiled code.
+
+    Inside a traced function this lowers to an XLA ``named_scope`` (the
+    op shows up under ``name`` in a device trace); outside, it doubles
+    as a host-side ``TraceAnnotation`` so client spans (the reference's
+    RPC timers) land in the same profile.
+    """
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def host_span(name: str) -> Iterator[None]:
+    """Host-side annotation for un-traced code (client loops, data feed)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def capture_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profile into ``log_dir`` (TensorBoard format).
+
+    The TPU-native replacement for reading ``docker logs`` latencies: one
+    trace shows per-stage compute, ppermute hops, and host feed gaps.
+    """
+    with jax.profiler.trace(str(log_dir)):
+        yield
+
+
+@contextlib.contextmanager
+def timed() -> Iterator[dict]:
+    """``with timed() as t: ...`` → ``t["seconds"]`` afterwards.
+
+    The reference's ubiquitous ``t0 = time.monotonic(); ...; dt`` idiom
+    (manual_nn.py:90-99) as a reusable span.
+    """
+    box = {"seconds": None}
+    t0 = time.monotonic()
+    try:
+        yield box
+    finally:
+        box["seconds"] = time.monotonic() - t0
