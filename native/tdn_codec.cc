@@ -1,0 +1,535 @@
+// Native codec for the framework's two public JSON schemas.
+//
+// Role: the reference's hot ser/de path ran on vendored native code —
+// protobuf's C++ descriptor fast path (dist_nn_pb2.py:32) plus the
+// per-hop Matrix pack/unpack (grpc_node.py:107,126). This framework has
+// no wire format (stage hand-off is a device copy), so its only ser/de
+// is the host-side JSON contract: model files
+// {"layers":[{"neurons":[{"weights","bias","activation"}]}]} and
+// example files {"examples":[{"input","label"}]}
+// (config/config_sample.json, SURVEY.md C12). Python json.load on a
+// 60k-example file is seconds of pure-Python list work; this parser
+// reads the same schemas directly into packed float64/int32 buffers.
+//
+// Deliberately a *specialized* JSON reader: objects/arrays/numbers/
+// strings/true/false/null, no \uXXXX escapes beyond pass-through (the
+// schema carries no exotic strings). Any layer without a "neurons"
+// array (e.g. conv2d) reports unsupported → the caller falls back to
+// the Python path.
+//
+// C ABI only; bound from Python via ctypes (no pybind11 in the image).
+
+#include <locale.h>
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// strtod is LC_NUMERIC-sensitive (a comma-decimal host locale would
+// mis-parse "0.5"); JSON is locale-independent, so parse under a
+// process-lifetime C locale.
+static locale_t c_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", static_cast<locale_t>(0));
+  return loc;
+}
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit Parser(const char* data, long len) : p(data), end(data + len) {}
+
+  bool fail(const std::string& msg) {
+    if (err.empty()) {
+      long off = static_cast<long>(p - (end - (end - p)));
+      (void)off;
+      err = msg;
+    }
+    return false;
+  }
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+
+  bool expect(char c) {
+    skip_ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return fail(std::string("expected '") + c + "'");
+  }
+
+  bool peek(char c) {
+    skip_ws();
+    return p < end && *p == c;
+  }
+
+  bool parse_string(std::string* out) {
+    skip_ws();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u':
+            // Pass the escape through verbatim; schema strings are
+            // activation names / metadata keys, never \u sequences we
+            // must decode to parse structure.
+            out->push_back('\\');
+            out->push_back('u');
+            break;
+          default: out->push_back(*p); break;
+        }
+        ++p;
+      } else {
+        out->push_back(*p);
+        ++p;
+      }
+    }
+    if (p >= end) return fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+
+  bool parse_number(double* out) {
+    skip_ws();
+    char* num_end = nullptr;
+    *out = strtod_l(p, &num_end, c_locale());
+    if (num_end == p) return fail("expected number");
+    p = num_end;
+    return true;
+  }
+
+  // Strictly 1-D numeric array (neuron weights/bias rows — nesting here
+  // is a malformed model the Python path rejects, not data to flatten).
+  bool parse_numbers_1d(std::vector<double>* out) {
+    if (!expect('[')) return false;
+    if (peek(']')) { ++p; return true; }
+    while (true) {
+      skip_ws();
+      if (p < end && *p == '[')
+        return fail("weights must be a flat array of numbers");
+      double d;
+      if (!parse_number(&d)) return false;
+      out->push_back(d);
+      skip_ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      return expect(']');
+    }
+  }
+
+  // Skip any JSON value (used for keys we don't interpret).
+  bool skip_value() {
+    skip_ws();
+    if (p >= end) return fail("unexpected end of input");
+    char c = *p;
+    if (c == '"') {
+      std::string s;
+      return parse_string(&s);
+    }
+    if (c == '{') {
+      ++p;
+      if (peek('}')) { ++p; return true; }
+      while (true) {
+        std::string key;
+        if (!parse_string(&key)) return false;
+        if (!expect(':')) return false;
+        if (!skip_value()) return false;
+        skip_ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        return expect('}');
+      }
+    }
+    if (c == '[') {
+      ++p;
+      if (peek(']')) { ++p; return true; }
+      while (true) {
+        if (!skip_value()) return false;
+        skip_ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        return expect(']');
+      }
+    }
+    if (c == 't') {
+      if (end - p >= 4 && strncmp(p, "true", 4) == 0) { p += 4; return true; }
+      return fail("bad literal");
+    }
+    if (c == 'f') {
+      if (end - p >= 5 && strncmp(p, "false", 5) == 0) { p += 5; return true; }
+      return fail("bad literal");
+    }
+    if (c == 'n') {
+      if (end - p >= 4 && strncmp(p, "null", 4) == 0) { p += 4; return true; }
+      return fail("bad literal");
+    }
+    double d;
+    return parse_number(&d);
+  }
+
+  // Flatten an arbitrarily nested numeric array into `out`.
+  bool parse_flat_numbers(std::vector<double>* out) {
+    if (!expect('[')) return false;
+    if (peek(']')) { ++p; return true; }
+    while (true) {
+      skip_ws();
+      if (p < end && *p == '[') {
+        if (!parse_flat_numbers(out)) return false;
+      } else {
+        double d;
+        if (!parse_number(&d)) return false;
+        out->push_back(d);
+      }
+      skip_ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      return expect(']');
+    }
+  }
+};
+
+struct LayerData {
+  std::vector<double> weights;  // neuron-major rows: (out_dim, in_dim)
+  std::vector<double> bias;
+  std::string activation;
+  std::string type;
+  long in_dim = 0;
+  long out_dim = 0;
+};
+
+}  // namespace
+
+struct TdnModel {
+  std::vector<LayerData> layers;
+  long layers_start = -1;  // byte span of the "layers" value in the input
+  long layers_end = -1;
+  int unsupported = 0;  // a layer had no "neurons" array → Python fallback
+  std::string err;
+};
+
+static void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+// Parse one {"weights": [...], "bias": x, "activation": "..."} neuron.
+static bool parse_neuron(Parser& ps, std::vector<double>* row, double* bias,
+                         std::string* activation, bool first) {
+  if (!ps.expect('{')) return false;
+  bool saw_weights = false, saw_bias = false;
+  if (ps.peek('}')) { ++ps.p; return ps.fail("neuron object is empty"); }
+  while (true) {
+    std::string key;
+    if (!ps.parse_string(&key)) return false;
+    if (!ps.expect(':')) return false;
+    if (key == "weights") {
+      if (!ps.parse_numbers_1d(row)) return false;
+      saw_weights = true;
+    } else if (key == "bias") {
+      if (!ps.parse_number(bias)) return false;
+      saw_bias = true;
+    } else if (key == "activation" && first) {
+      if (!ps.parse_string(activation)) return false;
+    } else {
+      if (!ps.skip_value()) return false;
+    }
+    ps.skip_ws();
+    if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+    if (!ps.expect('}')) return false;
+    break;
+  }
+  if (!saw_weights) return ps.fail("neuron has no weights");
+  if (!saw_bias) return ps.fail("neuron has no bias");
+  return true;
+}
+
+extern "C" {
+
+// Parse a model JSON. Returns a handle (free with tdn_model_free) or
+// nullptr with `err` set. A handle may still flag `unsupported` (layer
+// without neurons) — caller then uses the Python path.
+TdnModel* tdn_model_parse(const char* json, long len, char* err, int errlen) {
+  Parser ps(json, len);
+  TdnModel* m = new TdnModel();
+  bool saw_layers = false;
+
+  if (!ps.expect('{')) goto bad;
+  if (ps.peek('}')) { set_err(err, errlen, "model has no layers"); delete m; return nullptr; }
+  while (true) {
+    std::string key;
+    if (!ps.parse_string(&key)) goto bad;
+    if (!ps.expect(':')) goto bad;
+    if (key == "layers") {
+      saw_layers = true;
+      ps.skip_ws();
+      m->layers_start = static_cast<long>(ps.p - json);
+      if (!ps.expect('[')) goto bad;
+      if (ps.peek(']')) {
+        set_err(err, errlen, "model has no layers");
+        delete m;
+        return nullptr;
+      }
+      while (true) {
+        // One layer object.
+        if (!ps.expect('{')) goto bad;
+        LayerData layer;
+        bool saw_neurons = false;
+        if (!ps.peek('}')) {
+          while (true) {
+            std::string lkey;
+            if (!ps.parse_string(&lkey)) goto bad;
+            if (!ps.expect(':')) goto bad;
+            if (lkey == "neurons") {
+              saw_neurons = true;
+              if (!ps.expect('[')) goto bad;
+              if (ps.peek(']')) { ++ps.p; ps.fail("layer has no neurons"); goto bad; }
+              bool first = true;
+              while (true) {
+                std::vector<double> row;
+                double bias = 0.0;
+                if (!parse_neuron(ps, &row, &bias, &layer.activation, first))
+                  goto bad;
+                if (first) {
+                  layer.in_dim = static_cast<long>(row.size());
+                  if (layer.activation.empty()) layer.activation = "linear";
+                } else if (static_cast<long>(row.size()) != layer.in_dim) {
+                  ps.fail("neurons in a layer must have equal weight counts");
+                  goto bad;
+                }
+                first = false;
+                layer.weights.insert(layer.weights.end(), row.begin(), row.end());
+                layer.bias.push_back(bias);
+                ps.skip_ws();
+                if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+                if (!ps.expect(']')) goto bad;
+                break;
+              }
+            } else if (lkey == "type") {
+              if (!ps.parse_string(&layer.type)) goto bad;
+            } else {
+              if (!ps.skip_value()) goto bad;
+            }
+            ps.skip_ws();
+            if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+            if (!ps.expect('}')) goto bad;
+            break;
+          }
+        } else {
+          ++ps.p;  // consume '}' of empty layer object
+        }
+        if (!saw_neurons) m->unsupported = 1;
+        layer.out_dim = static_cast<long>(layer.bias.size());
+        if (layer.type.empty()) layer.type = "hidden";
+        m->layers.push_back(std::move(layer));
+        ps.skip_ws();
+        if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+        if (!ps.expect(']')) goto bad;
+        break;
+      }
+      m->layers_end = static_cast<long>(ps.p - json);
+    } else {
+      if (!ps.skip_value()) goto bad;
+    }
+    ps.skip_ws();
+    if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+    if (!ps.expect('}')) goto bad;
+    break;
+  }
+  if (!saw_layers) {
+    set_err(err, errlen, "model has no layers");
+    delete m;
+    return nullptr;
+  }
+  return m;
+
+bad:
+  set_err(err, errlen, ps.err.empty() ? "parse error" : ps.err);
+  delete m;
+  return nullptr;
+}
+
+int tdn_model_unsupported(TdnModel* m) { return m->unsupported; }
+
+int tdn_model_num_layers(TdnModel* m) {
+  return static_cast<int>(m->layers.size());
+}
+
+int tdn_model_layers_span(TdnModel* m, long* start, long* end) {
+  *start = m->layers_start;
+  *end = m->layers_end;
+  return 0;
+}
+
+int tdn_model_layer_dims(TdnModel* m, int i, long* in_dim, long* out_dim) {
+  if (i < 0 || i >= static_cast<int>(m->layers.size())) return 1;
+  *in_dim = m->layers[i].in_dim;
+  *out_dim = m->layers[i].out_dim;
+  return 0;
+}
+
+const char* tdn_model_layer_activation(TdnModel* m, int i) {
+  if (i < 0 || i >= static_cast<int>(m->layers.size())) return "";
+  return m->layers[i].activation.c_str();
+}
+
+const char* tdn_model_layer_type(TdnModel* m, int i) {
+  if (i < 0 || i >= static_cast<int>(m->layers.size())) return "";
+  return m->layers[i].type.c_str();
+}
+
+// Copy layer i's weights (neuron-major (out_dim, in_dim) rows — the
+// schema's per-neuron layout; Python transposes per grpc_node.py:51)
+// and bias into caller-allocated buffers.
+int tdn_model_layer_fill(TdnModel* m, int i, double* w, double* b) {
+  if (i < 0 || i >= static_cast<int>(m->layers.size())) return 1;
+  const LayerData& L = m->layers[i];
+  memcpy(w, L.weights.data(), L.weights.size() * sizeof(double));
+  memcpy(b, L.bias.data(), L.bias.size() * sizeof(double));
+  return 0;
+}
+
+void tdn_model_free(TdnModel* m) { delete m; }
+
+// Parse an examples JSON → packed (n, dim) float64 inputs + int32
+// labels (missing label → -1, load_examples parity). Nested "input"
+// arrays are flattened. Buffers are malloc'd; free with tdn_buffer_free.
+int tdn_parse_examples(const char* json, long len, double** inputs, long* n,
+                       long* dim, int32_t** labels, char* err, int errlen) {
+  Parser ps(json, len);
+  std::vector<double> xs;
+  std::vector<int32_t> ys;
+  long d = -1;
+  long count = 0;
+  bool saw_examples = false;
+
+  if (!ps.expect('{')) goto bad;
+  if (ps.peek('}')) { set_err(err, errlen, "no examples"); return 1; }
+  while (true) {
+    std::string key;
+    if (!ps.parse_string(&key)) goto bad;
+    if (!ps.expect(':')) goto bad;
+    if (key == "examples") {
+      saw_examples = true;
+      if (!ps.expect('[')) goto bad;
+      if (ps.peek(']')) { ++ps.p; }
+      else {
+        while (true) {
+          if (!ps.expect('{')) goto bad;
+          double label = -1;
+          size_t xs_before = xs.size();
+          bool saw_input = false;
+          if (!ps.peek('}')) {
+            while (true) {
+              std::string ekey;
+              if (!ps.parse_string(&ekey)) goto bad;
+              if (!ps.expect(':')) goto bad;
+              if (ekey == "input") {
+                if (!ps.parse_flat_numbers(&xs)) goto bad;
+                saw_input = true;
+              } else if (ekey == "label") {
+                if (!ps.parse_number(&label)) goto bad;
+              } else {
+                if (!ps.skip_value()) goto bad;
+              }
+              ps.skip_ws();
+              if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+              if (!ps.expect('}')) goto bad;
+              break;
+            }
+          } else {
+            ++ps.p;
+          }
+          if (!saw_input) { ps.fail("example has no input"); goto bad; }
+          long this_dim = static_cast<long>(xs.size() - xs_before);
+          if (d < 0) d = this_dim;
+          else if (this_dim != d) {
+            ps.fail("examples have inconsistent input dimensions");
+            goto bad;
+          }
+          ys.push_back(static_cast<int32_t>(label));
+          ++count;
+          ps.skip_ws();
+          if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+          if (!ps.expect(']')) goto bad;
+          break;
+        }
+      }
+    } else {
+      if (!ps.skip_value()) goto bad;
+    }
+    ps.skip_ws();
+    if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+    if (!ps.expect('}')) goto bad;
+    break;
+  }
+  if (!saw_examples) { set_err(err, errlen, "no examples key"); return 1; }
+
+  *n = count;
+  *dim = d < 0 ? 0 : d;
+  *inputs = static_cast<double*>(malloc(xs.size() * sizeof(double)));
+  *labels = static_cast<int32_t*>(malloc(ys.size() * sizeof(int32_t)));
+  if ((xs.size() && !*inputs) || (ys.size() && !*labels)) {
+    free(*inputs);
+    free(*labels);
+    set_err(err, errlen, "out of memory");
+    return 1;
+  }
+  memcpy(*inputs, xs.data(), xs.size() * sizeof(double));
+  memcpy(*labels, ys.data(), ys.size() * sizeof(int32_t));
+  return 0;
+
+bad:
+  set_err(err, errlen, ps.err.empty() ? "parse error" : ps.err);
+  return 1;
+}
+
+// Serialize (n, dim) inputs + labels to the examples JSON. Returns a
+// malloc'd NUL-terminated string via *out (free with tdn_buffer_free)
+// and its length, or -1 on allocation failure.
+long tdn_write_examples(const double* x, const int32_t* labels, long n,
+                        long dim, char** out) {
+  std::string buf;
+  buf.reserve(static_cast<size_t>(n) * (static_cast<size_t>(dim) * 20 + 32) + 16);
+  buf += "{\"examples\": [";
+  char num[32];
+  for (long i = 0; i < n; ++i) {
+    if (i) buf += ", ";
+    buf += "{\"input\": [";
+    for (long j = 0; j < dim; ++j) {
+      if (j) buf += ", ";
+      // %.17g round-trips every float64 exactly (shortest-exact would
+      // need Ryu; json.dumps uses repr which is shortest — outputs
+      // differ textually but re-parse identically).
+      snprintf(num, sizeof(num), "%.17g", x[i * dim + j]);
+      buf += num;
+    }
+    buf += "], \"label\": ";
+    snprintf(num, sizeof(num), "%d", labels[i]);
+    buf += num;
+    buf += "}";
+  }
+  buf += "]}";
+  *out = static_cast<char*>(malloc(buf.size() + 1));
+  if (!*out) return -1;
+  memcpy(*out, buf.data(), buf.size() + 1);
+  return static_cast<long>(buf.size());
+}
+
+void tdn_buffer_free(void* ptr) { free(ptr); }
+
+}  // extern "C"

@@ -1,0 +1,188 @@
+"""Native C++ JSON codec tests: parity with the pure-Python schema path.
+
+The codec plays the protobuf-C++-fast-path role of the reference
+(dist_nn_pb2.py:32): same results as the Python loaders, just faster.
+These tests require the native build (g++ is in the image); the
+fallback path is exercised by flipping TDN_NATIVE in a subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.core.schema import (
+    ModelSpec,
+    load_examples,
+    load_model,
+    save_examples,
+    save_model,
+)
+from tpu_dist_nn.native import (
+    native_available,
+    parse_examples,
+    parse_model_layers,
+    write_examples,
+)
+from tpu_dist_nn.testing.factories import random_model
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native codec unavailable (no g++?)"
+)
+
+
+def _model_json(tmp_path, model):
+    path = tmp_path / "model.json"
+    save_model(model, path)
+    return path
+
+
+def test_model_parse_matches_python(tmp_path):
+    model = random_model([7, 5, 4, 3], seed=1)
+    model.metadata["inference_metrics"] = {"accuracy": 0.97, "f1_score": 0.96}
+    model.metadata["note"] = "layers \"quoted\" text"
+    path = _model_json(tmp_path, model)
+
+    native = load_model(path)  # native path (available per skipif)
+    env = dict(os.environ, TDN_NATIVE="0")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "from tpu_dist_nn.core.schema import load_model;"
+         f"m = load_model({str(path)!r});"
+         "import json, numpy as np;"
+         "print(json.dumps([[l.weights.tolist(), l.biases.tolist(),"
+         " l.activation, l.type_tag] for l in m.layers]));"
+         "print(json.dumps(m.metadata))"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    py_layers = json.loads(out.stdout.splitlines()[0])
+    py_meta = json.loads(out.stdout.splitlines()[1])
+    assert len(native.layers) == len(py_layers)
+    for nat, (w, b, act, tag) in zip(native.layers, py_layers):
+        np.testing.assert_array_equal(nat.weights, np.asarray(w))
+        np.testing.assert_array_equal(nat.biases, np.asarray(b))
+        assert nat.activation == act and nat.type_tag == tag
+    assert native.metadata == py_meta
+
+
+def test_examples_roundtrip_and_parity(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-3, 3, (17, 9))
+    y = rng.integers(0, 5, 17).astype(np.int32)
+    path = tmp_path / "ex.json"
+    save_examples(x, y, path)  # native writer
+    x2, y2 = load_examples(path)  # native reader
+    np.testing.assert_array_equal(x, x2)
+    np.testing.assert_array_equal(y, y2)
+    # The file is plain JSON any consumer can read (public contract).
+    obj = json.loads(path.read_text())
+    assert len(obj["examples"]) == 17
+    np.testing.assert_allclose(obj["examples"][3]["input"], x[3])
+
+
+def test_examples_nested_input_and_missing_label():
+    blob = json.dumps({"examples": [
+        {"input": [[0.5, 1.5], [2.5, 3.5]], "label": 2},
+        {"input": [1, 2, 3, 4]},
+    ]}).encode()
+    x, y = parse_examples(blob)
+    np.testing.assert_array_equal(x, [[0.5, 1.5, 2.5, 3.5], [1, 2, 3, 4]])
+    assert y.tolist() == [2, -1]  # missing label → -1 (load_examples parity)
+
+
+def test_malformed_inputs_raise():
+    with pytest.raises(ValueError, match="inconsistent input dimensions"):
+        parse_examples(b'{"examples": [{"input": [1]}, {"input": [1, 2]}]}')
+    with pytest.raises(ValueError, match="equal weight counts"):
+        parse_model_layers(json.dumps({"layers": [{"neurons": [
+            {"weights": [1.0], "bias": 0.0},
+            {"weights": [1.0, 2.0], "bias": 0.0},
+        ]}]}).encode())
+    with pytest.raises(ValueError, match="no layers"):
+        parse_model_layers(b'{"layers": []}')
+    with pytest.raises(ValueError):
+        parse_examples(b'{"examples": [{"input": [1, 2}]}')
+
+
+def test_conv_model_falls_back_to_python(tmp_path):
+    """Non-dense layers are out of the native codec's scope: it signals
+    fallback and the Python path loads them (scheme: protobuf C++ vs
+    pure-Python descriptor selection)."""
+    obj = {"layers": [
+        {"type": "conv2d", "in_shape": [2, 2, 1], "kernel_size": [1, 1],
+         "stride": [1, 1], "padding": "same", "activation": "relu",
+         "weights": [[[[1.0]]]], "bias": [0.0]},
+    ]}
+    assert parse_model_layers(json.dumps(obj).encode()) is None
+    path = tmp_path / "conv.json"
+    path.write_text(json.dumps(obj))
+    model = load_model(path)  # full loader silently uses the Python path
+    assert model.layers[0].kind == "conv2d"
+
+
+def test_write_examples_float_roundtrip_exact():
+    """%.17g must round-trip float64 bit-exactly through re-parse."""
+    tricky = np.array([[0.1, 1e-308, 1.7976931348623157e308, -0.0,
+                        2.220446049250313e-16, 3.141592653589793]])
+    data = write_examples(tricky, np.array([0], np.int32))
+    x, _ = parse_examples(data)
+    np.testing.assert_array_equal(x, tricky)
+
+
+def test_pure_python_fallback_subprocess(tmp_path):
+    """TDN_NATIVE=0 must serve the same loader API from pure Python."""
+    model = random_model([4, 3, 2], seed=2)
+    path = _model_json(tmp_path, model)
+    ex_path = tmp_path / "ex.json"
+    save_examples(np.ones((2, 4)), np.zeros(2, np.int32), ex_path)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "from tpu_dist_nn.native import native_available;"
+         "assert not native_available();"
+         "from tpu_dist_nn.core.schema import load_model, load_examples;"
+         f"m = load_model({str(path)!r}); x, y = load_examples({str(ex_path)!r});"
+         "print(len(m.layers), x.shape, y.tolist())"],
+        env=dict(os.environ, TDN_NATIVE="0"),
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "2 (2, 4) [0, 0]"
+
+
+def test_non_ascii_metadata_before_layers(tmp_path):
+    """Byte-offset splice must survive multi-byte UTF-8 before layers."""
+    model = random_model([3, 2], seed=4)
+    obj = {"name": "café modèle ✓", **model.to_json_dict()}
+    path = tmp_path / "utf8.json"
+    path.write_text(json.dumps(obj, ensure_ascii=False), encoding="utf-8")
+    loaded = load_model(path)
+    assert loaded.metadata["name"] == "café modèle ✓"
+    np.testing.assert_array_equal(loaded.layers[0].weights, model.layers[0].weights)
+
+
+def test_empty_and_ragged_examples_save(tmp_path):
+    """Empty dataset writes {\"examples\": []}; ragged inputs fall back
+    to the Python per-row path instead of crashing."""
+    p = tmp_path / "empty.json"
+    save_examples(np.zeros((0, 5)), np.zeros((0,), np.int32), p)
+    assert json.loads(p.read_text()) == {"examples": []}
+    x0, y0 = load_examples(p)
+    assert x0.shape[0] == 0 and y0.shape == (0,)
+
+    ragged = [np.ones((2, 3)), np.ones(6)]  # same flat size, different shape
+    p2 = tmp_path / "ragged.json"
+    save_examples(ragged, np.zeros(2, np.int32), p2)
+    x2, _ = load_examples(p2)
+    np.testing.assert_array_equal(x2, np.ones((2, 6)))
+
+
+def test_nested_weights_rejected_native():
+    obj = {"layers": [{"neurons": [
+        {"weights": [[1.0, 2.0], [3.0, 4.0]], "bias": 0.0}]}]}
+    with pytest.raises(ValueError, match="flat array"):
+        parse_model_layers(json.dumps(obj).encode())

@@ -174,8 +174,44 @@ class ModelSpec:
 
 
 def load_model(path: str | Path) -> ModelSpec:
-    with open(path, "r") as f:
-        return ModelSpec.from_json_dict(json.load(f))
+    """Load a model JSON, preferring the native C++ codec.
+
+    The native path (:mod:`tpu_dist_nn.native`) parses the per-neuron
+    weight arrays straight into packed float64 buffers — the role the
+    protobuf C++ fast path played in the reference (dist_nn_pb2.py:32) —
+    and reports the byte span of the ``"layers"`` value so the (small)
+    metadata remainder is parsed host-side without re-walking the
+    weights. Falls back to pure Python when the library is unavailable
+    or the model has non-dense layers.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        from tpu_dist_nn.native import parse_model_layers
+
+        native = parse_model_layers(data)
+    except ImportError:
+        native = None
+    if native is None:
+        return ModelSpec.from_json_dict(json.loads(data))
+    raw_layers, (start, end) = native
+    layers = []
+    for rl in raw_layers:
+        spec = LayerSpec(
+            weights=rl["weights"],
+            biases=rl["biases"],
+            activation=rl["activation"],
+            type_tag=rl["type"],
+        )
+        spec.validate()
+        layers.append(spec)
+    # Splice in *byte* space — the native spans are byte offsets, and a
+    # non-ASCII char before "layers" would shift code-point indices.
+    meta_obj = json.loads(data[:start] + b"null" + data[end:])
+    meta_obj.pop("layers", None)
+    model = ModelSpec(layers=layers, metadata=meta_obj)
+    model.validate_chain()
+    return model
 
 
 def save_model(model: ModelSpec, path: str | Path) -> None:
@@ -376,8 +412,17 @@ def load_examples(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
     flat 784-vectors; the sample file nests rows, which the reference
     would have mis-sized — we flatten instead).
     """
-    with open(path, "r") as f:
-        obj = json.load(f)
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        from tpu_dist_nn.native import parse_examples
+
+        native = parse_examples(data)
+    except ImportError:
+        native = None
+    if native is not None:
+        return native
+    obj = json.loads(data)
     examples = obj["examples"]
     inputs = np.asarray(
         [np.asarray(e["input"], dtype=np.float64).reshape(-1) for e in examples]
@@ -387,6 +432,16 @@ def load_examples(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
 
 
 def save_examples(inputs: np.ndarray, labels: np.ndarray, path: str | Path) -> None:
+    try:
+        from tpu_dist_nn.native import write_examples
+
+        data = write_examples(inputs, labels)
+    except ImportError:
+        data = None
+    if data is not None:
+        with open(path, "wb") as f:
+            f.write(data)
+        return
     examples = [
         {"input": np.asarray(x).reshape(-1).tolist(), "label": int(y)}
         for x, y in zip(inputs, labels)

@@ -1,0 +1,25 @@
+"""Native (C++) runtime components and their ctypes bindings.
+
+The reference's performance-critical ser/de ran on vendored native code
+(protobuf C++ descriptors, ``dist_nn_pb2.py:32``); this package plays
+the same role for the framework's host-side IO: a specialized C++ codec
+for the public JSON schemas, built on demand with ``g++`` and bound via
+ctypes (the image has no pybind11). Everything here is optional — every
+entry point falls back to the pure-Python implementation when no
+compiler or prebuilt library is available, exactly like protobuf's
+pure-Python descriptor fallback.
+"""
+
+from tpu_dist_nn.native.codec import (
+    native_available,
+    parse_examples,
+    parse_model_layers,
+    write_examples,
+)
+
+__all__ = [
+    "native_available",
+    "parse_examples",
+    "parse_model_layers",
+    "write_examples",
+]

@@ -1,0 +1,133 @@
+"""High-level Python API over the native JSON codec.
+
+Returns exactly what the pure-Python schema loaders produce, so
+:mod:`tpu_dist_nn.core.schema` can switch between paths transparently:
+
+* :func:`parse_examples` ↔ ``schema.load_examples`` internals
+  (``run_grpc_inference.py:35-52``'s wholesale load, but into packed
+  buffers instead of Python lists).
+* :func:`parse_model_layers` ↔ the per-neuron materialization of
+  ``LayerSpec.from_neurons`` (row stack + transpose, grpc_node.py:51),
+  plus the byte span of the ``"layers"`` value so metadata can be
+  re-parsed host-side without re-walking the neuron arrays.
+* :func:`write_examples` ↔ ``schema.save_examples``.
+
+All return ``None`` when the native library is unavailable; callers
+fall back to pure Python (protobuf-style descriptor fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from tpu_dist_nn.native.loader import get_library
+
+_ERRLEN = 256
+
+
+def native_available() -> bool:
+    return get_library() is not None
+
+
+def parse_examples(data: bytes):
+    """``examples JSON bytes -> (inputs (n,dim) f64, labels (n,) i32)``
+    or None when native is unavailable. Raises ValueError on bad JSON."""
+    lib = get_library()
+    if lib is None:
+        return None
+    err = ctypes.create_string_buffer(_ERRLEN)
+    inputs_p = ctypes.POINTER(ctypes.c_double)()
+    labels_p = ctypes.POINTER(ctypes.c_int32)()
+    n = ctypes.c_long()
+    dim = ctypes.c_long()
+    rc = lib.tdn_parse_examples(
+        data, len(data),
+        ctypes.byref(inputs_p), ctypes.byref(n), ctypes.byref(dim),
+        ctypes.byref(labels_p), err, _ERRLEN,
+    )
+    if rc != 0:
+        raise ValueError(f"examples parse failed: {err.value.decode()}")
+    try:
+        count, d = n.value, dim.value
+        x = np.ctypeslib.as_array(inputs_p, shape=(count, d)).copy() if count else np.zeros((0, d))
+        y = np.ctypeslib.as_array(labels_p, shape=(count,)).copy() if count else np.zeros((0,), np.int32)
+    finally:
+        lib.tdn_buffer_free(inputs_p)
+        lib.tdn_buffer_free(labels_p)
+    return x, y.astype(np.int32)
+
+
+def parse_model_layers(data: bytes):
+    """``model JSON bytes -> (layers, (span_start, span_end))`` or None.
+
+    ``layers`` is a list of ``{"weights": (in,out) f64, "biases": (out,)
+    f64, "activation": str, "type": str}`` — weights already transposed
+    per grpc_node.py:51. Returns None (fallback) when the native library
+    is missing OR the model contains non-dense layers (conv2d etc.).
+    Raises ValueError on malformed JSON (message parity with schema).
+    """
+    lib = get_library()
+    if lib is None:
+        return None
+    err = ctypes.create_string_buffer(_ERRLEN)
+    handle = lib.tdn_model_parse(data, len(data), err, _ERRLEN)
+    if not handle:
+        raise ValueError(err.value.decode() or "model parse failed")
+    try:
+        if lib.tdn_model_unsupported(handle):
+            return None  # conv/pool layers → Python path handles them
+        num = lib.tdn_model_num_layers(handle)
+        layers = []
+        for i in range(num):
+            in_dim = ctypes.c_long()
+            out_dim = ctypes.c_long()
+            lib.tdn_model_layer_dims(handle, i, ctypes.byref(in_dim), ctypes.byref(out_dim))
+            rows = np.empty((out_dim.value, in_dim.value), dtype=np.float64)
+            bias = np.empty((out_dim.value,), dtype=np.float64)
+            lib.tdn_model_layer_fill(
+                handle, i,
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                bias.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            )
+            layers.append({
+                "weights": rows.T.copy(),  # (in_dim, out_dim), grpc_node.py:51
+                "biases": bias,
+                "activation": lib.tdn_model_layer_activation(handle, i).decode(),
+                "type": lib.tdn_model_layer_type(handle, i).decode(),
+            })
+        start = ctypes.c_long()
+        end = ctypes.c_long()
+        lib.tdn_model_layers_span(handle, ctypes.byref(start), ctypes.byref(end))
+        return layers, (start.value, end.value)
+    finally:
+        lib.tdn_model_free(handle)
+
+
+def write_examples(inputs: np.ndarray, labels: np.ndarray):
+    """``(inputs, labels) -> examples JSON bytes`` or None (fallback)."""
+    lib = get_library()
+    if lib is None:
+        return None
+    if len(inputs) == 0:
+        return b'{"examples": []}'
+    try:
+        x = np.ascontiguousarray(
+            np.asarray(inputs, dtype=np.float64).reshape(len(inputs), -1)
+        )
+    except ValueError:
+        return None  # ragged rows → the Python path's per-row reshape
+    y = np.ascontiguousarray(np.asarray(labels, dtype=np.int32))
+    out = ctypes.c_char_p()
+    n = lib.tdn_write_examples(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(x), x.shape[1], ctypes.byref(out),
+    )
+    if n < 0:
+        raise MemoryError("native examples serialization failed")
+    try:
+        return ctypes.string_at(out, n)
+    finally:
+        lib.tdn_buffer_free(out)
