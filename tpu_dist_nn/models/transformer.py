@@ -118,6 +118,23 @@ def dot_product_attention(q, k, v, *, causal: bool):
     return jnp.einsum("...hqk,...khd->...qhd", probs, v)
 
 
+def attn_sublayer(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
+                  attn_fn=dot_product_attention) -> jnp.ndarray:
+    """Pre-LN attention sublayer with residual: ``(B, T, D) -> (B, T, D)``.
+
+    Shared by the dense block and the MoE block
+    (:mod:`tpu_dist_nn.parallel.expert_parallel`), which differ only in
+    their FFN sublayer.
+    """
+    B, T, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    h = layer_norm(x, block["ln1_g"], block["ln1_b"])
+    qkv = h @ block["w_qkv"] + block["b_qkv"]
+    q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, Dh), 3, axis=2)
+    o = attn_fn(q, k, v, causal=cfg.causal).reshape(B, T, D)
+    return x + o @ block["w_o"] + block["b_o"]
+
+
 def block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
                 attn_fn=dot_product_attention) -> jnp.ndarray:
     """One pre-LN residual block: ``x: (batch, T, D) -> (batch, T, D)``.
@@ -125,15 +142,7 @@ def block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
     ``block`` holds *unstacked* leaves (no leading layer axis) — a scan
     carry slice single-chip, or one stage's shard in the pipeline.
     """
-    B, T, D = x.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
-
-    h = layer_norm(x, block["ln1_g"], block["ln1_b"])
-    qkv = h @ block["w_qkv"] + block["b_qkv"]
-    q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, Dh), 3, axis=2)
-    o = attn_fn(q, k, v, causal=cfg.causal).reshape(B, T, D)
-    x = x + o @ block["w_o"] + block["b_o"]
-
+    x = attn_sublayer(block, x, cfg, attn_fn)
     h = layer_norm(x, block["ln2_g"], block["ln2_b"])
     h = jax.nn.gelu(h @ block["w_up"] + block["b_up"])
     return x + h @ block["w_down"] + block["b_down"]
@@ -167,14 +176,20 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
     return unembed(params, x)
 
 
+def next_token_ce(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean cross-entropy (nats/token): ``logits (..., T, V)``,
+    ``targets (..., T) int``. The single definition of the LM loss
+    numerics, shared by the dense, MoE, and sharded loss paths."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
 def lm_loss(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
             attn_fn=dot_product_attention) -> jnp.ndarray:
     """Next-token cross-entropy (mean nats/token) on ``(batch, T)`` tokens."""
     logits = forward(params, tokens[:, :-1], cfg, attn_fn)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return next_token_ce(logits, tokens[:, 1:])
 
 
 def num_params(params) -> int:

@@ -1,0 +1,340 @@
+"""Expert parallelism (MoE) over the ``expert`` mesh axis.
+
+The reference has no MoE anywhere (SURVEY.md §2.3 "EP: No — out of
+scope" for the reference itself); this module exists because the TPU
+build treats every parallelism axis as first-class. Design follows the
+Switch-Transformer/GShard recipe, TPU-first:
+
+* **Top-1 routing with static capacity.** Each token picks its
+  highest-probability expert; each expert accepts at most
+  ``C = ceil(capacity_factor * tokens_per_group / n_experts)`` tokens.
+  Everything is one-hot einsum math — no gather/scatter with dynamic
+  shapes, so XLA sees static shapes and keeps the dispatch on the MXU.
+* **Grouped routing.** Tokens route within fixed-size groups (one group
+  per device shard), so the sharded program and the single-chip oracle
+  run the *same* math: the oracle is the EP path with group count = EP
+  degree and no ``all_to_all``. Parity is exact, not approximate.
+* **``all_to_all`` dispatch over ICI.** Under ``shard_map`` the
+  ``(n_experts, capacity, d_model)`` dispatch buffer is exchanged with
+  ``lax.all_to_all`` over the ``expert`` axis — the TPU analogue of the
+  reference's gRPC hop, but a single fused ICI collective instead of
+  per-hop ser/de (SURVEY.md §2.4).
+* **The ``expert`` axis doubles as a data axis** outside the MoE
+  layers: attention and LayerNorm see the batch sharded over
+  ``(data, expert)`` jointly, so no compute is replicated.
+
+Aux load-balancing loss is the Switch loss ``E * Σ_e f_e·p_e``
+(fraction-dispatched × mean router probability), averaged over blocks
+and groups.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    attn_sublayer,
+    dot_product_attention,
+    layer_norm,
+    next_token_ce,
+)
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_EXPERT
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    """Transformer config plus MoE routing knobs (hashable, static)."""
+
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 1e-2
+
+    def capacity(self, tokens_per_group: int) -> int:
+        return max(
+            1,
+            int(np.ceil(self.capacity_factor * tokens_per_group / self.n_experts)),
+        )
+
+
+def init_moe_transformer(key: jax.Array, cfg: MoEConfig, dtype=jnp.float32):
+    """Params pytree like ``init_transformer`` but each block's MLP is a
+    bank of ``n_experts`` FFNs plus a router.
+
+    Block leaves keep the stacked leading ``(n_layers, ...)`` axis;
+    expert leaves add an expert axis after it: ``(L, E, D, F)`` etc.
+    """
+    from tpu_dist_nn.models.transformer import init_transformer
+
+    base = init_transformer(key, cfg, dtype)
+    L, D, F, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
+    k_router, k_up, k_down = jax.random.split(jax.random.fold_in(key, 7), 3)
+    s = 1.0 / np.sqrt(D)
+    blocks = dict(base["blocks"])
+    del blocks["w_up"], blocks["b_up"], blocks["w_down"], blocks["b_down"]
+    blocks["w_router"] = (
+        jax.random.normal(k_router, (L, D, E), jnp.float32) * s
+    ).astype(dtype)
+    blocks["w_up"] = (
+        jax.random.normal(k_up, (L, E, D, F), jnp.float32) * s
+    ).astype(dtype)
+    blocks["b_up"] = jnp.zeros((L, E, F), dtype)
+    blocks["w_down"] = (
+        jax.random.normal(k_down, (L, E, F, D), jnp.float32)
+        * (1.0 / np.sqrt(F))
+        / np.sqrt(2 * L)
+    ).astype(dtype)
+    blocks["b_down"] = jnp.zeros((L, E, D), dtype)
+    return dict(base, blocks=blocks)
+
+
+def route_top1(x_flat: jnp.ndarray, w_router: jnp.ndarray, capacity: int):
+    """Top-1 routing for one token group.
+
+    ``x_flat: (S, D)`` -> ``(dispatch (S, E, C) {0,1}, combine (S, E, C)
+    gate-weighted, aux_loss scalar)``. Tokens beyond an expert's
+    capacity are dropped (their combine weights are zero, so the
+    residual stream carries them through unchanged — same semantics as
+    Switch).
+    """
+    E = w_router.shape[-1]
+    logits = (x_flat @ w_router).astype(jnp.float32)  # (S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (S,)
+    gate = jnp.max(probs, axis=-1)  # (S,)
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (S, E)
+
+    # Position of each token within its expert's buffer; drop overflow.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (S, E), -1 where unrouted
+    kept = onehot * (pos < capacity)
+    pos_idx = jnp.sum(pos * kept, axis=-1).astype(jnp.int32)  # (S,)
+    dispatch = kept[:, :, None] * jax.nn.one_hot(
+        pos_idx, capacity, dtype=jnp.float32
+    )[:, None, :]  # (S, E, C)
+    combine = dispatch * gate[:, None, None]
+
+    # Switch load-balancing loss: E * Σ_e fraction_routed_e · mean_prob_e.
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(w_up, b_up, w_down, b_down, buf):
+    """Apply an expert bank: ``buf (E, C, D) -> (E, C, D)``."""
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", buf, w_up) + b_up[:, None, :]
+    )
+    return jnp.einsum("ecf,efd->ecd", h, w_down) + b_down[:, None, :]
+
+
+def moe_ffn_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
+                  n_groups: int = 1):
+    """Single-chip MoE FFN oracle: ``x (B, T, D) -> (y, aux_loss)``.
+
+    Routes within ``n_groups`` fixed token groups — with ``n_groups``
+    equal to the EP degree this computes exactly what the sharded path
+    computes, making it the parity oracle for
+    :func:`make_ep_lm_forward`.
+    """
+    B, T, D = x.shape
+    S = B * T
+    if S % n_groups:
+        raise ValueError(f"{S} tokens not divisible into {n_groups} groups")
+    cap = cfg.capacity(S // n_groups)
+    xg = x.reshape(n_groups, S // n_groups, D)
+
+    def per_group(xf):
+        dispatch, combine, aux = route_top1(xf, block["w_router"], cap)
+        buf = jnp.einsum("sec,sd->ecd", dispatch, xf.astype(jnp.float32))
+        out = _expert_ffn(
+            block["w_up"], block["b_up"], block["w_down"], block["b_down"],
+            buf.astype(x.dtype),
+        )
+        y = jnp.einsum("sec,ecd->sd", combine, out.astype(jnp.float32))
+        return y.astype(x.dtype), aux
+
+    ys, auxs = jax.vmap(per_group)(xg)
+    return ys.reshape(B, T, D), jnp.mean(auxs)
+
+
+def moe_block_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
+                    n_groups: int = 1, attn_fn=dot_product_attention,
+                    ffn_fn=None):
+    """One pre-LN residual MoE block (attention + routed FFN).
+
+    Mirrors ``transformer.block_apply`` with the dense MLP swapped for
+    the expert bank. Returns ``(x, aux_loss)``.
+    """
+    x = attn_sublayer(block, x, cfg, attn_fn)
+    h = layer_norm(x, block["ln2_g"], block["ln2_b"])
+    if ffn_fn is None:
+        y, aux = moe_ffn_apply(block, h, cfg, n_groups)
+    else:
+        y, aux = ffn_fn(block, h)
+    return x + y, aux
+
+
+def moe_forward(params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
+                n_groups: int = 1, attn_fn=dot_product_attention,
+                ffn_fn=None):
+    """Full MoE-LM forward: ``(B, T) tokens -> ((B, T, V) logits, aux)``.
+
+    Block stack is a ``lax.scan`` over the stacked layer axis, aux
+    losses averaged over layers.
+    """
+    from tpu_dist_nn.models.transformer import embed, unembed
+
+    x = embed(params, tokens)
+
+    def body(carry, block):
+        y, aux = moe_block_apply(block, carry, cfg, n_groups, attn_fn, ffn_fn)
+        return y, aux
+
+    x, auxs = lax.scan(body, x, params["blocks"])
+    return unembed(params, x), jnp.mean(auxs)
+
+
+def moe_lm_loss(params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
+                n_groups: int = 1, attn_fn=dot_product_attention,
+                ffn_fn=None):
+    """Next-token CE + weighted router aux loss (mean nats/token)."""
+    logits, aux = moe_forward(
+        params, tokens[:, :-1], cfg, n_groups, attn_fn, ffn_fn
+    )
+    return next_token_ce(logits, tokens[:, 1:]) + cfg.router_aux_weight * aux
+
+
+# ---------------------------------------------------------------------------
+# Sharding over the expert axis
+# ---------------------------------------------------------------------------
+
+#: Block leaves sharded over the expert axis (leading dim = n_experts,
+#: regrouped to (n_ep, L, E/n_ep, ...)). Everything else is replicated
+#: over ``expert`` — attention runs data-parallel on that axis.
+EP_SHARDED = frozenset({"w_up", "b_up", "w_down", "b_down"})
+
+
+def ep_shard_blocks(blocks: dict, n_ep: int) -> dict:
+    """Expert leaves ``(L, E, ...) -> (n_ep, L, E/n_ep, ...)``."""
+    E = blocks["w_up"].shape[1]
+    if E % n_ep:
+        raise ValueError(f"n_experts={E} not divisible by expert axis {n_ep}")
+    out = {}
+    for k, v in blocks.items():
+        if k in EP_SHARDED:
+            out[k] = jnp.moveaxis(
+                v.reshape(v.shape[0], n_ep, E // n_ep, *v.shape[2:]), 1, 0
+            )
+        else:
+            out[k] = v
+    return out
+
+
+def ep_unshard_blocks(staged: dict) -> dict:
+    """Inverse of :func:`ep_shard_blocks`."""
+    out = {}
+    for k, v in staged.items():
+        if k in EP_SHARDED:
+            moved = jnp.moveaxis(v, 0, 1)  # (L, n_ep, E/n_ep, ...)
+            out[k] = moved.reshape(
+                moved.shape[0], moved.shape[1] * moved.shape[2], *moved.shape[3:]
+            )
+        else:
+            out[k] = v
+    return out
+
+
+def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
+                       with_loss: bool = False):
+    """-> ``fn(params_ep, tokens)`` with experts sharded over ``expert``.
+
+    ``params_ep["blocks"]`` must come from :func:`ep_shard_blocks`.
+    Batch shards over ``(data, expert)`` jointly; inside each MoE layer
+    the dispatch buffer rides ``lax.all_to_all`` over the ``expert``
+    axis so each device computes only its local experts. Returns logits
+    (or, with ``with_loss``, the scalar CE+aux loss) — numerically
+    identical to the grouped single-chip oracle with
+    ``n_groups = mesh.shape['data'] * mesh.shape['expert']`` (one
+    routing group per device shard).
+    """
+    n_ep = mesh.shape[AXIS_EXPERT]
+    E = cfg.n_experts
+    if E % n_ep:
+        raise ValueError(f"n_experts={E} not divisible by expert axis {n_ep}")
+
+    def ep_ffn(block, h):
+        """Sharded routed FFN on this device's token shard ``h (b, T, D)``."""
+        b, T, D = h.shape
+        S = b * T
+        cap = cfg.capacity(S)
+        hf = h.reshape(S, D)
+        dispatch, combine, aux = route_top1(hf, block["w_router"], cap)
+        buf = jnp.einsum("sec,sd->ecd", dispatch, hf.astype(jnp.float32))
+        buf = buf.astype(h.dtype)  # (E, C, D)
+        # Exchange: each device keeps its E/n_ep local experts and
+        # receives every other shard's tokens for them: (E, C, D) ->
+        # (E/n_ep, n_ep*C, D). One fused ICI collective — the entire
+        # "wire layer" of the reference (SURVEY.md §2.4) in one op.
+        buf = lax.all_to_all(
+            buf, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
+        )
+        out = _expert_ffn(
+            block["w_up"], block["b_up"], block["w_down"], block["b_down"], buf,
+        )
+        out = lax.all_to_all(
+            out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
+        )  # back to (E, C, D), rows for this shard's tokens
+        y = jnp.einsum("sec,ecd->sd", combine, out.astype(jnp.float32))
+        return y.astype(h.dtype).reshape(b, T, D), aux
+
+    def device_fn(embed_params, blocks_ep, tokens):
+        from tpu_dist_nn.models.transformer import embed, unembed
+
+        # shard_map hands sharded leaves with a leading local-shard dim
+        # of size 1; strip it so every leaf leads with the layer axis.
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in blocks_ep.items()
+        }
+        inputs = tokens[:, :-1] if with_loss else tokens
+        x = embed(embed_params, inputs)
+
+        def body(carry, block):
+            y, aux = moe_block_apply(
+                block, carry, cfg, attn_fn=attn_fn, ffn_fn=ep_ffn
+            )
+            return y, aux
+
+        x, auxs = lax.scan(body, x, blocks)
+        logits = unembed(embed_params, x)
+        if not with_loss:
+            return logits
+        ce = next_token_ce(logits, tokens[:, 1:])
+        ce = lax.pmean(lax.pmean(ce, AXIS_DATA), AXIS_EXPERT)
+        aux = lax.pmean(lax.pmean(jnp.mean(auxs), AXIS_DATA), AXIS_EXPERT)
+        return ce + cfg.router_aux_weight * aux
+
+    blocks_specs = {
+        k: (P(AXIS_EXPERT) if k in EP_SHARDED else P())
+        for k in ("ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_o", "b_o",
+                  "ln2_g", "ln2_b", "w_router",
+                  "w_up", "b_up", "w_down", "b_down")
+    }
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), blocks_specs, P((AXIS_DATA, AXIS_EXPERT))),
+        out_specs=P() if with_loss else P((AXIS_DATA, AXIS_EXPERT)),
+    )
+
+    def forward(params_ep, tokens):
+        embed_params = {k: v for k, v in params_ep.items() if k != "blocks"}
+        return fn(embed_params, params_ep["blocks"], tokens)
+
+    return forward

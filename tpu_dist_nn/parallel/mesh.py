@@ -9,7 +9,10 @@ is a ``jax.sharding.Mesh`` whose axes name the parallelism degrees:
   ``run_grpc_inference.py:197-211``, promoted to true data parallelism),
 * ``model`` — tensor parallelism (intra-layer, reserved),
 * ``seq``   — sequence/context parallelism (reserved for the
-  transformer configs; ring attention rides this axis).
+  transformer configs; ring attention rides this axis),
+* ``expert`` — expert parallelism (MoE layers; ``all_to_all`` token
+  dispatch rides this axis, which doubles as a data axis outside the
+  expert layers).
 
 Multi-chip topology note: the stage axis should map to an ICI ring so
 ``ppermute`` hand-offs ride inter-chip links, which
@@ -29,6 +32,7 @@ AXIS_STAGE = "stage"
 AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,20 +43,21 @@ class MeshSpec:
     data: int = 1
     model: int = 1
     seq: int = 1
+    expert: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.stage * self.data * self.model * self.seq
+        return self.stage * self.data * self.model * self.seq * self.expert
 
     def axis_names(self) -> tuple[str, ...]:
-        return (AXIS_DATA, AXIS_SEQ, AXIS_STAGE, AXIS_MODEL)
+        return (AXIS_DATA, AXIS_SEQ, AXIS_STAGE, AXIS_MODEL, AXIS_EXPERT)
 
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.data, self.seq, self.stage, self.model)
+        return (self.data, self.seq, self.stage, self.model, self.expert)
 
 
 def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
-    """Build a mesh with axes ``(data, seq, stage, model)``.
+    """Build a mesh with axes ``(data, seq, stage, model, expert)``.
 
     Axis order puts ``stage`` and ``model`` innermost so that pipeline
     and tensor hand-offs map to nearest-neighbor ICI links, with data
@@ -64,7 +69,8 @@ def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
         raise ValueError(
             f"mesh spec needs {spec.num_devices} devices "
             f"({spec.stage} stage x {spec.data} data x {spec.model} model x "
-            f"{spec.seq} seq) but only {len(devices)} are available"
+            f"{spec.seq} seq x {spec.expert} expert) but only "
+            f"{len(devices)} are available"
         )
     devices = devices[: spec.num_devices]
     if devices == jax.devices()[: spec.num_devices] and spec.num_devices == len(jax.devices()):
@@ -76,7 +82,7 @@ def build_mesh(spec: MeshSpec, devices=None) -> Mesh:
         return jax.make_mesh(
             spec.axis_sizes(),
             spec.axis_names(),
-            axis_types=(AxisType.Auto,) * 4,
+            axis_types=(AxisType.Auto,) * len(spec.axis_sizes()),
             devices=devices,
         )
     import numpy as np
