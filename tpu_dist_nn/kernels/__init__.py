@@ -12,5 +12,14 @@ from tpu_dist_nn.kernels.fused_dense import (
     fcnn_fused_forward,
     fused_dense,
 )
+from tpu_dist_nn.kernels.flash_attention import (
+    default_attn_fn,
+    flash_attention,
+)
 
-__all__ = ["fcnn_fused_forward", "fused_dense"]
+__all__ = [
+    "default_attn_fn",
+    "fcnn_fused_forward",
+    "flash_attention",
+    "fused_dense",
+]

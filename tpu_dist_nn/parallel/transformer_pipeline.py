@@ -19,13 +19,14 @@ hard part 2) — no hand-written backward schedule.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from tpu_dist_nn.models.transformer import (
     TransformerConfig,
     block_apply,
+    dot_product_attention,
     embed,
+    next_token_ce,
     unembed,
 )
 from tpu_dist_nn.parallel.gpipe import make_gpipe
@@ -52,7 +53,8 @@ def unshard_blocks(staged: dict) -> dict:
 
 
 def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
-                             num_microbatches: int):
+                             num_microbatches: int,
+                             attn_fn=dot_product_attention):
     """-> ``fn(params, tokens) -> logits`` with blocks pipelined.
 
     ``params`` is the standard transformer pytree but with
@@ -64,7 +66,7 @@ def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
     def stage_fn(stage_blocks, x):
         # stage_blocks leaves: (L/S, ...); scan the local block group.
         def body(carry, block):
-            return block_apply(block, carry, cfg), None
+            return block_apply(block, carry, cfg, attn_fn), None
 
         y, _ = lax.scan(body, x, stage_blocks)
         return y
@@ -88,15 +90,15 @@ def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
 
 
 def make_pipeline_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
-                          num_microbatches: int):
+                          num_microbatches: int,
+                          attn_fn=dot_product_attention):
     """-> ``loss_fn(params, tokens) -> scalar`` next-token CE through the pipeline."""
-    fwd = make_pipeline_lm_forward(mesh, cfg, num_stages, num_microbatches)
+    fwd = make_pipeline_lm_forward(
+        mesh, cfg, num_stages, num_microbatches, attn_fn
+    )
 
     def loss_fn(params, tokens):
         logits = fwd(params, tokens[:, :-1])
-        targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return next_token_ce(logits, tokens[:, 1:])
 
     return loss_fn

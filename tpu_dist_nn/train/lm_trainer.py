@@ -36,12 +36,27 @@ class LMTrainConfig:
     log_every: int = 50
 
 
-def make_lm_train_step(cfg: TransformerConfig, optimizer):
-    """jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+def _resolve_attn_fn(attn_fn):
+    if attn_fn is not None:
+        return attn_fn
+    from tpu_dist_nn.kernels.flash_attention import default_attn_fn
+
+    return default_attn_fn()
+
+
+def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
+    """jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
+
+    ``attn_fn=None`` picks the backend default (the Pallas flash kernel
+    on TPU, the jnp reference elsewhere).
+    """
+    attn_fn = _resolve_attn_fn(attn_fn)
 
     @jax.jit
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, cfg, attn_fn)
+        )(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -49,10 +64,13 @@ def make_lm_train_step(cfg: TransformerConfig, optimizer):
 
 
 def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
-                                num_microbatches: int, optimizer):
+                                num_microbatches: int, optimizer,
+                                attn_fn=None):
     """Pipelined train step; ``params["blocks"]`` must be stage-grouped
     (:func:`tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`)."""
-    loss_fn = make_pipeline_lm_loss(mesh, cfg, num_stages, num_microbatches)
+    loss_fn = make_pipeline_lm_loss(
+        mesh, cfg, num_stages, num_microbatches, _resolve_attn_fn(attn_fn)
+    )
 
     @jax.jit
     def step(params, opt_state, tokens):
