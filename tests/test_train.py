@@ -160,3 +160,17 @@ def test_evaluate_fcnn_runs():
     params = init_fcnn(jax.random.key(1), [DIM, 8, CLASSES])
     m = evaluate_fcnn(params, data)
     assert set(m) == {"accuracy", "precision", "recall", "f1_score"}
+
+
+def test_training_rejects_dataset_smaller_than_batch():
+    # drop_remainder=True with no full batch used to crash with an
+    # obscure "Need at least one array to stack"; now a structured
+    # InvalidArgumentError fails fast (reference fail-fast contract).
+    import pytest
+
+    from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+    data = _data(n=16)
+    params = init_fcnn(jax.random.key(0), [DIM, 8, CLASSES])
+    with pytest.raises(InvalidArgumentError, match="no full batch"):
+        train_fcnn(params, data, TrainConfig(epochs=1, batch_size=64))

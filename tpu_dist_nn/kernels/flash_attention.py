@@ -55,6 +55,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     q = q_ref[0].astype(jnp.float32) * scale  # (bq, Dh)
     bq, d = q.shape
     n_kb = k_ref.shape[1] // block_k
+    if causal:
+        # Skip k-blocks entirely above the diagonal: only blocks with
+        # jk*bk <= iq*bq + bq - 1 can contain unmasked entries.
+        n_kb = jnp.minimum(n_kb, (iq * bq + bq + block_k - 1) // block_k)
 
     q_ids = iq * bq + _iota((bq, block_k), 0)
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
@@ -128,6 +132,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0]
     bq, d = q.shape
     n_kb = k_ref.shape[1] // block_k
+    if causal:
+        n_kb = jnp.minimum(n_kb, (iq * bq + bq + block_k - 1) // block_k)
     q_ids = iq * bq + _iota((bq, block_k), 0)
 
     def body(jk, dq):
@@ -155,6 +161,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     vb = v_ref[0].astype(jnp.float32)
     bk, d = kb.shape
     n_qb = q_ref.shape[1] // block_q
+    # Causal: q-blocks strictly above this k-block's diagonal see it
+    # fully masked — start the stream at the first intersecting block.
+    lo = (jk * bk) // block_q if causal else 0
     k_ids = jk * bk + _iota((block_q, bk), 1)
 
     def body(iq, carry):
@@ -176,7 +185,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk_new, dv_new
 
     zero = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(0, n_qb, body, (zero, zero))
+    dk, dv = lax.fori_loop(lo, n_qb, body, (zero, zero))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

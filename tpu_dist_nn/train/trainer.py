@@ -91,6 +91,10 @@ def run_training_loop(
     """
     from tpu_dist_nn.checkpoint.store import resume_or_init
 
+    from tpu_dist_nn.utils.errors import check_full_batch
+
+    check_full_batch(len(train_data), config.batch_size)
+
     history = []
     start_epoch, state = resume_or_init(
         checkpoints, {"params": params, "opt_state": opt_state}

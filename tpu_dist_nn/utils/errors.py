@@ -53,6 +53,17 @@ class UnavailableError(FrameworkError, RuntimeError):
     code = "UNAVAILABLE"
 
 
+def check_full_batch(num_examples: int, batch_size: int) -> None:
+    """Fail fast when ``drop_remainder`` batching would yield zero
+    batches — shared by every trainer's epoch loop."""
+    if num_examples < batch_size:
+        raise InvalidArgumentError(
+            f"dataset has {num_examples} examples but "
+            f"batch_size={batch_size} drops remainders: no full "
+            "batch to train on — lower batch_size"
+        )
+
+
 def check_input_dim(expected: int, got: int, *, stage: int | None = None) -> None:
     """The per-forward dim check every reference node ran
     (grpc_node.py:83-84), raised host-side before trace/compile."""
