@@ -78,15 +78,22 @@ def make_gpipe(
     num_microbatches: int,
     *,
     microbatch_spec: P | None = None,
+    stage_params_spec=None,
 ):
     """shard_map the schedule over the mesh.
 
     ``microbatch_spec`` partitions one microbatch (without the leading M
-    axis); default shards the batch dim over the data axis. Returns
-    ``f(xs, stage_params) -> (M, *microbatch_shape) outputs``.
+    axis); default shards the batch dim over the data axis.
+    ``stage_params_spec`` optionally gives a per-leaf PartitionSpec
+    pytree for the stage params (default: every leaf ``P(stage)``) —
+    used to compose further axes inside a stage, e.g. a tensor-parallel
+    ``P(stage, model)`` layout whose model dim ``stage_fn`` strips
+    itself. Returns ``f(xs, stage_params) -> (M, *microbatch_shape)``.
     """
     if microbatch_spec is None:
         microbatch_spec = P(AXIS_DATA)
+    if stage_params_spec is None:
+        stage_params_spec = P(AXIS_STAGE)
     xs_spec = P(None, *microbatch_spec)
     extra = tuple(
         ax
@@ -99,6 +106,6 @@ def make_gpipe(
     return jax.shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(xs_spec, P(AXIS_STAGE)),
+        in_specs=(xs_spec, stage_params_spec),
         out_specs=xs_spec,
     )

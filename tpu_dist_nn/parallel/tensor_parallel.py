@@ -49,6 +49,14 @@ from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_MODEL
 #: invariant over the model axis (psum is variant->invariant).
 TP_REPLICATED = frozenset({"ln1_g", "ln1_b", "ln2_g", "ln2_b", "b_o", "b_down"})
 
+#: Every leaf of a dense transformer block — the single source for
+#: building per-leaf PartitionSpec dicts (here and in the PP x TP
+#: composition).
+BLOCK_KEYS = (
+    "ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_o", "b_o",
+    "ln2_g", "ln2_b", "w_up", "b_up", "w_down", "b_down",
+)
+
 
 def tp_shard_blocks(blocks: dict, cfg: TransformerConfig, n: int) -> dict:
     """Stacked block leaves ``(L, ...) -> (N, L, ...)`` Megatron layout.
@@ -169,9 +177,7 @@ def make_tp_lm_forward(mesh, cfg: TransformerConfig, attn_fn=dot_product_attenti
         return x @ embed_params["tok_embed"].T
 
     blocks_specs = {
-        k: (P() if k in TP_REPLICATED else P(AXIS_MODEL))
-        for k in ("ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_o", "b_o",
-                  "ln2_g", "ln2_b", "w_up", "b_up", "w_down", "b_down")
+        k: (P() if k in TP_REPLICATED else P(AXIS_MODEL)) for k in BLOCK_KEYS
     }
     fn = jax.shard_map(
         device_fn,

@@ -19,6 +19,7 @@ hard part 2) — no hand-written backward schedule.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from tpu_dist_nn.models.transformer import (
@@ -32,7 +33,7 @@ from tpu_dist_nn.models.transformer import (
 from tpu_dist_nn.parallel.gpipe import make_gpipe
 from jax.sharding import PartitionSpec as P
 
-from tpu_dist_nn.parallel.mesh import AXIS_DATA
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
 
 
 def shard_blocks(blocks: dict, num_stages: int) -> dict:
@@ -94,6 +95,127 @@ def make_pipeline_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
                           attn_fn=dot_product_attention):
     """-> ``loss_fn(params, tokens) -> scalar`` next-token CE through the pipeline."""
     fwd = make_pipeline_lm_forward(
+        mesh, cfg, num_stages, num_microbatches, attn_fn
+    )
+
+    def loss_fn(params, tokens):
+        logits = fwd(params, tokens[:, :-1])
+        return next_token_ce(logits, tokens[:, 1:])
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# 3D composition: pipeline x tensor x data parallelism
+# ---------------------------------------------------------------------------
+
+def shard_blocks_pp_tp(blocks: dict, cfg: TransformerConfig,
+                       num_stages: int, n_tp: int) -> dict:
+    """Stacked blocks ``(L, ...)`` -> pipeline+Megatron layout.
+
+    TP-sharded leaves become ``(S, N, L/S, ...)`` (stage axis leading,
+    model axis second); TP-replicated leaves (LayerNorm, psum-side
+    biases) become ``(S, L/S, ...)``.
+    """
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        TP_REPLICATED,
+        tp_shard_blocks,
+    )
+
+    L = jax.tree.leaves(blocks)[0].shape[0]
+    if L % num_stages:
+        raise ValueError(f"n_layers={L} not divisible by num_stages={num_stages}")
+    tp = tp_shard_blocks(blocks, cfg, n_tp)  # sharded leaves: (N, L, ...)
+    out = {}
+    for k, v in tp.items():
+        if k in TP_REPLICATED:  # (L, ...)
+            out[k] = v.reshape(num_stages, L // num_stages, *v.shape[1:])
+        else:  # (N, L, ...) -> (S, N, L/S, ...)
+            r = v.reshape(n_tp, num_stages, L // num_stages, *v.shape[2:])
+            out[k] = jnp.swapaxes(r, 0, 1)
+    return out
+
+
+def unshard_blocks_pp_tp(staged: dict, cfg: TransformerConfig) -> dict:
+    """Inverse of :func:`shard_blocks_pp_tp`: back to stacked ``(L, ...)``."""
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        TP_REPLICATED,
+        tp_unshard_blocks,
+    )
+
+    tp = {}
+    for k, v in staged.items():
+        if k in TP_REPLICATED:  # (S, L/S, ...)
+            tp[k] = v.reshape(-1, *v.shape[2:])
+        else:  # (S, N, L/S, ...) -> (N, L, ...)
+            r = jnp.swapaxes(v, 0, 1)
+            tp[k] = r.reshape(r.shape[0], -1, *r.shape[3:])
+    return tp_unshard_blocks(tp, cfg)
+
+
+def make_pipeline_tp_lm_forward(mesh, cfg: TransformerConfig,
+                                num_stages: int, num_microbatches: int,
+                                attn_fn=dot_product_attention):
+    """-> ``fn(params, tokens) -> logits`` with blocks pipelined over
+    ``stage`` AND Megatron-sharded over ``model`` — the 3D composition
+    (with the batch over ``data``). ``params["blocks"]`` must come from
+    :func:`shard_blocks_pp_tp`; embedding/unembed stay replicated.
+
+    Inside a stage each device scans its local block group with
+    :func:`~tpu_dist_nn.parallel.tensor_parallel.tp_block_apply`
+    (two psums/block over ICI); between stages the activation rides the
+    same single-``ppermute`` GPipe hop as the 1-axis pipeline.
+    """
+    from tpu_dist_nn.parallel.mesh import AXIS_MODEL
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        BLOCK_KEYS,
+        TP_REPLICATED,
+        tp_block_apply,
+    )
+
+    n_tp = mesh.shape[AXIS_MODEL]
+
+    def stage_fn(stage_blocks, x):
+        # gpipe stripped the stage dim; strip the model-shard dim here.
+        blocks = {
+            k: (v if k in TP_REPLICATED else v[0])
+            for k, v in stage_blocks.items()
+        }
+
+        def body(carry, block):
+            return tp_block_apply(block, carry, cfg, n_tp, attn_fn), None
+
+        y, _ = lax.scan(body, x, blocks)
+        return y
+
+    blocks_spec = {
+        k: (P(AXIS_STAGE) if k in TP_REPLICATED else P(AXIS_STAGE, AXIS_MODEL))
+        for k in BLOCK_KEYS
+    }
+    gpipe = make_gpipe(
+        mesh, stage_fn, num_stages, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None, None),
+        stage_params_spec=blocks_spec,
+    )
+
+    def fn(params, tokens):
+        B, T = tokens.shape
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        x = embed(params, tokens)
+        xs = x.reshape(M, B // M, T, cfg.d_model)
+        ys = gpipe(xs, params["blocks"])
+        return unembed(params, ys.reshape(B, T, cfg.d_model))
+
+    return fn
+
+
+def make_pipeline_tp_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
+                             num_microbatches: int,
+                             attn_fn=dot_product_attention):
+    """-> ``loss_fn(params, tokens) -> scalar`` CE through the 3D pipeline."""
+    fwd = make_pipeline_tp_lm_forward(
         mesh, cfg, num_stages, num_microbatches, attn_fn
     )
 
