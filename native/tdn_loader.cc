@@ -1,0 +1,100 @@
+// Native data-loader primitives: multithreaded shuffled-batch assembly.
+//
+// The reference feeds its pipeline from Python lists serialized through
+// proto on every hop (run_grpc_inference.py:135-137); the TPU build
+// feeds HBM through an async queue (tpu_dist_nn/data/feed.py), and the
+// host-side cost that remains is assembling shuffled batches: a row
+// gather (plus dtype normalize for integer wire formats) over a large
+// training array. These kernels do that assembly with std::thread
+// fan-out so epoch shuffling never stalls the device queue.
+//
+// Exposed via ctypes from tpu_dist_nn/native/loader.py; every entry
+// point is plain C ABI and thread-safe (no shared state).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int clamp_threads(long work_items, int requested) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  long t = requested > 0 ? requested : static_cast<long>(hw);
+  t = std::min<long>(t, work_items);
+  return static_cast<int>(std::max<long>(1, t));
+}
+
+template <typename Fn>
+void parallel_for(long n, int n_threads, Fn&& fn) {
+  int t = clamp_threads(n, n_threads);
+  if (t == 1) {
+    fn(0L, n);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(t);
+  long chunk = (n + t - 1) / t;
+  for (int i = 0; i < t; ++i) {
+    long lo = i * chunk;
+    long hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([lo, hi, &fn] { fn(lo, hi); });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows: dst[i] = src[idx[i]] for arbitrary row_bytes.
+// Returns 0 on success, -1 on bad arguments.
+int tdn_gather_rows(const void* src, long n_rows, long row_bytes,
+                    const long* idx, long n_idx, void* dst, int n_threads) {
+  if (src == nullptr || idx == nullptr || dst == nullptr || row_bytes <= 0)
+    return -1;
+  const char* s = static_cast<const char*>(src);
+  char* d = static_cast<char*>(dst);
+  std::atomic<bool> ok{true};
+  parallel_for(n_idx, n_threads, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      long r = idx[i];
+      if (r < 0 || r >= n_rows) {
+        ok.store(false, std::memory_order_relaxed);
+        continue;
+      }
+      std::memcpy(d + i * row_bytes, s + r * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  });
+  return ok.load() ? 0 : -1;
+}
+
+// Fused gather + uint8 -> float32 normalize:
+// dst[i, j] = float(src[idx[i], j]) * scale.
+int tdn_gather_norm_u8(const uint8_t* src, long n_rows, long dim,
+                       const long* idx, long n_idx, float* dst, float scale,
+                       int n_threads) {
+  if (src == nullptr || idx == nullptr || dst == nullptr || dim <= 0)
+    return -1;
+  std::atomic<bool> ok{true};
+  parallel_for(n_idx, n_threads, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      long r = idx[i];
+      if (r < 0 || r >= n_rows) {
+        ok.store(false, std::memory_order_relaxed);
+        continue;
+      }
+      const uint8_t* sp = src + r * dim;
+      float* dp = dst + i * dim;
+      for (long j = 0; j < dim; ++j) dp[j] = static_cast<float>(sp[j]) * scale;
+    }
+  });
+  return ok.load() ? 0 : -1;
+}
+
+}  // extern "C"

@@ -27,14 +27,29 @@ def batch_iterator(
     seed: int = 0,
     drop_remainder: bool = False,
 ) -> Iterator:
-    """Yield (x_batch, y_batch) (or bare x_batch) slices host-side."""
+    """Yield (x_batch, y_batch) (or bare x_batch) slices host-side.
+
+    Shuffled assembly routes through the native multithreaded row
+    gather (:mod:`tpu_dist_nn.native.fastloader`) when available; the
+    unshuffled path is a zero-copy numpy view either way.
+    """
+    from tpu_dist_nn.native.fastloader import gather_rows
+
     n = len(x)
-    order = np.random.default_rng(seed).permutation(n) if shuffle else np.arange(n)
+    if not shuffle:
+        for start in range(0, n, batch_size):
+            stop = start + batch_size
+            if drop_remainder and stop > n:
+                return
+            yield (x[start:stop], y[start:stop]) if y is not None else x[start:stop]
+        return
+    order = np.random.default_rng(seed).permutation(n)
     for start in range(0, n, batch_size):
         idx = order[start : start + batch_size]
         if drop_remainder and len(idx) < batch_size:
             return
-        yield (x[idx], y[idx]) if y is not None else x[idx]
+        bx = gather_rows(np.asarray(x), idx)
+        yield (bx, np.asarray(y)[idx]) if y is not None else bx
 
 
 def device_prefetch(batches: Iterable, depth: int = 2, sharding=None) -> Iterator:

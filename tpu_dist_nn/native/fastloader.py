@@ -1,0 +1,96 @@
+"""Python API over the native data-loader primitives.
+
+Shuffled-batch assembly is the host-side cost that remains once the
+device queue is async (``data/feed.py``): a row gather over the
+training array, plus a dtype normalize when the wire format is integer
+(uint8 pixels). Both run as multithreaded C++
+(``native/tdn_loader.cc``) when the native library is available and
+fall back to numpy transparently — results are bit-identical either
+way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from tpu_dist_nn.native.loader import get_library
+
+
+def _normalize_index(idx, n_rows: int) -> np.ndarray:
+    """Numpy index semantics for both paths: integer dtype required,
+    negatives wrap — so native and fallback results are identical."""
+    idx = np.asarray(idx)
+    if idx.dtype.kind not in "iu":
+        raise IndexError(
+            f"row indices must be integers, got dtype {idx.dtype}"
+        )
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    return np.where(idx < 0, idx + n_rows, idx)
+
+
+def gather_rows(x: np.ndarray, idx, *, n_threads: int = 0):
+    """``x[idx]`` for a 2D C-contiguous array, native when possible.
+
+    Falls back to numpy fancy indexing for non-contiguous inputs,
+    unusual dtypes, empty rows, or when the native library is
+    unavailable — with identical index semantics either way.
+    """
+    idx = _normalize_index(idx, x.shape[0])
+    lib = get_library()
+    if (
+        lib is None
+        or x.ndim != 2
+        or x.shape[1] == 0
+        or len(idx) == 0
+        or not x.flags.c_contiguous
+        or x.dtype.hasobject
+    ):
+        return x[idx]
+    out = np.empty((len(idx), x.shape[1]), dtype=x.dtype)
+    rc = lib.tdn_gather_rows(
+        x.ctypes.data_as(ctypes.c_void_p),
+        x.shape[0],
+        x.shape[1] * x.dtype.itemsize,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(idx),
+        out.ctypes.data_as(ctypes.c_void_p),
+        n_threads,
+    )
+    if rc != 0:
+        raise IndexError(
+            f"gather index out of range for array with {x.shape[0]} rows"
+        )
+    return out
+
+
+def gather_normalize_u8(x: np.ndarray, idx, scale: float,
+                        *, n_threads: int = 0) -> np.ndarray:
+    """Fused ``x[idx].astype(f32) * scale`` for uint8 ``x`` (one pass,
+    no intermediate uint8 batch). Numpy fallback is two passes."""
+    if x.dtype != np.uint8 or x.ndim != 2:
+        raise TypeError(
+            f"gather_normalize_u8 needs a 2D uint8 array, got "
+            f"{x.dtype} with ndim={x.ndim}"
+        )
+    idx = _normalize_index(idx, x.shape[0])
+    lib = get_library()
+    if lib is None or x.shape[1] == 0 or len(idx) == 0 or not x.flags.c_contiguous:
+        return x[idx].astype(np.float32) * np.float32(scale)
+    out = np.empty((len(idx), x.shape[1]), dtype=np.float32)
+    rc = lib.tdn_gather_norm_u8(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        x.shape[0],
+        x.shape[1],
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(idx),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        scale,
+        n_threads,
+    )
+    if rc != 0:
+        raise IndexError(
+            f"gather index out of range for array with {x.shape[0]} rows"
+        )
+    return out

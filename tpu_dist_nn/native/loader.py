@@ -1,7 +1,8 @@
-"""Build-on-demand loader for the native codec shared library.
+"""Build-on-demand loader for the native runtime shared library.
 
-First use compiles ``native/tdn_codec.cc`` with ``g++`` into
-``native/build/libtdn_native.so`` (rebuilt when the source is newer)
+First use compiles the C++ sources under ``native/`` (JSON codec +
+data-loader primitives) with ``g++`` into
+``native/build/libtdn_native.so`` (rebuilt when any source is newer)
 and loads it via ctypes. Any failure — no compiler, read-only tree,
 bad toolchain — degrades to ``None`` and callers use the pure-Python
 path; set ``TDN_NATIVE=0`` to skip the native path entirely or
@@ -18,7 +19,10 @@ import threading
 from pathlib import Path
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
-_SRC = _REPO_ROOT / "native" / "tdn_codec.cc"
+_SRCS = (
+    _REPO_ROOT / "native" / "tdn_codec.cc",
+    _REPO_ROOT / "native" / "tdn_loader.cc",
+)
 _LIB = _REPO_ROOT / "native" / "build" / "libtdn_native.so"
 
 _lock = threading.Lock()
@@ -35,12 +39,12 @@ def _build() -> None:
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O2", "-fPIC", "-std=c++17", "-Wall", "-Wextra",
-        "-shared", "-o", str(_LIB), str(_SRC),
+        "-shared", "-o", str(_LIB), *[str(s) for s in _SRCS],
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(
-            f"native codec build failed: {' '.join(cmd)}\n{proc.stderr}"
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
         )
 
 
@@ -80,6 +84,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_char_p)]
     lib.tdn_buffer_free.restype = None
     lib.tdn_buffer_free.argtypes = [c.c_void_p]
+
+    lib.tdn_gather_rows.restype = c.c_int
+    lib.tdn_gather_rows.argtypes = [
+        c.c_void_p, c.c_long, c.c_long,
+        c.POINTER(c.c_long), c.c_long, c.c_void_p, c.c_int]
+    lib.tdn_gather_norm_u8.restype = c.c_int
+    lib.tdn_gather_norm_u8.argtypes = [
+        c.POINTER(c.c_uint8), c.c_long, c.c_long,
+        c.POINTER(c.c_long), c.c_long, c.POINTER(c.c_float), c.c_float,
+        c.c_int]
     return lib
 
 
@@ -96,11 +110,16 @@ def get_library() -> ctypes.CDLL | None:
             return None
         _attempted = True
         try:
-            if not _LIB.exists() or (
-                _SRC.exists() and _SRC.stat().st_mtime > _LIB.stat().st_mtime
-            ):
-                if not _SRC.exists():
-                    raise NativeBuildError(f"native source missing: {_SRC}")
+            missing = [s for s in _SRCS if not s.exists()]
+            stale = not _LIB.exists() or any(
+                s.stat().st_mtime > _LIB.stat().st_mtime for s in _SRCS
+                if s.exists()
+            )
+            if stale:
+                if missing:
+                    raise NativeBuildError(
+                        f"native source missing: {missing[0]}"
+                    )
                 _build()
             _lib = _bind(ctypes.CDLL(str(_LIB)))
             return _lib
