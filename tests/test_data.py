@@ -49,7 +49,8 @@ def test_idx_round_trip(tmp_path):
     )
     ds = load_mnist_idx(tmp_path, "train")
     assert ds.x.shape == (5, 16)
-    np.testing.assert_allclose(ds.x, images.reshape(5, 16) / 255.0)
+    assert ds.x.dtype == np.float32
+    np.testing.assert_allclose(ds.x, images.reshape(5, 16) / 255.0, rtol=1e-6)
     np.testing.assert_array_equal(ds.y, labels)
 
 

@@ -155,3 +155,28 @@ def test_cli_oracle(model_file, inputs_file, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "Average inference time" in out
+
+
+def test_cli_lm_trains_and_reports_metrics(capsys):
+    # Tiny-transformer LM verb: single-chip and pipelined, metrics JSON
+    # on stdout (BASELINE configs[4] driver surface).
+    import json
+
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "2",
+        "--seq-len", "16", "--steps", "4", "--batch-size", "4",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    metrics = json.loads(out)
+    assert metrics["perplexity"] > 1
+    assert 0 < metrics["bits_per_byte"] < 10
+
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "2",
+        "--seq-len", "16", "--steps", "2", "--batch-size", "4",
+        "--stages", "2", "--microbatches", "2",
+    ])
+    assert rc == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["perplexity"] > 1

@@ -47,6 +47,16 @@ def test_gather_rows_negative_indices_wrap_like_numpy():
     np.testing.assert_array_equal(gather_rows(x, idx), x[idx])
 
 
+def test_gather_rows_out_of_range_negative_raises_on_both_paths():
+    # -11 on a 10-row array must raise (numpy semantics), and must NOT
+    # double-wrap to -1 on the numpy fallback path.
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    with pytest.raises(IndexError):
+        gather_rows(x, np.array([-11]))
+    with pytest.raises(IndexError):
+        gather_rows(x[:, ::2], np.array([-11]))  # non-contiguous fallback
+
+
 def test_gather_rows_float_indices_rejected():
     x = np.zeros((10, 4), np.float32)
     with pytest.raises(IndexError, match="must be integers"):

@@ -176,6 +176,81 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_lm(args) -> int:
+    """Train + evaluate the Tiny-Transformer LM (BASELINE configs[4]).
+
+    Corpus: a real on-disk WikiText file when present (``--corpus`` or
+    the conventional paths in data/text.py), else the synthetic
+    gated-fallback corpus. Pipelined over ``--stages`` when > 1.
+    """
+    import jax
+
+    from tpu_dist_nn.data.text import lm_sequences, load_corpus, encode
+    from tpu_dist_nn.data.text import lm_batches
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+        num_params,
+    )
+    from tpu_dist_nn.train.lm_trainer import (
+        LMTrainConfig,
+        evaluate_lm,
+        train_lm,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256,  # byte-level
+        d_model=args.d_model,
+        n_heads=args.heads,
+        n_layers=args.layers,
+        d_ff=4 * args.d_model,
+        max_seq_len=args.seq_len,
+    )
+    text, source = load_corpus(args.corpus)
+    tokens = encode(text)
+    rows = lm_sequences(tokens, args.seq_len)
+    split = max(1, int(len(rows) * 0.95))
+    train_rows, eval_rows = rows[:split], rows[split:]
+    params = init_transformer(jax.random.key(args.seed), cfg)
+    log.info(
+        "tiny-transformer: %d params, corpus=%s, %d train rows, %d eval rows",
+        num_params(params), source, len(train_rows), len(eval_rows),
+    )
+
+    mesh = None
+    if args.stages > 1:
+        from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+
+        mesh = build_mesh(
+            MeshSpec(stage=args.stages, data=args.data_parallel)
+        )
+    train_cfg = LMTrainConfig(
+        learning_rate=args.lr, steps=args.steps,
+        batch_size=args.batch_size, seq_len=args.seq_len,
+    )
+    batches = lm_batches(
+        train_rows, args.batch_size, seed=args.seed, epochs=None
+    )
+    t0 = time.monotonic()
+    params, history = train_lm(
+        params, cfg, batches, train_cfg, mesh=mesh,
+        num_stages=args.stages, num_microbatches=args.microbatches,
+    )
+    train_seconds = time.monotonic() - t0
+    for h in history:
+        log.info("step %d: loss %.4f (%.2fs)", h["step"], h["loss"], h["seconds"])
+    eval_metrics = evaluate_lm(
+        params, cfg, eval_rows if len(eval_rows) >= args.batch_size else rows,
+        batch_size=args.batch_size,
+    )
+    print(json.dumps({
+        "train_seconds": round(train_seconds, 2),
+        "final_train_loss": history[-1]["loss"] if history else None,
+        **{k: round(v, 4) for k, v in eval_metrics.items()},
+    }))
+    return 0
+
+
 def cmd_oracle(args) -> int:
     """Single-process float64 baseline (scripts/manual_nn.py:88-99)."""
     from tpu_dist_nn.core.schema import load_examples, load_model
@@ -233,6 +308,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save per-epoch training state here and resume from it")
     p.add_argument("--keep-checkpoints", type=int, default=3)
     p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("lm", help="train + eval the Tiny-Transformer LM")
+    p.add_argument("--corpus", help="path to a text corpus (WikiText-2); "
+                   "falls back to the synthetic corpus")
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--stages", type=int, default=1,
+                   help="pipeline stages (per-block GPipe) when > 1")
+    p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--microbatches", type=int, default=4)
+    p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")
     p.add_argument("--config", required=True)

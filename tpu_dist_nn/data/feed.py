@@ -43,13 +43,15 @@ def batch_iterator(
                 return
             yield (x[start:stop], y[start:stop]) if y is not None else x[start:stop]
         return
+    x = np.asarray(x)
+    y = None if y is None else np.asarray(y)
     order = np.random.default_rng(seed).permutation(n)
     for start in range(0, n, batch_size):
         idx = order[start : start + batch_size]
         if drop_remainder and len(idx) < batch_size:
             return
-        bx = gather_rows(np.asarray(x), idx)
-        yield (bx, np.asarray(y)[idx]) if y is not None else bx
+        bx = gather_rows(x, idx)
+        yield (bx, y[idx]) if y is not None else bx
 
 
 def device_prefetch(batches: Iterable, depth: int = 2, sharding=None) -> Iterator:

@@ -115,7 +115,14 @@ def lm_sequences(tokens: np.ndarray, seq_len: int) -> np.ndarray:
 
 def lm_batches(rows: np.ndarray, batch_size: int, *, seed: int = 0,
                epochs: int | None = 1) -> Iterator[np.ndarray]:
-    """Shuffled ``(batch_size, seq_len+1)`` batches; partial tails dropped."""
+    """Shuffled ``(batch_size, seq_len+1)`` batches; partial tails dropped.
+
+    Fails fast when no full batch exists (with ``epochs=None`` the loop
+    would otherwise spin forever yielding nothing).
+    """
+    from tpu_dist_nn.utils.errors import check_full_batch
+
+    check_full_batch(len(rows), batch_size)
     rng = np.random.default_rng(seed)
     epoch = 0
     while epochs is None or epoch < epochs:

@@ -20,14 +20,22 @@ from tpu_dist_nn.native.loader import get_library
 
 def _normalize_index(idx, n_rows: int) -> np.ndarray:
     """Numpy index semantics for both paths: integer dtype required,
-    negatives wrap — so native and fallback results are identical."""
+    negatives wrap exactly once, out-of-range raises — so native and
+    fallback results are identical."""
     idx = np.asarray(idx)
     if idx.dtype.kind not in "iu":
         raise IndexError(
             f"row indices must be integers, got dtype {idx.dtype}"
         )
     idx = np.ascontiguousarray(idx, dtype=np.int64)
-    return np.where(idx < 0, idx + n_rows, idx)
+    wrapped = np.where(idx < 0, idx + n_rows, idx)
+    if wrapped.size and (
+        int(wrapped.min()) < 0 or int(wrapped.max()) >= n_rows
+    ):
+        raise IndexError(
+            f"gather index out of range for array with {n_rows} rows"
+        )
+    return wrapped
 
 
 def gather_rows(x: np.ndarray, idx, *, n_threads: int = 0):
