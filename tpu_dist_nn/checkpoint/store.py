@@ -168,10 +168,38 @@ class CheckpointManager:
 def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
     """Shared trainer resume step: restore the newest checkpoint into
     ``state``'s structure, or keep ``state`` as-is when none exists.
-    Returns ``(completed_epochs, state)``."""
+    Returns ``(completed_epochs, state)``.
+
+    Restored leaf shapes are validated against the template: msgpack
+    restore matches dict *keys*, so a checkpoint written under a
+    different placement (e.g. another ``--stages`` grouping, which
+    reshapes block leaves) would otherwise surface as a confusing
+    trace-time error deep inside jit.
+    """
     if checkpoints is None:
         return 0, state
     restored = checkpoints.restore_or_none(state)
     if restored is None:
         return 0, state
-    return restored
+    step, restored_state = restored
+
+    import jax
+    import numpy as np
+
+    def _check(t, r):
+        ts = np.shape(t)
+        rs = np.shape(r)
+        if ts != rs:
+            from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"checkpoint leaf shape {rs} does not match this run's "
+                f"template shape {ts} — the checkpoint was written under "
+                "a different placement (e.g. a different --stages or "
+                "model size); use a matching configuration or a fresh "
+                "checkpoint directory"
+            )
+        return r
+
+    restored_state = jax.tree.map(_check, state, restored_state)
+    return step, restored_state

@@ -205,6 +205,7 @@ def cmd_lm(args) -> int:
         n_layers=args.layers,
         d_ff=4 * args.d_model,
         max_seq_len=args.seq_len,
+        compute_dtype="bfloat16" if args.bf16 else "float32",
     )
     text, source = load_corpus(args.corpus)
     tokens = encode(text)
@@ -231,21 +232,37 @@ def cmd_lm(args) -> int:
     batches = lm_batches(
         train_rows, args.batch_size, seed=args.seed, epochs=None
     )
+    checkpoints = None
+    if args.checkpoint_dir:
+        from tpu_dist_nn.checkpoint import CheckpointManager
+
+        checkpoints = CheckpointManager(
+            args.checkpoint_dir, keep=args.keep_checkpoints
+        )
     t0 = time.monotonic()
     params, history = train_lm(
         params, cfg, batches, train_cfg, mesh=mesh,
         num_stages=args.stages, num_microbatches=args.microbatches,
+        checkpoints=checkpoints,
     )
     train_seconds = time.monotonic() - t0
     for h in history:
         log.info("step %d: loss %.4f (%.2fs)", h["step"], h["loss"], h["seconds"])
+    held_out = len(eval_rows) >= args.batch_size
+    if not held_out:
+        log.warning(
+            "eval split has %d rows < batch size %d; reporting metrics "
+            "over the FULL dataset (includes training rows)",
+            len(eval_rows), args.batch_size,
+        )
     eval_metrics = evaluate_lm(
-        params, cfg, eval_rows if len(eval_rows) >= args.batch_size else rows,
+        params, cfg, eval_rows if held_out else rows,
         batch_size=args.batch_size,
     )
     print(json.dumps({
         "train_seconds": round(train_seconds, 2),
         "final_train_loss": history[-1]["loss"] if history else None,
+        "eval_split": "held-out" if held_out else "full-dataset",
         **{k: round(v, 4) for k, v in eval_metrics.items()},
     }))
     return 0
@@ -324,6 +341,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline stages (per-block GPipe) when > 1")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--microbatches", type=int, default=4)
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 compute (f32 master params + CE)")
+    p.add_argument("--checkpoint-dir",
+                   help="save per-interval training state here and resume")
+    p.add_argument("--keep-checkpoints", type=int, default=3)
     p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")

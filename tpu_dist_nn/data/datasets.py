@@ -80,7 +80,7 @@ def synthetic_mnist(
     x = np.tanh(base) + rng.normal(0, noise, (num_examples, dim))
     # Squash into [0,1] like normalized pixel intensities (/255, cell 8).
     x = (x - x.min()) / (x.max() - x.min())
-    return Dataset(x.astype(np.float64), y, num_classes)
+    return Dataset(x.astype(np.float32), y, num_classes)
 
 
 def load_idx_images(path) -> np.ndarray:
@@ -91,7 +91,7 @@ def load_idx_images(path) -> np.ndarray:
     f32 is what every trainer feeds the device anyway, at half the host
     RAM of the old f64 intermediate.
     """
-    from tpu_dist_nn.native.fastloader import gather_normalize_u8
+    from tpu_dist_nn.native.fastloader import normalize_u8
 
     raw = Path(path).read_bytes()
     magic, n, rows, cols = struct.unpack(">IIII", raw[:16])
@@ -99,7 +99,7 @@ def load_idx_images(path) -> np.ndarray:
         raise ValueError(f"{path}: bad IDX3 magic {magic:#x}")
     data = np.frombuffer(raw, dtype=np.uint8, offset=16)
     pixels = np.ascontiguousarray(data.reshape(n, rows * cols))
-    return gather_normalize_u8(pixels, np.arange(n), 1.0 / 255.0)
+    return normalize_u8(pixels, 1.0 / 255.0)
 
 
 def load_idx_labels(path) -> np.ndarray:

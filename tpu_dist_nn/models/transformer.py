@@ -36,7 +36,15 @@ from jax import lax
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
-    """Static architecture description (hashable; closed over by jit)."""
+    """Static architecture description (hashable; closed over by jit).
+
+    ``compute_dtype`` selects the forward-pass precision as a string
+    (hashable): params stay float32 master copies; under ``"bfloat16"``
+    the loss path casts them (and activations) to bf16 for the MXU and
+    keeps softmax/CE accumulation in f32 — the standard TPU mixed-
+    precision recipe. Gradients flow back to the f32 masters through
+    the cast.
+    """
 
     vocab_size: int = 256
     d_model: int = 128
@@ -45,6 +53,7 @@ class TransformerConfig:
     d_ff: int = 512
     max_seq_len: int = 256
     causal: bool = True
+    compute_dtype: str = "float32"
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -55,6 +64,13 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def cast_params(self, params):
+        """Params in the compute dtype (identity for float32)."""
+        if self.compute_dtype == "float32":
+            return params
+        dtype = jnp.dtype(self.compute_dtype)
+        return jax.tree.map(lambda a: a.astype(dtype), params)
 
 
 def init_transformer(key: jax.Array, cfg: TransformerConfig, dtype=jnp.float32):
@@ -96,9 +112,12 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig, dtype=jnp.float32):
 
 
 def layer_norm(x, g, b, eps=1e-5):
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) * lax.rsqrt(var + eps) * g + b
+    """Stats accumulate in f32 regardless of input dtype (bf16-safe)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype)
+    return normed * g + b
 
 
 def dot_product_attention(q, k, v, *, causal: bool):
@@ -165,8 +184,10 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
     """Full LM forward: ``(batch, T) tokens -> (batch, T, vocab) logits``.
 
     The block stack runs as ``lax.scan`` over the stacked layer axis —
-    one traced block body regardless of depth.
+    one traced block body regardless of depth. Runs in
+    ``cfg.compute_dtype`` (params cast per :meth:`cast_params`).
     """
+    params = cfg.cast_params(params)
     x = embed(params, tokens)
 
     def body(carry, block):

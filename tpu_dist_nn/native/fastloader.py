@@ -73,6 +73,24 @@ def gather_rows(x: np.ndarray, idx, *, n_threads: int = 0):
     return out
 
 
+def normalize_u8(x: np.ndarray, scale: float, *, n_threads: int = 0) -> np.ndarray:
+    """Whole-array ``x.astype(f32) * scale`` for 2D uint8 ``x``.
+
+    Native path runs the fused multithreaded kernel with an identity
+    gather; the fallback is a direct one-pass numpy expression (no
+    index materialization or extra copy).
+    """
+    if x.dtype != np.uint8 or x.ndim != 2:
+        raise TypeError(
+            f"normalize_u8 needs a 2D uint8 array, got {x.dtype} "
+            f"with ndim={x.ndim}"
+        )
+    if get_library() is None or not x.flags.c_contiguous or x.size == 0:
+        return x.astype(np.float32) * np.float32(scale)
+    return gather_normalize_u8(x, np.arange(x.shape[0]), scale,
+                               n_threads=n_threads)
+
+
 def gather_normalize_u8(x: np.ndarray, idx, scale: float,
                         *, n_threads: int = 0) -> np.ndarray:
     """Fused ``x[idx].astype(f32) * scale`` for uint8 ``x`` (one pass,

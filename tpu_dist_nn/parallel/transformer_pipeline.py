@@ -78,6 +78,7 @@ def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
     )
 
     def fn(params, tokens):
+        params = cfg.cast_params(params)
         B, T = tokens.shape
         M = num_microbatches
         if B % M:
@@ -199,6 +200,7 @@ def make_pipeline_tp_lm_forward(mesh, cfg: TransformerConfig,
     )
 
     def fn(params, tokens):
+        params = cfg.cast_params(params)
         B, T = tokens.shape
         M = num_microbatches
         if B % M:

@@ -83,12 +83,20 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
 
 def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
              train_cfg: LMTrainConfig, *, mesh=None, num_stages: int = 1,
-             num_microbatches: int = 1):
+             num_microbatches: int = 1, checkpoints=None,
+             checkpoint_every: int | None = None):
     """Run the training loop; pipelined when ``mesh``+``num_stages>1``.
 
-    Returns ``(params, history)`` with params in standard (unstaged)
-    layout either way.
+    ``checkpoints`` (a CheckpointManager) enables step-level save +
+    resume of (params, opt_state): the checkpoint index counts
+    completed steps, and on resume the batch stream is consumed up to
+    that step so a deterministic stream (``lm_batches`` with a fixed
+    seed) stays aligned. Saves every ``checkpoint_every`` steps
+    (default: ``log_every``). Returns ``(params, history)`` with params
+    in standard (unstaged) layout either way.
     """
+    from tpu_dist_nn.checkpoint.store import resume_or_init
+
     optimizer = optax.adam(train_cfg.learning_rate)
     pipelined = mesh is not None and num_stages > 1
     if pipelined:
@@ -99,17 +107,31 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     else:
         step = make_lm_train_step(cfg, optimizer)
     opt_state = optimizer.init(params)
+    start_step, state = resume_or_init(
+        checkpoints, {"params": params, "opt_state": opt_state}
+    )
+    params, opt_state = state["params"], state["opt_state"]
+    every = checkpoint_every or train_cfg.log_every
 
     history = []
     t0 = time.monotonic()
     for i, batch in enumerate(batches):
         if i >= train_cfg.steps:
             break
+        if i < start_step:
+            continue  # replay-skip: keeps a seeded stream aligned
         params, opt_state, loss = step(params, opt_state, jnp.asarray(batch))
         if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
             history.append(
                 {"step": i + 1, "loss": float(loss),
                  "seconds": time.monotonic() - t0}
+            )
+        if checkpoints is not None and (
+            (i + 1) % every == 0 or i == train_cfg.steps - 1
+        ):
+            checkpoints.save(
+                i + 1, {"params": params, "opt_state": opt_state},
+                metadata={"step": i + 1, "loss": float(loss)},
             )
     if pipelined:
         params = dict(params, blocks=unshard_blocks(params["blocks"]))
