@@ -180,3 +180,44 @@ def test_cli_lm_trains_and_reports_metrics(capsys):
     assert rc == 0
     metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert metrics["perplexity"] > 1
+
+
+def test_engine_step_latency_probe(model_file):
+    # The BASELINE "p50 per-stage pipeline step latency" metric.
+    engine = Engine.up(model_file, [1, 1, 1])
+    summary = engine.step_latency(batch_size=16, iters=5)
+    assert summary["count"] == 5
+    assert summary["num_stages"] == 3
+    assert summary["p50_per_stage_s"] == pytest.approx(
+        summary["p50_s"] / 3
+    )
+    engine.down()
+
+
+def test_cli_lm_moe_single_and_expert_parallel(capsys):
+    import json
+
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--seq-len", "16", "--steps", "3", "--batch-size", "4",
+        "--experts", "2",
+    ])
+    assert rc == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["perplexity"] > 1
+
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--seq-len", "16", "--steps", "3", "--batch-size", "4",
+        "--experts", "2", "--expert-parallel", "2",
+    ])
+    assert rc == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["perplexity"] > 1
+
+
+def test_cli_lm_moe_rejects_stages():
+    rc = cli_main([
+        "lm", "--experts", "2", "--stages", "2", "--steps", "1",
+    ])
+    assert rc != 0

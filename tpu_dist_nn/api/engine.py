@@ -249,6 +249,35 @@ class Engine:
         out = self.infer(np.asarray(x).reshape(1, -1))[0]
         return out, time.monotonic() - t0
 
+    def step_latency(self, batch_size: int = 256, iters: int = 20) -> dict:
+        """The BASELINE "p50 per-stage pipeline step latency" probe.
+
+        Times ``iters`` synchronous forward steps on a synthetic batch
+        and reports the :class:`~tpu_dist_nn.utils.profiling.LatencyStats`
+        percentiles plus ``p50_per_stage_s`` (step p50 divided by the
+        stage count — the per-stage share of one pipeline step).
+        """
+        from tpu_dist_nn.utils.errors import InvalidArgumentError
+        from tpu_dist_nn.utils.profiling import LatencyStats
+
+        if iters < 1:
+            raise InvalidArgumentError(
+                f"step_latency needs iters >= 1, got {iters}"
+            )
+        rng = np.random.default_rng(0)
+        x = rng.uniform(0.0, 1.0, (batch_size, self.model.input_dim))
+        self.infer(x)  # warmup / compile
+        stats = LatencyStats("pipeline_step")
+        for _ in range(iters):
+            t0 = time.monotonic()
+            self.infer(x)
+            stats.record(time.monotonic() - t0)
+        num_stages = self.placement().get("num_stages", 1)
+        summary = stats.summary()
+        summary["num_stages"] = num_stages
+        summary["p50_per_stage_s"] = summary["p50_s"] / num_stages
+        return summary
+
     def run_inference(
         self,
         inputs,

@@ -198,7 +198,20 @@ def cmd_lm(args) -> int:
         train_lm,
     )
 
-    cfg = TransformerConfig(
+    moe = args.experts > 0
+    if moe and args.stages > 1:
+        raise ValueError("--experts is not combinable with --stages "
+                         "(MoE pipelines are not implemented)")
+    if not moe and args.expert_parallel > 1:
+        raise ValueError("--expert-parallel requires --experts > 0")
+    if moe and args.expert_parallel > 1:
+        shards = args.expert_parallel * args.data_parallel
+        if args.batch_size % shards:
+            raise ValueError(
+                f"--batch-size {args.batch_size} must be divisible by "
+                f"expert_parallel*data_parallel={shards}"
+            )
+    common = dict(
         vocab_size=256,  # byte-level
         d_model=args.d_model,
         n_heads=args.heads,
@@ -207,19 +220,52 @@ def cmd_lm(args) -> int:
         max_seq_len=args.seq_len,
         compute_dtype="bfloat16" if args.bf16 else "float32",
     )
+    if moe:
+        from tpu_dist_nn.parallel.expert_parallel import MoEConfig
+
+        cfg = MoEConfig(
+            **common, n_experts=args.experts,
+            capacity_factor=args.capacity_factor,
+        )
+    else:
+        cfg = TransformerConfig(**common)
     text, source = load_corpus(args.corpus)
     tokens = encode(text)
     rows = lm_sequences(tokens, args.seq_len)
     split = max(1, int(len(rows) * 0.95))
     train_rows, eval_rows = rows[:split], rows[split:]
-    params = init_transformer(jax.random.key(args.seed), cfg)
+    if moe:
+        from tpu_dist_nn.parallel.expert_parallel import init_moe_transformer
+
+        params = init_moe_transformer(jax.random.key(args.seed), cfg)
+    else:
+        params = init_transformer(jax.random.key(args.seed), cfg)
     log.info(
-        "tiny-transformer: %d params, corpus=%s, %d train rows, %d eval rows",
+        "tiny-transformer%s: %d params, corpus=%s, %d train rows, %d eval rows",
+        f" (MoE x{args.experts})" if moe else "",
         num_params(params), source, len(train_rows), len(eval_rows),
     )
 
     mesh = None
-    if args.stages > 1:
+    step_fn = None
+    if moe and args.expert_parallel > 1:
+        from tpu_dist_nn.parallel.expert_parallel import ep_shard_blocks
+        from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+        from tpu_dist_nn.train.lm_trainer import make_moe_lm_train_step
+
+        ep_mesh = build_mesh(
+            MeshSpec(expert=args.expert_parallel, data=args.data_parallel)
+        )
+        params = dict(
+            params,
+            blocks=ep_shard_blocks(params["blocks"], args.expert_parallel),
+        )
+        step_fn = lambda opt: make_moe_lm_train_step(cfg, opt, ep_mesh)  # noqa: E731
+    elif moe:
+        from tpu_dist_nn.train.lm_trainer import make_moe_lm_train_step
+
+        step_fn = lambda opt: make_moe_lm_train_step(cfg, opt)  # noqa: E731
+    elif args.stages > 1:
         from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 
         mesh = build_mesh(
@@ -243,9 +289,13 @@ def cmd_lm(args) -> int:
     params, history = train_lm(
         params, cfg, batches, train_cfg, mesh=mesh,
         num_stages=args.stages, num_microbatches=args.microbatches,
-        checkpoints=checkpoints,
+        checkpoints=checkpoints, step_fn=step_fn,
     )
     train_seconds = time.monotonic() - t0
+    if moe and args.expert_parallel > 1:
+        from tpu_dist_nn.parallel.expert_parallel import ep_unshard_blocks
+
+        params = dict(params, blocks=ep_unshard_blocks(params["blocks"]))
     for h in history:
         log.info("step %d: loss %.4f (%.2fs)", h["step"], h["loss"], h["seconds"])
     held_out = len(eval_rows) >= args.batch_size
@@ -255,10 +305,18 @@ def cmd_lm(args) -> int:
             "over the FULL dataset (includes training rows)",
             len(eval_rows), args.batch_size,
         )
-    eval_metrics = evaluate_lm(
-        params, cfg, eval_rows if held_out else rows,
-        batch_size=args.batch_size,
-    )
+    if moe:
+        from tpu_dist_nn.train.lm_trainer import evaluate_moe_lm
+
+        eval_metrics = evaluate_moe_lm(
+            params, cfg, eval_rows if held_out else rows,
+            batch_size=args.batch_size,
+        )
+    else:
+        eval_metrics = evaluate_lm(
+            params, cfg, eval_rows if held_out else rows,
+            batch_size=args.batch_size,
+        )
     print(json.dumps({
         "train_seconds": round(train_seconds, 2),
         "final_train_loss": history[-1]["loss"] if history else None,
@@ -343,6 +401,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", type=int, default=4)
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (f32 master params + CE)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="MoE: experts per block (0 = dense MLP)")
+    p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--expert-parallel", type=int, default=1,
+                   help="shard experts over this many devices (all_to_all)")
     p.add_argument("--checkpoint-dir",
                    help="save per-interval training state here and resume")
     p.add_argument("--keep-checkpoints", type=int, default=3)

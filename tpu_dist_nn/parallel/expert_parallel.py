@@ -191,6 +191,7 @@ def moe_forward(params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
     """
     from tpu_dist_nn.models.transformer import embed, unembed
 
+    params = cfg.cast_params(params)
     x = embed(params, tokens)
 
     def body(carry, block):
@@ -333,7 +334,15 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
         out_specs=P() if with_loss else P((AXIS_DATA, AXIS_EXPERT)),
     )
 
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+
     def forward(params_ep, tokens):
+        B = tokens.shape[0]
+        if B % n_shards:
+            raise ValueError(
+                f"batch {B} not divisible by data*expert shards {n_shards}"
+            )
+        params_ep = cfg.cast_params(params_ep)
         embed_params = {k: v for k, v in params_ep.items() if k != "blocks"}
         return fn(embed_params, params_ep["blocks"], tokens)
 

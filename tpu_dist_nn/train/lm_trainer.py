@@ -81,10 +81,45 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     return step
 
 
+def make_moe_lm_train_step(cfg, optimizer, mesh=None, attn_fn=None):
+    """MoE train step: single-chip (``mesh=None``, grouped oracle) or
+    expert-parallel over the mesh's ``expert`` axis (all_to_all
+    dispatch). ``cfg`` is a
+    :class:`~tpu_dist_nn.parallel.expert_parallel.MoEConfig`.
+    ``attn_fn=None`` resolves the backend default (flash on TPU), same
+    as the dense train step."""
+    from tpu_dist_nn.parallel.expert_parallel import (
+        make_ep_lm_forward,
+        moe_lm_loss,
+    )
+
+    attn_fn = _resolve_attn_fn(attn_fn)
+    if mesh is None:
+        def loss_fn(p, t):
+            return moe_lm_loss(p, t, cfg, attn_fn=attn_fn)
+    else:
+        loss_fn = make_ep_lm_forward(mesh, cfg, attn_fn, with_loss=True)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def evaluate_moe_lm(params, cfg, rows: np.ndarray,
+                    batch_size: int = 16) -> dict:
+    """MoE eval: CE only (router aux excluded) so perplexity/bits-per-
+    byte are comparable with the dense model's numbers."""
+    return _evaluate_ce(_jitted_moe_ce(cfg), params, rows, batch_size)
+
+
 def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
              train_cfg: LMTrainConfig, *, mesh=None, num_stages: int = 1,
              num_microbatches: int = 1, checkpoints=None,
-             checkpoint_every: int | None = None):
+             checkpoint_every: int | None = None, step_fn=None):
     """Run the training loop; pipelined when ``mesh``+``num_stages>1``.
 
     ``checkpoints`` (a CheckpointManager) enables step-level save +
@@ -94,12 +129,18 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     seed) stays aligned. Saves every ``checkpoint_every`` steps
     (default: ``log_every``). Returns ``(params, history)`` with params
     in standard (unstaged) layout either way.
+
+    ``step_fn``: ``optimizer -> step`` factory overriding the built-in
+    step (used by the MoE family via :func:`make_moe_lm_train_step`);
+    the caller then owns any param-layout shard/unshard.
     """
     from tpu_dist_nn.checkpoint.store import resume_or_init
 
     optimizer = optax.adam(train_cfg.learning_rate)
-    pipelined = mesh is not None and num_stages > 1
-    if pipelined:
+    pipelined = step_fn is None and mesh is not None and num_stages > 1
+    if step_fn is not None:
+        step = step_fn(optimizer)
+    elif pipelined:
         params = dict(params, blocks=shard_blocks(params["blocks"], num_stages))
         step = make_pipeline_lm_train_step(
             mesh, cfg, num_stages, num_microbatches, optimizer
@@ -145,10 +186,22 @@ def _jitted_lm_loss(cfg: TransformerConfig):
     return jax.jit(functools.partial(lm_loss, cfg=cfg))
 
 
-def evaluate_lm(params, cfg: TransformerConfig, rows: np.ndarray,
-                batch_size: int = 16) -> dict:
-    """Mean next-token CE + perplexity + bits/byte over ``(N, T+1)`` rows."""
-    loss_fn = _jitted_lm_loss(cfg)
+@functools.lru_cache(maxsize=32)
+def _jitted_moe_ce(cfg):
+    from tpu_dist_nn.models.transformer import next_token_ce
+    from tpu_dist_nn.parallel.expert_parallel import moe_forward
+
+    attn_fn = _resolve_attn_fn(None)
+
+    @jax.jit
+    def ce(p, tokens):
+        logits, _ = moe_forward(p, tokens[:, :-1], cfg, attn_fn=attn_fn)
+        return next_token_ce(logits, tokens[:, 1:])
+
+    return ce
+
+
+def _evaluate_ce(loss_fn, params, rows: np.ndarray, batch_size: int) -> dict:
     losses, weights = [], []
     for i in range(0, len(rows) - batch_size + 1, batch_size):
         batch = jnp.asarray(rows[i : i + batch_size])
@@ -162,3 +215,9 @@ def evaluate_lm(params, cfg: TransformerConfig, rows: np.ndarray,
         "perplexity": float(np.exp(loss)),
         "bits_per_byte": loss / np.log(2),
     }
+
+
+def evaluate_lm(params, cfg: TransformerConfig, rows: np.ndarray,
+                batch_size: int = 16) -> dict:
+    """Mean next-token CE + perplexity + bits/byte over ``(N, T+1)`` rows."""
+    return _evaluate_ce(_jitted_lm_loss(cfg), params, rows, batch_size)
