@@ -67,7 +67,7 @@ def main() -> int:
 
     def run_pass():
         outs = []
-        for bx in device_prefetch(batch_iterator(x, batch_size=batch), depth=2):
+        for bx in device_prefetch(batch_iterator(x, batch_size=batch), depth=4):
             outs.append(apply(params, bx))
         jax.block_until_ready(outs)
         return outs

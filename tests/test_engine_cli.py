@@ -160,8 +160,6 @@ def test_cli_oracle(model_file, inputs_file, capsys):
 def test_cli_lm_trains_and_reports_metrics(capsys):
     # Tiny-transformer LM verb: single-chip and pipelined, metrics JSON
     # on stdout (BASELINE configs[4] driver surface).
-    import json
-
     rc = cli_main([
         "lm", "--d-model", "16", "--heads", "2", "--layers", "2",
         "--seq-len", "16", "--steps", "4", "--batch-size", "4",
@@ -195,8 +193,6 @@ def test_engine_step_latency_probe(model_file):
 
 
 def test_cli_lm_moe_single_and_expert_parallel(capsys):
-    import json
-
     rc = cli_main([
         "lm", "--d-model", "16", "--heads", "2", "--layers", "1",
         "--seq-len", "16", "--steps", "3", "--batch-size", "4",
@@ -221,3 +217,16 @@ def test_cli_lm_moe_rejects_stages():
         "lm", "--experts", "2", "--stages", "2", "--steps", "1",
     ])
     assert rc != 0
+
+
+def test_cli_lm_moe_data_parallel_without_ep(capsys):
+    # --experts with --data-parallel alone shards the batch over the
+    # data axis (expert axis = 1) instead of silently running single-chip.
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--seq-len", "16", "--steps", "2", "--batch-size", "4",
+        "--experts", "2", "--data-parallel", "2",
+    ])
+    assert rc == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["perplexity"] > 1

@@ -260,9 +260,10 @@ class Engine:
         from tpu_dist_nn.utils.errors import InvalidArgumentError
         from tpu_dist_nn.utils.profiling import LatencyStats
 
-        if iters < 1:
+        if iters < 1 or batch_size < 1:
             raise InvalidArgumentError(
-                f"step_latency needs iters >= 1, got {iters}"
+                f"step_latency needs iters >= 1 and batch_size >= 1, "
+                f"got iters={iters}, batch_size={batch_size}"
             )
         rng = np.random.default_rng(0)
         x = rng.uniform(0.0, 1.0, (batch_size, self.model.input_dim))

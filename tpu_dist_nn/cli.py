@@ -204,13 +204,7 @@ def cmd_lm(args) -> int:
                          "(MoE pipelines are not implemented)")
     if not moe and args.expert_parallel > 1:
         raise ValueError("--expert-parallel requires --experts > 0")
-    if moe and args.expert_parallel > 1:
-        shards = args.expert_parallel * args.data_parallel
-        if args.batch_size % shards:
-            raise ValueError(
-                f"--batch-size {args.batch_size} must be divisible by "
-                f"expert_parallel*data_parallel={shards}"
-            )
+
     common = dict(
         vocab_size=256,  # byte-level
         d_model=args.d_model,
@@ -220,57 +214,72 @@ def cmd_lm(args) -> int:
         max_seq_len=args.seq_len,
         compute_dtype="bfloat16" if args.bf16 else "float32",
     )
+    mesh = None
+    step_fn = None
+    unshard_fn = None
     if moe:
-        from tpu_dist_nn.parallel.expert_parallel import MoEConfig
+        # One dispatch site for the whole MoE family: config, init,
+        # train-step factory, eval, and the EP shard/unshard pair.
+        from tpu_dist_nn.parallel.expert_parallel import (
+            MoEConfig,
+            ep_shard_blocks,
+            ep_unshard_blocks,
+            init_moe_transformer,
+        )
+        from tpu_dist_nn.train.lm_trainer import (
+            evaluate_moe_lm,
+            make_moe_lm_train_step,
+        )
 
         cfg = MoEConfig(
             **common, n_experts=args.experts,
             capacity_factor=args.capacity_factor,
         )
+        init_fn, eval_fn = init_moe_transformer, evaluate_moe_lm
+        ep, dp = args.expert_parallel, args.data_parallel
+        if ep > 1 or dp > 1:
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+
+            if args.batch_size % (ep * dp):
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible "
+                    f"by expert_parallel*data_parallel={ep * dp}"
+                )
+            ep_mesh = build_mesh(MeshSpec(expert=ep, data=dp))
+            step_fn = lambda opt: make_moe_lm_train_step(cfg, opt, ep_mesh)  # noqa: E731
+            # The EP executor always expects the ep_shard_blocks layout,
+            # including the degenerate ep=1 case (leading shard dim of 1).
+            unshard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=ep_unshard_blocks(p["blocks"])
+            )
+        else:
+            step_fn = lambda opt: make_moe_lm_train_step(cfg, opt)  # noqa: E731
     else:
         cfg = TransformerConfig(**common)
+        init_fn, eval_fn = init_transformer, evaluate_lm
+        if args.stages > 1:
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+
+            mesh = build_mesh(
+                MeshSpec(stage=args.stages, data=args.data_parallel)
+            )
+
     text, source = load_corpus(args.corpus)
     tokens = encode(text)
     rows = lm_sequences(tokens, args.seq_len)
     split = max(1, int(len(rows) * 0.95))
     train_rows, eval_rows = rows[:split], rows[split:]
-    if moe:
-        from tpu_dist_nn.parallel.expert_parallel import init_moe_transformer
-
-        params = init_moe_transformer(jax.random.key(args.seed), cfg)
-    else:
-        params = init_transformer(jax.random.key(args.seed), cfg)
+    params = init_fn(jax.random.key(args.seed), cfg)
+    if unshard_fn is not None:  # EP mesh path: apply the shard layout
+        params = dict(
+            params,
+            blocks=ep_shard_blocks(params["blocks"], args.expert_parallel),
+        )
     log.info(
         "tiny-transformer%s: %d params, corpus=%s, %d train rows, %d eval rows",
         f" (MoE x{args.experts})" if moe else "",
         num_params(params), source, len(train_rows), len(eval_rows),
     )
-
-    mesh = None
-    step_fn = None
-    if moe and args.expert_parallel > 1:
-        from tpu_dist_nn.parallel.expert_parallel import ep_shard_blocks
-        from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
-        from tpu_dist_nn.train.lm_trainer import make_moe_lm_train_step
-
-        ep_mesh = build_mesh(
-            MeshSpec(expert=args.expert_parallel, data=args.data_parallel)
-        )
-        params = dict(
-            params,
-            blocks=ep_shard_blocks(params["blocks"], args.expert_parallel),
-        )
-        step_fn = lambda opt: make_moe_lm_train_step(cfg, opt, ep_mesh)  # noqa: E731
-    elif moe:
-        from tpu_dist_nn.train.lm_trainer import make_moe_lm_train_step
-
-        step_fn = lambda opt: make_moe_lm_train_step(cfg, opt)  # noqa: E731
-    elif args.stages > 1:
-        from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
-
-        mesh = build_mesh(
-            MeshSpec(stage=args.stages, data=args.data_parallel)
-        )
     train_cfg = LMTrainConfig(
         learning_rate=args.lr, steps=args.steps,
         batch_size=args.batch_size, seq_len=args.seq_len,
@@ -292,10 +301,8 @@ def cmd_lm(args) -> int:
         checkpoints=checkpoints, step_fn=step_fn,
     )
     train_seconds = time.monotonic() - t0
-    if moe and args.expert_parallel > 1:
-        from tpu_dist_nn.parallel.expert_parallel import ep_unshard_blocks
-
-        params = dict(params, blocks=ep_unshard_blocks(params["blocks"]))
+    if unshard_fn is not None:
+        params = unshard_fn(params)
     for h in history:
         log.info("step %d: loss %.4f (%.2fs)", h["step"], h["loss"], h["seconds"])
     held_out = len(eval_rows) >= args.batch_size
@@ -305,18 +312,10 @@ def cmd_lm(args) -> int:
             "over the FULL dataset (includes training rows)",
             len(eval_rows), args.batch_size,
         )
-    if moe:
-        from tpu_dist_nn.train.lm_trainer import evaluate_moe_lm
-
-        eval_metrics = evaluate_moe_lm(
-            params, cfg, eval_rows if held_out else rows,
-            batch_size=args.batch_size,
-        )
-    else:
-        eval_metrics = evaluate_lm(
-            params, cfg, eval_rows if held_out else rows,
-            batch_size=args.batch_size,
-        )
+    eval_metrics = eval_fn(
+        params, cfg, eval_rows if held_out else rows,
+        batch_size=args.batch_size,
+    )
     print(json.dumps({
         "train_seconds": round(train_seconds, 2),
         "final_train_loss": history[-1]["loss"] if history else None,
