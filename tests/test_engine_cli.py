@@ -230,3 +230,15 @@ def test_cli_lm_moe_data_parallel_without_ep(capsys):
     assert rc == 0
     metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert metrics["perplexity"] > 1
+
+
+def test_cli_lm_sample_bytes(capsys):
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--seq-len", "32", "--steps", "2", "--batch-size", "4",
+        "--sample-bytes", "8", "--temperature", "0",
+    ])
+    assert rc == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # 8 bytes decode to at most 8 chars (multi-byte UTF-8 collapses).
+    assert isinstance(metrics["sample"], str) and 0 < len(metrics["sample"]) <= 8

@@ -204,6 +204,19 @@ def cmd_lm(args) -> int:
                          "(MoE pipelines are not implemented)")
     if not moe and args.expert_parallel > 1:
         raise ValueError("--expert-parallel requires --experts > 0")
+    if args.sample_bytes > 0:
+        # Validate the whole sampling request BEFORE training so a bad
+        # flag combination can't discard a long run.
+        if moe:
+            raise ValueError("--sample-bytes supports the dense LM only")
+        if args.temperature < 0:
+            raise ValueError("--temperature must be >= 0")
+        prompt_len = len(encode(args.prompt or "The "))
+        if prompt_len >= args.seq_len:
+            raise ValueError(
+                f"--prompt is {prompt_len} bytes but must be shorter than "
+                f"--seq-len {args.seq_len} to leave room for generation"
+            )
 
     common = dict(
         vocab_size=256,  # byte-level
@@ -316,12 +329,34 @@ def cmd_lm(args) -> int:
         params, cfg, eval_rows if held_out else rows,
         batch_size=args.batch_size,
     )
-    print(json.dumps({
+    report = {
         "train_seconds": round(train_seconds, 2),
         "final_train_loss": history[-1]["loss"] if history else None,
         "eval_split": "held-out" if held_out else "full-dataset",
         **{k: round(v, 4) for k, v in eval_metrics.items()},
-    }))
+    }
+    if args.sample_bytes > 0:
+        import jax.numpy as jnp
+
+        from tpu_dist_nn.data.text import decode as decode_text
+        from tpu_dist_nn.models.generate import generate
+
+        prompt = encode(args.prompt or "The ")[None, :]
+        n = min(args.sample_bytes, cfg.max_seq_len - prompt.shape[1])
+        # One compiled program for the whole prefill+decode loop —
+        # eager dispatch would pay a host->device round trip per op.
+        sample_fn = jax.jit(
+            lambda p, t, k: generate(
+                p, cfg, t, n, temperature=args.temperature, key=k
+            )
+        )
+        out = sample_fn(
+            params, jnp.asarray(prompt), jax.random.key(args.seed)
+        )
+        # Raw bytes decode UTF-8 with replacement, so the string may be
+        # shorter than n bytes when multi-byte sequences collapse.
+        report["sample"] = decode_text(np.asarray(out[0]))
+    print(json.dumps(report))
     return 0
 
 
@@ -408,6 +443,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir",
                    help="save per-interval training state here and resume")
     p.add_argument("--keep-checkpoints", type=int, default=3)
+    p.add_argument("--sample-bytes", type=int, default=0,
+                   help="generate this many bytes after training")
+    p.add_argument("--prompt", help="generation prompt (default 'The ')")
+    p.add_argument("--temperature", type=float, default=0.8,
+                   help="0 = greedy")
     p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")

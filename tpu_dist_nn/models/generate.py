@@ -1,0 +1,141 @@
+"""Autoregressive decoding with a static KV cache.
+
+The reference serves only feed-forward classifiers; the transformer
+family adds next-token generation, built TPU-first:
+
+* **Static shapes throughout**: the KV cache is a fixed
+  ``(L, B, max_len, H, Dh)`` buffer written with
+  ``lax.dynamic_update_slice``; the decode loop is one ``lax.scan``
+  over ``max_new_tokens`` steps — one compile regardless of prompt or
+  generation length.
+* **Prefill + decode split**: the prompt runs through the full batched
+  forward once (MXU-shaped matmuls), recording each layer's K/V from
+  the shared attention sublayer; per-token decode then attends a
+  single query against the cache.
+* **Sampling**: greedy at ``temperature == 0`` (exact argmax of the
+  full forward — tested against the teacher-forced oracle), else
+  softmax sampling with an explicit PRNG key.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    attn_sublayer,
+    embed,
+    ffn_sublayer,
+    layer_norm,
+    unembed,
+)
+
+
+def prefill(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
+            max_len: int):
+    """Run the prompt ``(B, T)``, filling a ``max_len`` cache.
+
+    Returns ``(logits (B, T, V), cache)`` — the caller samples from
+    ``logits[:, T-1]`` and decodes from position ``T``.
+    """
+    params = cfg.cast_params(params)
+    B, T = tokens.shape
+    if T > max_len:
+        raise ValueError(f"prompt length {T} exceeds cache length {max_len}")
+    x = embed(params, tokens)
+
+    def body(carry, block):
+        y, k, v = attn_sublayer(block, carry, cfg, return_kv=True)
+        return ffn_sublayer(block, y), (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    pad = [(0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0)]
+    cache = {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
+    return unembed(params, x), cache
+
+
+def decode_step(params: dict, cache: dict, pos, token: jnp.ndarray,
+                cfg: TransformerConfig):
+    """One decode step: ``token (B,) int32`` at position ``pos``.
+
+    Returns ``(logits (B, V), cache)`` with the cache updated at
+    ``pos``. Attention masks positions ``> pos`` (the rest of the
+    buffer is zero-filled future space).
+    """
+    params = cfg.cast_params(params)
+    B = token.shape[0]
+    H, Dh = cfg.n_heads, cfg.head_dim
+    M = cache["k"].shape[2]
+    x = params["tok_embed"][token][:, None, :] + params["pos_embed"][pos][None, None, :]
+
+    def body(carry, inputs):
+        x = carry
+        block, k_cache, v_cache = inputs
+        h = layer_norm(x, block["ln1_g"], block["ln1_b"])
+        qkv = h @ block["w_qkv"] + block["b_qkv"]
+        q, k, v = jnp.split(qkv.reshape(B, 1, 3 * H, Dh), 3, axis=2)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            k_cache.astype(jnp.float32),
+        ) / np.sqrt(Dh)
+        live = jnp.arange(M) <= pos
+        scores = jnp.where(live[None, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache).reshape(B, 1, H * Dh)
+        x = x + o @ block["w_o"] + block["b_o"]
+        return ffn_sublayer(block, x), (k_cache, v_cache)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    return unembed(params, x)[:, 0], {"k": ks, "v": vs}
+
+
+def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             key: jax.Array | None = None):
+    """Generate ``(B, max_new_tokens)`` continuations of ``prompt (B, T)``.
+
+    Greedy when ``temperature == 0`` (no key needed), else samples from
+    ``softmax(logits / temperature)`` using ``key``. Total length
+    ``T + max_new_tokens`` must fit ``cfg.max_seq_len`` (positional
+    table). jit-compatible: static ``max_new_tokens``/``temperature``.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    total = T + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {T} + new {max_new_tokens} exceeds max_seq_len "
+            f"{cfg.max_seq_len}"
+        )
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
+
+    logits, cache = prefill(params, prompt, cfg, max_len=total)
+
+    def sample(logits, k):
+        if temperature == 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    first = sample(logits[:, T - 1], key)
+
+    def body(carry, step_key):
+        cache, token, pos = carry
+        logits, cache = decode_step(params, cache, pos, token, cfg)
+        nxt = sample(logits, step_key)
+        return (cache, nxt, pos + 1), token
+
+    keys = jax.random.split(jax.random.fold_in(key, 1), max_new_tokens)
+    (_, _, _), out = lax.scan(body, (cache, first, jnp.int32(T)), keys)
+    return jnp.swapaxes(out, 0, 1)  # (B, max_new_tokens)

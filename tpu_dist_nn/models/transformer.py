@@ -138,12 +138,14 @@ def dot_product_attention(q, k, v, *, causal: bool):
 
 
 def attn_sublayer(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
-                  attn_fn=dot_product_attention) -> jnp.ndarray:
+                  attn_fn=dot_product_attention, *, return_kv: bool = False):
     """Pre-LN attention sublayer with residual: ``(B, T, D) -> (B, T, D)``.
 
     Shared by the dense block and the MoE block
     (:mod:`tpu_dist_nn.parallel.expert_parallel`), which differ only in
-    their FFN sublayer.
+    their FFN sublayer. ``return_kv`` additionally returns this
+    sublayer's ``(k, v)`` ``(B, T, H, Dh)`` tensors — the KV-cache fill
+    for autoregressive decoding (:mod:`tpu_dist_nn.models.generate`).
     """
     B, T, D = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
@@ -151,7 +153,16 @@ def attn_sublayer(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
     qkv = h @ block["w_qkv"] + block["b_qkv"]
     q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, Dh), 3, axis=2)
     o = attn_fn(q, k, v, causal=cfg.causal).reshape(B, T, D)
-    return x + o @ block["w_o"] + block["b_o"]
+    y = x + o @ block["w_o"] + block["b_o"]
+    return (y, k, v) if return_kv else y
+
+
+def ffn_sublayer(block: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Pre-LN GELU MLP sublayer with residual — shared by the batched
+    block and the KV-cached decode step (``models.generate``)."""
+    h = layer_norm(x, block["ln2_g"], block["ln2_b"])
+    h = jax.nn.gelu(h @ block["w_up"] + block["b_up"])
+    return x + h @ block["w_down"] + block["b_down"]
 
 
 def block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
@@ -161,10 +172,7 @@ def block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
     ``block`` holds *unstacked* leaves (no leading layer axis) — a scan
     carry slice single-chip, or one stage's shard in the pipeline.
     """
-    x = attn_sublayer(block, x, cfg, attn_fn)
-    h = layer_norm(x, block["ln2_g"], block["ln2_b"])
-    h = jax.nn.gelu(h @ block["w_up"] + block["b_up"])
-    return x + h @ block["w_down"] + block["b_down"]
+    return ffn_sublayer(block, attn_sublayer(block, x, cfg, attn_fn))
 
 
 def embed(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
