@@ -80,6 +80,23 @@ def test_generate_bounds_and_key_requirements():
         generate(params, CFG, _prompt(1, 40), 20)
     with pytest.raises(ValueError, match="PRNG key"):
         generate(params, CFG, _prompt(1, 4), 4, temperature=0.5)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(params, CFG, _prompt(1, 4), 4, temperature=-0.5)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(params, CFG, _prompt(1, 4), 0)
+    import dataclasses
+
+    noncausal = dataclasses.replace(CFG, causal=False)
+    with pytest.raises(ValueError, match="causal"):
+        generate(params, noncausal, _prompt(1, 4), 4)
+
+
+def test_generate_single_token():
+    params = init_transformer(jax.random.key(1), CFG)
+    prompt = _prompt(2, 8, seed=2)
+    got = generate(params, CFG, prompt, 1)
+    want = jnp.argmax(forward(params, prompt, CFG)[:, -1], -1)
+    np.testing.assert_array_equal(np.asarray(got[:, 0]), np.asarray(want))
 
 
 def test_decode_step_updates_cache_in_place_positions():

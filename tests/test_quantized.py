@@ -1,0 +1,79 @@
+"""Int8 quantized inference: dequant error bounds, jnp-vs-Pallas exact
+agreement, closeness to the f32 forward, and end-to-end classifier
+accuracy parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.data.datasets import synthetic_mnist
+from tpu_dist_nn.kernels.quantized import (
+    fcnn_quantized_forward,
+    forward_quantized,
+    quantize_fcnn,
+)
+from tpu_dist_nn.models.fcnn import forward, init_fcnn
+
+
+def _params_and_x(sizes=(24, 32, 16, 4), batch=64, seed=0):
+    params = init_fcnn(jax.random.key(seed), list(sizes))
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 1, (batch, sizes[0])).astype(np.float32)
+    return params, jnp.asarray(x)
+
+
+def test_weight_quantization_roundtrip_error_bounded():
+    params, _ = _params_and_x()
+    q = quantize_fcnn(params)
+    for p, qp in zip(params, q):
+        w = np.asarray(p["w"], np.float32)
+        deq = np.asarray(qp["wq"], np.float32) * np.asarray(qp["scale"])
+        # Symmetric int8: max error <= scale/2 per channel.
+        bound = np.broadcast_to(
+            np.asarray(qp["scale"])[None, :] * 0.5 + 1e-8, w.shape
+        )
+        np.testing.assert_array_less(np.abs(w - deq), bound)
+        assert qp["wq"].dtype == jnp.int8
+
+
+def test_quantized_forward_close_to_f32():
+    params, x = _params_and_x()
+    q = quantize_fcnn(params)
+    ref = forward(params, x)
+    got = forward_quantized(q, x)
+    # Probabilities (softmax outputs) should agree to ~1e-2.
+    assert float(jnp.max(jnp.abs(got - ref))) < 2e-2
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(got), -1), np.argmax(np.asarray(ref), -1)
+    )
+
+
+def test_pallas_chain_matches_jnp_reference_exactly():
+    params, x = _params_and_x(batch=100)  # ragged vs block_b
+    q = quantize_fcnn(params)
+    ref = forward_quantized(q, x)
+    got = fcnn_quantized_forward(q, x, block_b=32)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_quantized_classifier_accuracy_parity():
+    # Train a small f32 classifier, quantize, and check accuracy holds.
+    from tpu_dist_nn.train.trainer import TrainConfig, train_fcnn
+
+    data = synthetic_mnist(800, num_classes=4, dim=24, noise=0.25, seed=0)
+    train, test = data.split(0.8, seed=1)
+    params = init_fcnn(jax.random.key(0), [24, 32, 4])
+    params, _ = train_fcnn(params, train, TrainConfig(epochs=20, batch_size=32))
+
+    x = jnp.asarray(test.x, jnp.float32)
+    acc_f32 = float(
+        np.mean(np.argmax(np.asarray(forward(params, x)), -1) == test.y)
+    )
+    q = quantize_fcnn(params)
+    acc_q = float(
+        np.mean(np.argmax(np.asarray(fcnn_quantized_forward(q, x)), -1) == test.y)
+    )
+    assert acc_f32 > 0.85
+    assert acc_q >= acc_f32 - 0.02  # int8 costs at most 2 points

@@ -64,6 +64,8 @@ def cmd_up(args) -> int:
         x, y = load_examples(args.inputs)
         result = engine.run_inference(x[:1])
         print(json.dumps({"smoke_inference": result.outputs[0].tolist()}))
+    if args.probe_latency:
+        print(json.dumps({"step_latency": engine.step_latency()}))
     return 0
 
 
@@ -211,11 +213,17 @@ def cmd_lm(args) -> int:
             raise ValueError("--sample-bytes supports the dense LM only")
         if args.temperature < 0:
             raise ValueError("--temperature must be >= 0")
-        prompt_len = len(encode(args.prompt or "The "))
+        prompt_len = len(encode(args.prompt))
         if prompt_len >= args.seq_len:
             raise ValueError(
                 f"--prompt is {prompt_len} bytes but must be shorter than "
                 f"--seq-len {args.seq_len} to leave room for generation"
+            )
+        if args.sample_bytes > args.seq_len - prompt_len:
+            raise ValueError(
+                f"--sample-bytes {args.sample_bytes} does not fit: the "
+                f"{prompt_len}-byte prompt leaves {args.seq_len - prompt_len} "
+                f"positions within --seq-len {args.seq_len}"
             )
 
     common = dict(
@@ -341,8 +349,8 @@ def cmd_lm(args) -> int:
         from tpu_dist_nn.data.text import decode as decode_text
         from tpu_dist_nn.models.generate import generate
 
-        prompt = encode(args.prompt or "The ")[None, :]
-        n = min(args.sample_bytes, cfg.max_seq_len - prompt.shape[1])
+        prompt = encode(args.prompt)[None, :]
+        n = args.sample_bytes  # validated to fit before training
         # One compiled program for the whole prefill+decode loop —
         # eager dispatch would pay a host->device round trip per op.
         sample_fn = jax.jit(
@@ -385,6 +393,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("up", help="validate, place, compile (orchestrator)")
     _add_up_args(p)
+    p.add_argument("--probe-latency", action="store_true",
+                   help="report p50/p90/p99 pipeline step latency "
+                        "(the BASELINE per-stage metric)")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
@@ -445,7 +456,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep-checkpoints", type=int, default=3)
     p.add_argument("--sample-bytes", type=int, default=0,
                    help="generate this many bytes after training")
-    p.add_argument("--prompt", help="generation prompt (default 'The ')")
+    p.add_argument("--prompt", default="The ", help="generation prompt")
     p.add_argument("--temperature", type=float, default=0.8,
                    help="0 = greedy")
     p.set_defaults(fn=cmd_lm)

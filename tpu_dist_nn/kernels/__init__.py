@@ -16,10 +16,18 @@ from tpu_dist_nn.kernels.flash_attention import (
     default_attn_fn,
     flash_attention,
 )
+from tpu_dist_nn.kernels.quantized import (
+    fcnn_quantized_forward,
+    forward_quantized,
+    quantize_fcnn,
+)
 
 __all__ = [
     "default_attn_fn",
     "fcnn_fused_forward",
+    "fcnn_quantized_forward",
     "flash_attention",
+    "forward_quantized",
     "fused_dense",
+    "quantize_fcnn",
 ]

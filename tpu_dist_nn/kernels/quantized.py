@@ -1,0 +1,157 @@
+"""Int8 quantized inference: weight quantization + fused Pallas chain.
+
+The reference serves float64 weights through proto rows
+(``dist_nn.proto:5-7``); this module adds the TPU-native low-precision
+serving path the reference has no analogue for:
+
+* **Per-output-channel symmetric int8 weights** — ``scale_j =
+  max|W[:, j]| / 127``; int8 halves HBM traffic vs bf16 and quadruples
+  the weight capacity of the VMEM-resident fused chain.
+* **Dynamic per-row activation quantization** — each sample gets its
+  own scale (``max|x_i| / 127``), computed on the fly; the matmul runs
+  int8 x int8 -> int32 on the MXU (``preferred_element_type``), then
+  rescales to f32 for bias + activation.
+* **One fused kernel for the whole chain** (mirroring
+  :mod:`tpu_dist_nn.kernels.fused_dense`): int8 weights resident in
+  VMEM, inter-layer activations never touch HBM, activation re-quant
+  between layers inside the kernel.
+
+The jnp reference path (:func:`forward_quantized`) computes the exact
+same arithmetic; the Pallas chain is tested for exact agreement with
+it, and both for closeness to the f32 forward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from tpu_dist_nn.core.activations import ACTIVATION_NAMES
+from tpu_dist_nn.kernels.fused_dense import (
+    _apply_named_activation,
+    _interpret,
+    chain_fits_vmem,
+)
+
+
+def quantize_fcnn(params) -> list[dict]:
+    """f32 FCNN params -> per-layer ``{"wq" int8, "scale" f32 (Dout,),
+    "b" f32, "act"}`` with symmetric per-output-channel scales."""
+    out = []
+    for p in params:
+        w = np.asarray(p["w"], np.float32)
+        absmax = np.maximum(np.abs(w).max(axis=0), 1e-8)
+        scale = (absmax / 127.0).astype(np.float32)
+        wq = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        out.append(
+            {
+                "wq": jnp.asarray(wq),
+                "scale": jnp.asarray(scale),
+                "b": jnp.asarray(np.asarray(p["b"], np.float32)),
+                "act": p["act"],
+            }
+        )
+    return out
+
+
+def _quantize_rows(x: jnp.ndarray):
+    """Per-row symmetric int8: -> (x_q int8, row_scale f32 (M, 1))."""
+    absmax = jnp.maximum(jnp.max(jnp.abs(x), axis=-1, keepdims=True), 1e-8)
+    s = absmax / 127.0
+    xq = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return xq, s
+
+
+def _int8_layer(x, wq, scale, b, act_name):
+    """One quantized layer on f32 input ``x``: int8 MXU matmul + rescale."""
+    xq, sx = _quantize_rows(x)
+    z = jax.lax.dot_general(
+        xq, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = z.astype(jnp.float32) * (sx * scale[None, :]) + b
+    return _apply_named_activation(y, act_name)
+
+
+def forward_quantized(qparams: Sequence[dict], x: jnp.ndarray,
+                      activations: Sequence[str] | None = None) -> jnp.ndarray:
+    """jnp reference path: the exact arithmetic of the fused kernel."""
+    if activations is None:
+        activations = tuple(ACTIVATION_NAMES[int(p["act"])] for p in qparams)
+    x = x.astype(jnp.float32)
+    for p, act in zip(qparams, activations):
+        x = _int8_layer(x, p["wq"], p["scale"], p["b"], act)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Fused whole-chain kernel
+# ---------------------------------------------------------------------------
+
+def _chain_kernel(x_ref, *refs, activations: Sequence[str]):
+    *wsb_refs, o_ref = refs
+    h = x_ref[:].astype(jnp.float32)
+    for li, act in enumerate(activations):
+        wq = wsb_refs[3 * li][:]
+        scale = wsb_refs[3 * li + 1][:]
+        b = wsb_refs[3 * li + 2][:]
+        h = _int8_layer(h, wq, scale, b, act)
+    o_ref[:] = h
+
+
+def quantized_chain_fits_vmem(qparams) -> bool:
+    return chain_fits_vmem(
+        [{"w": p["wq"], "b": p["b"]} for p in qparams]
+    )
+
+
+def fcnn_quantized_forward(qparams, x, *,
+                           activations: Sequence[str] | None = None,
+                           block_b: int = 512):
+    """Whole int8 chain in one Pallas kernel per batch tile.
+
+    Every layer's int8 weights are VMEM-resident (4x the capacity of
+    the f32 chain); activations quantize/rescale between layers without
+    leaving VMEM. Falls back to the jnp path when the weights exceed
+    the VMEM budget.
+    """
+    if activations is None:
+        activations = tuple(ACTIVATION_NAMES[int(p["act"])] for p in qparams)
+    else:
+        activations = tuple(activations)
+    if not quantized_chain_fits_vmem(qparams):
+        return forward_quantized(qparams, x, activations)
+    return _quantized_chain_call(
+        tuple((p["wq"].shape, p["b"].shape) for p in qparams),
+        activations,
+        min(block_b, x.shape[0]),
+        x,
+        *[t for p in qparams for t in (p["wq"], p["scale"], p["b"])],
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("wb_shapes", "activations", "block_b")
+)
+def _quantized_chain_call(wb_shapes, activations, block_b, x, *wsbs):
+    M = x.shape[0]
+    out_dim = wb_shapes[-1][0][1]
+    grid = (pl.cdiv(M, block_b),)
+    in_specs = [pl.BlockSpec((block_b, x.shape[1]), lambda i: (i, 0))]
+    for w_shape, b_shape in wb_shapes:
+        in_specs.append(pl.BlockSpec(w_shape, lambda i: (0, 0)))  # wq
+        in_specs.append(pl.BlockSpec(b_shape, lambda i: (0,)))  # scale
+        in_specs.append(pl.BlockSpec(b_shape, lambda i: (0,)))  # b
+    return pl.pallas_call(
+        functools.partial(_chain_kernel, activations=activations),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_b, out_dim), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, out_dim), jnp.float32),
+        interpret=_interpret(),
+    )(x, *wsbs)

@@ -107,6 +107,14 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
     total = T + max_new_tokens
+    if not cfg.causal:
+        raise ValueError(
+            "generation requires a causal model (decode_step always "
+            "masks future positions; cfg.causal=False would disagree "
+            "with the prefill logits)"
+        )
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if total > cfg.max_seq_len:
         raise ValueError(
             f"prompt {T} + new {max_new_tokens} exceeds max_seq_len "
@@ -119,7 +127,8 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
     if key is None:
         key = jax.random.key(0)  # unused on the greedy path
 
-    logits, cache = prefill(params, prompt, cfg, max_len=total)
+    # The last decode writes position T + N - 2; size the cache exactly.
+    logits, cache = prefill(params, prompt, cfg, max_len=total - 1)
 
     def sample(logits, k):
         if temperature == 0:
@@ -129,13 +138,17 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
         ).astype(jnp.int32)
 
     first = sample(logits[:, T - 1], key)
+    if max_new_tokens == 1:
+        return first[:, None]
 
     def body(carry, step_key):
         cache, token, pos = carry
         logits, cache = decode_step(params, cache, pos, token, cfg)
         nxt = sample(logits, step_key)
-        return (cache, nxt, pos + 1), token
+        return (cache, nxt, pos + 1), nxt
 
-    keys = jax.random.split(jax.random.fold_in(key, 1), max_new_tokens)
-    (_, _, _), out = lax.scan(body, (cache, first, jnp.int32(T)), keys)
-    return jnp.swapaxes(out, 0, 1)  # (B, max_new_tokens)
+    keys = jax.random.split(jax.random.fold_in(key, 1), max_new_tokens - 1)
+    (_, _, _), rest = lax.scan(body, (cache, first, jnp.int32(T)), keys)
+    return jnp.concatenate(
+        [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+    )  # (B, max_new_tokens)
