@@ -5,6 +5,7 @@ accuracy parity."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_dist_nn.data.datasets import synthetic_mnist
 from tpu_dist_nn.kernels.quantized import (
@@ -77,3 +78,74 @@ def test_quantized_classifier_accuracy_parity():
     )
     assert acc_f32 > 0.85
     assert acc_q >= acc_f32 - 0.02  # int8 costs at most 2 points
+
+
+def test_engine_serves_quantized(tmp_path):
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.models.fcnn import spec_from_params
+    from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+    params, x = _params_and_x(batch=20)
+    acts = ["relu", "relu", "softmax"]
+    model = spec_from_params(params, acts)
+    p = tmp_path / "m.json"
+    save_model(model, p)
+
+    ref = Engine.up(p).infer(np.asarray(x))
+    eng = Engine.up(p, quantize="int8")
+    got = eng.infer(np.asarray(x))
+    np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
+    assert float(np.max(np.abs(got - ref))) < 2e-2
+
+    with pytest.raises(InvalidArgumentError, match="single-chip"):
+        Engine.up(p, [1, 1, 1], quantize="int8")
+    with pytest.raises(InvalidArgumentError, match="unknown quantize"):
+        Engine.up(p, quantize="int4")
+
+
+def test_cli_infer_quantized(tmp_path, capsys):
+    from tpu_dist_nn.cli import main as cli_main
+    from tpu_dist_nn.core.schema import save_examples, save_model
+    from tpu_dist_nn.models.fcnn import spec_from_params
+
+    params, x = _params_and_x(batch=10)
+    model = spec_from_params(params, ["relu", "relu", "softmax"])
+    mp = tmp_path / "m.json"
+    save_model(model, mp)
+    ip = tmp_path / "e.json"
+    save_examples(np.asarray(x), np.zeros(len(x), np.int64), ip)
+    rc = cli_main([
+        "infer", "--config", str(mp), "--inputs", str(ip),
+        "--batch-size", "4", "--quantize", "int8",
+    ])
+    assert rc == 0
+    assert "Total inference time" in capsys.readouterr().out
+
+
+def test_engine_quantized_serves_trained_weights(tmp_path):
+    # After train(), the int8 path must track the new weights, not the
+    # bring-up copy.
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.models.fcnn import spec_from_params
+    from tpu_dist_nn.train.trainer import TrainConfig
+
+    data = synthetic_mnist(600, num_classes=4, dim=24, noise=0.25, seed=0)
+    train, test = data.split(0.8, seed=1)
+    params = init_fcnn(jax.random.key(5), [24, 16, 4])
+    model = spec_from_params(params, ["relu", "softmax"])
+    p = tmp_path / "m.json"
+    save_model(model, p)
+
+    eng = Engine.up(p, quantize="int8")
+    before = float(
+        np.mean(eng.infer(test.x).argmax(-1) == test.y)
+    )
+    eng.train(train, TrainConfig(epochs=15, batch_size=32))
+    after = float(
+        np.mean(eng.infer(test.x).argmax(-1) == test.y)
+    )
+    assert after > before + 0.2  # training must reach the served path
+    eng.down()
+    assert eng._q is None

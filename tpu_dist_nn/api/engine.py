@@ -87,7 +87,22 @@ class Engine:
     """A brought-up model: placed, compiled, ready to serve or train."""
 
     def __init__(self, model: ModelSpec, distribution, mesh_spec: MeshSpec,
-                 num_microbatches: int, dtype, devices=None):
+                 num_microbatches: int, dtype, devices=None,
+                 quantize: str | None = None):
+        # Fail fast on quantize mode/placement BEFORE building any
+        # placement state (matches up()'s fail-fast convention).
+        if quantize is not None:
+            from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+            if quantize != "int8":
+                raise InvalidArgumentError(
+                    f"unknown quantize mode {quantize!r}; supported: 'int8'"
+                )
+            if mesh_spec.stage > 1 or mesh_spec.data > 1 or not model.is_dense:
+                raise InvalidArgumentError(
+                    "quantize='int8' currently serves dense models on the "
+                    "single-chip executor (no pipeline/conv/data-parallel)"
+                )
         # Copy metadata so export()'s annotations never mutate a
         # ModelSpec the caller still holds.
         self.model = ModelSpec(model.layers, dict(model.metadata))
@@ -113,6 +128,15 @@ class Engine:
                 self._plan, self._params = build_network(model, dtype)
             if self.data_sharded:
                 self._params = jax.device_put(self._params, replicated(self.mesh))
+        self._q = None  # int8 serving path (quantize="int8")
+        self._quantize = quantize
+        # Static activation names: passed explicitly on the hot path so
+        # infer() never reads act ids back from the device.
+        self._act_names = tuple(l.activation for l in model.layers)
+        if quantize is not None:
+            from tpu_dist_nn.kernels.quantized import quantize_fcnn
+
+            self._q = quantize_fcnn(self._params)
         self.setup_seconds: float | None = None
 
     # ---------------------------------------------------------------- up
@@ -128,11 +152,14 @@ class Engine:
         dtype=jnp.float32,
         devices=None,
         warmup: bool = True,
+        quantize: str | None = None,
     ) -> "Engine":
         """Validate, place, compile; returns a ready engine.
 
         ``model`` is a path or a ModelSpec. Bring-up wall time lands in
         ``engine.setup_seconds`` (run_grpc_fcnn.py:321-322 parity).
+        ``quantize="int8"`` serves the dense chain through the fused
+        int8 Pallas path (f32 masters kept for train/export).
         """
         t0 = time.monotonic()
         if not isinstance(model, ModelSpec):
@@ -170,7 +197,8 @@ class Engine:
         if mesh_spec.stage == 1:
             distribution = [len(model.layers)]
 
-        engine = cls(model, distribution, mesh_spec, num_microbatches, dtype, devices)
+        engine = cls(model, distribution, mesh_spec, num_microbatches, dtype,
+                     devices, quantize=quantize)
         if warmup:
             # Compilation is the readiness check (the analogue of the
             # orchestrator's TCP poll, run_grpc_fcnn.py:157-172).
@@ -228,6 +256,15 @@ class Engine:
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
             return np.asarray(out)
+        if self._q is not None:
+            from tpu_dist_nn.kernels.quantized import fcnn_quantized_forward
+
+            return np.asarray(
+                fcnn_quantized_forward(
+                    self._q, jnp.asarray(x, jnp.float32),
+                    activations=self._act_names,
+                )
+            )
         apply = (
             jitted_forward
             if self._plan is None
@@ -356,6 +393,12 @@ class Engine:
                 for l, t in zip(self.model.layers, trained)
             ]
             self.model = ModelSpec(new_layers, dict(self.model.metadata))
+        if self._q is not None:
+            # Re-quantize so the int8 serving path tracks the trained
+            # weights (it would otherwise serve the pre-training copy).
+            from tpu_dist_nn.kernels.quantized import quantize_fcnn
+
+            self._q = quantize_fcnn(self._params)
         return history
 
     # ------------------------------------------------------------ export
@@ -380,6 +423,7 @@ class Engine:
         relaunch contract, run_grpc_fcnn.py:329-344)."""
         self._pp = None
         self._params = None
+        self._q = None
 
     # ------------------------------------------------------------ health
 

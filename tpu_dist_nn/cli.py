@@ -40,6 +40,9 @@ def _add_up_args(p):
     p.add_argument("--distribution", help="layer distribution, e.g. 1,1,1")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--microbatches", type=int, default=4)
+    p.add_argument("--quantize", choices=["int8"],
+                   help="serve through the fused int8 kernel "
+                        "(dense single-chip only)")
 
 
 def _engine_from_args(args, warmup=True):
@@ -51,6 +54,7 @@ def _engine_from_args(args, warmup=True):
         data_parallel=getattr(args, "data_parallel", 1),
         num_microbatches=getattr(args, "microbatches", 4),
         warmup=warmup,
+        quantize=getattr(args, "quantize", None),
     )
 
 
@@ -214,6 +218,8 @@ def cmd_lm(args) -> int:
         if args.temperature < 0:
             raise ValueError("--temperature must be >= 0")
         prompt_len = len(encode(args.prompt))
+        if prompt_len == 0:
+            raise ValueError("--prompt must be non-empty")
         if prompt_len >= args.seq_len:
             raise ValueError(
                 f"--prompt is {prompt_len} bytes but must be shorter than "
