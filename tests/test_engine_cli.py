@@ -242,3 +242,15 @@ def test_cli_lm_sample_bytes(capsys):
     metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     # 8 bytes decode to at most 8 chars (multi-byte UTF-8 collapses).
     assert isinstance(metrics["sample"], str) and 0 < len(metrics["sample"]) <= 8
+
+
+def test_serve_loop_tears_down(model_file):
+    # The orchestrator supervisor-loop parity (run_grpc_fcnn.py:326-344):
+    # bounded run for the test, then a clean, idempotent teardown.
+    from tpu_dist_nn.cli import _serve_loop
+    from tpu_dist_nn.utils.errors import UnavailableError
+
+    engine = Engine.up(model_file)
+    _serve_loop(engine, max_seconds=0.3)
+    with pytest.raises(UnavailableError):
+        engine.infer(np.zeros((1, 12)))

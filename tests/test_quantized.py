@@ -149,3 +149,21 @@ def test_engine_quantized_serves_trained_weights(tmp_path):
     assert after > before + 0.2  # training must reach the served path
     eng.down()
     assert eng._q is None
+
+
+def test_quantize_collapses_metadata_distribution(tmp_path):
+    # A pipelined export carries layer_distribution metadata; quantized
+    # serving must collapse it (same behavior on any device count), while
+    # an explicit pipeline request still conflicts.
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.models.fcnn import spec_from_params
+
+    params, x = _params_and_x(batch=8)
+    model = spec_from_params(params, ["relu", "relu", "softmax"])
+    model.metadata["layer_distribution"] = [1, 1, 1]
+    p = tmp_path / "m.json"
+    save_model(model, p)
+    eng = Engine.up(p, quantize="int8")
+    assert not eng.pipelined
+    assert eng.infer(np.asarray(x)).shape == (8, 4)

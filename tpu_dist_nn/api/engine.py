@@ -129,7 +129,6 @@ class Engine:
             if self.data_sharded:
                 self._params = jax.device_put(self._params, replicated(self.mesh))
         self._q = None  # int8 serving path (quantize="int8")
-        self._quantize = quantize
         # Static activation names: passed explicitly on the hot path so
         # infer() never reads act ids back from the device.
         self._act_names = tuple(l.activation for l in model.layers)
@@ -164,9 +163,21 @@ class Engine:
         t0 = time.monotonic()
         if not isinstance(model, ModelSpec):
             model = load_model(model)
+        explicit_distribution = distribution is not None
         if distribution is None:
             distribution = model.metadata.get("layer_distribution")
         if distribution is None:
+            distribution = [len(model.layers)]
+        if quantize is not None and len(distribution) > 1 and not explicit_distribution:
+            # A metadata-carried multi-stage plan (written by a pipelined
+            # export) must not make `--quantize` fail only on hosts with
+            # enough devices to honor it — int8 serving is single-chip,
+            # so collapse and say so. An *explicit* pipeline request
+            # still conflicts and is rejected in __init__.
+            log.info(
+                "placement: ignoring metadata layer_distribution %s — "
+                "quantize='int8' serves single-chip", distribution,
+            )
             distribution = [len(model.layers)]
         # Fail fast on an invalid plan (run_grpc_fcnn.py:182-183).
         partition_model(model, distribution)

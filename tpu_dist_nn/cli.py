@@ -58,6 +58,21 @@ def _engine_from_args(args, warmup=True):
     )
 
 
+def _serve_loop(engine, max_seconds: float | None = None) -> None:
+    """Supervisor loop: stay up until SIGINT, then tear down cleanly —
+    the reference orchestrator's main loop (run_grpc_fcnn.py:326-344).
+    ``max_seconds`` bounds the loop for tests."""
+    t0 = time.monotonic()
+    try:
+        while max_seconds is None or time.monotonic() - t0 < max_seconds:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        log.info("interrupt received; tearing down")
+    finally:
+        engine.down()
+        log.info("engine down; relaunch with `tdn up` (stateless restart)")
+
+
 def cmd_up(args) -> int:
     engine = _engine_from_args(args)
     print(json.dumps({"ready": True, "setup_seconds": engine.setup_seconds,
@@ -70,6 +85,8 @@ def cmd_up(args) -> int:
         print(json.dumps({"smoke_inference": result.outputs[0].tolist()}))
     if args.probe_latency:
         print(json.dumps({"step_latency": engine.step_latency()}))
+    if args.serve:
+        _serve_loop(engine)
     return 0
 
 
@@ -402,6 +419,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--probe-latency", action="store_true",
                    help="report p50/p90/p99 pipeline step latency "
                         "(the BASELINE per-stage metric)")
+    p.add_argument("--serve", action="store_true",
+                   help="stay up until Ctrl-C, then tear down "
+                        "(the reference orchestrator's supervisor loop)")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
