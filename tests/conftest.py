@@ -22,3 +22,17 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compile cache: the suite's wall time is dominated by
+# recompiling the same shard_map/scan programs every run. Per-user path
+# so shared machines don't collide on ownership.
+import getpass  # noqa: E402
+import tempfile  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(
+        tempfile.gettempdir(), f"tdn_jax_cache_{getpass.getuser()}"
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

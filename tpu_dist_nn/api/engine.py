@@ -179,6 +179,17 @@ class Engine:
                 "quantize='int8' serves single-chip", distribution,
             )
             distribution = [len(model.layers)]
+        if quantize is not None and (len(distribution) > 1 or data_parallel > 1):
+            # Reject the explicit conflict HERE, before the device-count
+            # collapse below could silently turn a multi-stage request
+            # into a single-chip one on small hosts — the outcome must
+            # not depend on how many devices happen to be visible.
+            from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "quantize='int8' currently serves dense models on the "
+                "single-chip executor (no pipeline/conv/data-parallel)"
+            )
         # Fail fast on an invalid plan (run_grpc_fcnn.py:182-183).
         partition_model(model, distribution)
 
