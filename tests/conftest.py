@@ -25,14 +25,12 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compile cache: the suite's wall time is dominated by
 # recompiling the same shard_map/scan programs every run. Per-user path
 # so shared machines don't collide on ownership.
-import getpass  # noqa: E402
 import tempfile  # noqa: E402
 
+_user = os.environ.get("USER") or os.environ.get("LOGNAME") or str(os.getuid())
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.join(
-        tempfile.gettempdir(), f"tdn_jax_cache_{getpass.getuser()}"
-    ),
+    os.path.join(tempfile.gettempdir(), f"tdn_jax_cache_{_user}"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -114,10 +114,12 @@ def test_conv_training_learns():
 
 
 def test_engine_routes_conv_model(conv_model):
-    # A pipelined placement request on a conv model falls back to the
-    # single-program executor rather than the dense SPMD pipeline.
+    # A pipelined placement request on a conv model runs on the
+    # heterogeneous per-stage executor (not the dense SPMD pipeline,
+    # whose uniform-shape shard_map can't carry shrinking feature maps).
     engine = Engine.up(conv_model, [3, 3])
-    assert not engine.pipelined
+    assert engine.pipelined
+    assert engine.placement()["stage_kinds"][0][0] == "conv2d"
     x = np.random.default_rng(5).uniform(size=(4, conv_model.input_dim))
     got = engine.infer(x)
     want = oracle_forward_batch(conv_model, x)

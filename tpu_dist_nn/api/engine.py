@@ -116,7 +116,17 @@ class Engine:
         # over the data axis, params replicated.
         self.data_sharded = not self.pipelined and mesh_spec.data > 1
         self._plan = None  # mixed-layer (conv/pool) networks only
-        if self.pipelined:
+        self._hp = None  # heterogeneous (non-dense) pipeline executor
+        if self.pipelined and not model.is_dense:
+            from tpu_dist_nn.parallel.hetero_pipeline import HeteroPipeline
+
+            self._hp = HeteroPipeline(
+                model, self.distribution,
+                devices=list(self.mesh.devices.flat), dtype=dtype,
+            )
+            self._pp = None
+            self._params = None
+        elif self.pipelined:
             stages = partition_model(model, self.distribution)
             self._pp = build_pipeline_params(stages, dtype)
             self._params = None
@@ -195,17 +205,14 @@ class Engine:
 
         n_devices = len(devices or jax.devices())
         stages = len(distribution)
-        if stages > 1 and not model.is_dense:
-            # The uniform-width SPMD pipeline executor only covers dense
-            # chains; conv/pool models run single-chip or data-parallel
-            # (per-stage heterogeneous pipelining is a planned executor).
+        if stages > 1 and not model.is_dense and data_parallel > 1:
+            # The heterogeneous executor pins one stage per device and
+            # has no data axis; pipeline placement wins.
             log.info(
-                "placement: model has non-dense layers; using the "
-                "single-program executor instead of %d pipeline stages",
-                stages,
+                "placement: non-dense pipeline ignores data_parallel=%d",
+                data_parallel,
             )
-            distribution = [len(model.layers)]
-            stages = 1
+            data_parallel = 1
         if stages * data_parallel > n_devices:
             log.info(
                 "placement: %d stages x %d data shards exceed %d device(s); "
@@ -237,7 +244,9 @@ class Engine:
             "data_parallel": self.mesh_spec.data,
             "pipelined": self.pipelined,
         }
-        if self.pipelined:
+        if self._hp is not None:
+            base.update(self._hp.placement_summary())
+        elif self.pipelined:
             base.update(pipeline_spec_summary(self._pp))
         else:
             base.update(
@@ -262,7 +271,7 @@ class Engine:
         """
         from tpu_dist_nn.utils.errors import UnavailableError, check_input_dim
 
-        if self._pp is None and self._params is None:
+        if self._pp is None and self._params is None and self._hp is None:
             raise UnavailableError(
                 "engine is down; relaunch with Engine.up from the model JSON"
             )
@@ -273,6 +282,9 @@ class Engine:
         elif x.size != in_dim:
             check_input_dim(in_dim, int(x.size), stage=0)
         x = x.reshape(-1, in_dim)
+        if self._hp is not None:
+            mb = max(1, len(x) // self.num_microbatches)
+            return self._hp.forward(x, microbatch_size=mb)
         if self.pipelined:
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
@@ -383,6 +395,24 @@ class Engine:
         turns on epoch-level save + resume for whichever trainer flavor
         this engine's placement selects.
         """
+        if self._hp is not None:
+            # The heterogeneous executor serves inference only; train on
+            # the single-program executor and re-place the stages after
+            # (keeps train working for any placement — the outcome must
+            # not depend on how the engine happened to be placed).
+            from tpu_dist_nn.parallel.hetero_pipeline import HeteroPipeline
+
+            plan, params = build_network(self.model, self.dtype)
+            params, history = train_network(
+                plan, params, train_data, config,
+                eval_data=eval_data, checkpoints=checkpoints,
+            )
+            self.model = network_model_from_params(self.model, params)
+            self._hp = HeteroPipeline(
+                self.model, self.distribution,
+                devices=list(self.mesh.devices.flat), dtype=self.dtype,
+            )
+            return history
         if self.pipelined:
             self._pp, history = train_pipelined(
                 self._pp,
@@ -446,13 +476,18 @@ class Engine:
         self._pp = None
         self._params = None
         self._q = None
+        self._hp = None
 
     # ------------------------------------------------------------ health
 
     def health(self) -> dict:
         """Structured readiness report — the reference's TCP readiness
         poll (run_grpc_fcnn.py:157-172) as an inspectable status."""
-        ready = self._pp is not None or self._params is not None
+        ready = (
+            self._pp is not None
+            or self._params is not None
+            or self._hp is not None
+        )
         status = {
             "ready": ready,
             "devices": self.mesh_spec.num_devices,

@@ -1,0 +1,105 @@
+"""Heterogeneous pipeline: mixed-layer (conv/pool/dense) models across
+devices with non-uniform inter-stage shapes.
+
+The SPMD GPipe executor (:mod:`tpu_dist_nn.parallel.pipeline`) requires
+uniform per-stage programs (one shard_map body), which rules out conv
+models whose feature-map shapes shrink stage to stage. This executor is
+the single-controller alternative, closest in spirit to the reference's
+container-per-stage chain (``run_grpc_fcnn.py:83-155``) but with the
+Docker/gRPC substrate replaced by device placement + async dispatch:
+
+* each stage is its own jitted program with its params committed to its
+  device (stage i -> ``devices[i]``);
+* the hand-off is ``jax.device_put`` of the flat activation batch —
+  a device-to-device copy, no serialization (SURVEY.md §2.4);
+* microbatches are dispatched eagerly: JAX's async dispatch lets
+  microbatch m+1 run stage i while microbatch m runs stage i+1 — the
+  GPipe overlap without an SPMD schedule.
+
+Inference-only by design: the reference's pipeline is inference-only
+(SURVEY.md §2.3), and conv training runs on the single-program executor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.core.schema import ModelSpec, validate_distribution
+from tpu_dist_nn.models.network import build_network, jitted_network_forward
+
+
+class HeteroPipeline:
+    """Per-stage placement of a mixed-layer model.
+
+    ``distribution[i]`` layers are pinned to ``devices[i]``; activations
+    hand off as committed device arrays between consecutive stages.
+    """
+
+    def __init__(self, model: ModelSpec, distribution, devices=None,
+                 dtype=jnp.float32):
+        validate_distribution(distribution, len(model.layers))
+        if devices is None:
+            devices = jax.devices()
+        if len(distribution) > len(devices):
+            raise ValueError(
+                f"{len(distribution)} stages need as many devices; "
+                f"only {len(devices)} available"
+            )
+        self.distribution = list(distribution)
+        self.devices = list(devices[: len(distribution)])
+        self.out_dim = model.output_dim
+        self._dtype = dtype
+        self.stages = []
+        idx = 0
+        for n, dev in zip(distribution, self.devices):
+            sub = ModelSpec(model.layers[idx : idx + n])
+            plan, params = build_network(sub, dtype)
+            self.stages.append(
+                {
+                    "plan": plan,
+                    "params": jax.device_put(params, dev),
+                    "device": dev,
+                }
+            )
+            idx += n
+
+    def forward(self, x, *, microbatch_size: int | None = None) -> np.ndarray:
+        """``x (B, in_dim)`` -> ``(B, out_dim)`` through the chain.
+
+        With ``microbatch_size`` the batch is split and every chunk's
+        stage calls are dispatched before any result is awaited, so
+        chunks overlap across stages.
+        """
+        x = np.asarray(x, np.float32)
+        if len(x) == 0:
+            return np.zeros((0, self.out_dim), np.float32)
+        chunks = (
+            [x]
+            if microbatch_size is None
+            else [
+                x[i : i + microbatch_size]
+                for i in range(0, len(x), microbatch_size)
+            ]
+        )
+        outs = []
+        for chunk in chunks:
+            # One host->device transfer, then cast to the serving dtype
+            # on the first stage's device.
+            h = jax.device_put(chunk, self.stages[0]["device"]).astype(self._dtype)
+            for stage in self.stages:
+                h = jax.device_put(h, stage["device"])
+                h = jitted_network_forward(stage["plan"])(stage["params"], h)
+            outs.append(h)  # don't block: let later chunks overlap
+        return np.concatenate([np.asarray(o) for o in outs])
+
+    def placement_summary(self) -> dict:
+        return {
+            "num_stages": len(self.stages),
+            "stage_devices": [str(s["device"]) for s in self.stages],
+            "stage_layers": self.distribution,
+            "stage_kinds": [
+                [p.kind for p in s["plan"]] for s in self.stages
+            ],
+        }
