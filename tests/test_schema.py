@@ -144,3 +144,24 @@ def test_chain_dim_mismatch_raises():
     model.layers[1].biases = np.zeros(3)
     with pytest.raises(ValueError):
         partition_model(model, [1, 1])
+
+
+def test_shipped_sample_configs_load_and_run():
+    """The repo ships config samples (reference C12,
+    config/config_sample.json:1-33) usable exactly as the README
+    quickstart shows: load, forward via the oracle, sane softmax out."""
+    from pathlib import Path
+
+    from tpu_dist_nn.testing.oracle import oracle_forward_batch
+
+    root = Path(__file__).resolve().parents[1]
+    model = load_model(root / "config" / "config_sample.json")
+    x, labels = load_examples(
+        root / "config" / "example_inputs" / "example_inputs_sample.json"
+    )
+    assert model.input_dim == x.shape[1]
+    out = oracle_forward_batch(model, x)
+    assert out.shape == (len(x), model.output_dim)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-6)
+    assert len(labels) == len(x)
+    assert all(0 <= int(l) < model.output_dim for l in labels)

@@ -132,7 +132,11 @@ def cmd_infer(args) -> int:
 
 def cmd_train(args) -> int:
     from tpu_dist_nn.core.schema import load_model
-    from tpu_dist_nn.data.datasets import load_mnist_idx, synthetic_mnist
+    from tpu_dist_nn.data.datasets import (
+        load_mnist_idx,
+        synthetic_fashion_mnist,
+        synthetic_mnist,
+    )
     from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
     from tpu_dist_nn.train.trainer import TrainConfig
     import jax
@@ -162,8 +166,9 @@ def cmd_train(args) -> int:
             )
         full = Dataset(x, y, int(y.max()) + 1)
         data, eval_data = full.split(0.9, seed=args.seed)
-    else:  # synthetic
-        full = synthetic_mnist(
+    else:  # synthetic | fashion
+        make = synthetic_fashion_mnist if args.data == "fashion" else synthetic_mnist
+        full = make(
             args.num_examples, dim=model.input_dim,
             num_classes=model.output_dim, seed=args.seed,
         )
@@ -440,7 +445,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--layers", default="784,128,64,10",
                    help="fresh model sizes (generate_mnist_pytorch.py:25-27)")
     p.add_argument("--data", default="synthetic",
-                   help="synthetic | idx:DIR | json:FILE")
+                   help="synthetic | fashion | idx:DIR | json:FILE")
     p.add_argument("--num-examples", type=int, default=12000)
     p.add_argument("--distribution")
     p.add_argument("--data-parallel", type=int, default=1)
