@@ -1,0 +1,122 @@
+"""Torch interop: state dict ↔ ModelSpec parity (reference C8 toolchain,
+generate_mnist_pytorch.py:68-103 — the exporter, made real)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from tpu_dist_nn.core.schema import load_model  # noqa: E402
+from tpu_dist_nn.interop import (  # noqa: E402
+    model_from_torch_state_dict,
+    model_to_torch_state_dict,
+)
+from tpu_dist_nn.testing.factories import random_model  # noqa: E402
+from tpu_dist_nn.testing.oracle import oracle_forward_batch  # noqa: E402
+
+
+def _torch_fcnn(sizes):
+    layers = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        layers.append(torch.nn.Linear(a, b))
+        if i < len(sizes) - 2:
+            layers.append(torch.nn.ReLU())
+    return torch.nn.Sequential(*layers)
+
+
+def test_torch_forward_parity():
+    # The reference's torch model size (generate_mnist_pytorch.py:25-27)
+    # at test scale: torch softmax(logits) == oracle forward.
+    torch.manual_seed(0)
+    net = _torch_fcnn([20, 12, 8, 5])
+    model = model_from_torch_state_dict(net.state_dict())
+    assert model.layer_sizes == [20, 12, 8, 5]
+    assert [l.activation for l in model.layers] == ["relu", "relu", "softmax"]
+    assert model.layers[-1].type_tag == "output"
+
+    x = np.random.default_rng(0).uniform(0, 1, (9, 20)).astype(np.float32)
+    with torch.no_grad():
+        want = torch.softmax(net(torch.from_numpy(x)), dim=1).numpy()
+    got = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_torch_round_trip():
+    model = random_model([7, 6, 4], seed=5)
+    state = model_to_torch_state_dict(model)
+    back = model_from_torch_state_dict(
+        state, [l.activation for l in model.layers]
+    )
+    for a, b in zip(model.layers, back.layers):
+        np.testing.assert_allclose(a.weights, b.weights)
+        np.testing.assert_allclose(a.biases, b.biases)
+        assert a.activation == b.activation
+
+
+def test_state_dict_prefix_and_non_linear_keys_ignored():
+    torch.manual_seed(1)
+    net = torch.nn.Sequential(
+        torch.nn.Linear(6, 5), torch.nn.LayerNorm(5), torch.nn.Linear(5, 3)
+    )
+    model = model_from_torch_state_dict(net.state_dict())
+    # LayerNorm's 1-D weight/bias are skipped; two Linears imported.
+    assert model.layer_sizes == [6, 5, 3]
+
+
+def test_conv_state_dict_rejected():
+    net = torch.nn.Conv2d(3, 8, 3)
+    with pytest.raises(ValueError, match="conv"):
+        model_from_torch_state_dict(net.state_dict())
+
+
+def test_activation_count_mismatch():
+    net = _torch_fcnn([4, 3, 2])
+    with pytest.raises(ValueError, match="activations"):
+        model_from_torch_state_dict(net.state_dict(), ["relu"])
+
+
+def test_broken_chain_rejected():
+    state = {
+        "a.weight": torch.zeros(3, 4), "a.bias": torch.zeros(3),
+        "b.weight": torch.zeros(2, 9), "b.bias": torch.zeros(2),
+    }
+    with pytest.raises(ValueError, match="chain"):
+        model_from_torch_state_dict(state)
+
+
+def test_cli_import_torch(tmp_path):
+    from tpu_dist_nn.cli import main
+
+    torch.manual_seed(2)
+    net = _torch_fcnn([10, 6, 4])
+    pt = tmp_path / "net.pt"
+    torch.save(net.state_dict(), pt)
+    out = tmp_path / "model.json"
+    assert main(["import-torch", "--state-dict", str(pt), "--out", str(out)]) == 0
+    model = load_model(out)
+    assert model.layer_sizes == [10, 6, 4]
+
+    x = np.random.default_rng(1).uniform(0, 1, (5, 10)).astype(np.float32)
+    with torch.no_grad():
+        want = torch.softmax(net(torch.from_numpy(x)), dim=1).numpy()
+    np.testing.assert_allclose(
+        oracle_forward_batch(model, x), want, rtol=1e-5, atol=1e-7
+    )
+
+
+def test_conv1d_state_dict_rejected():
+    net = torch.nn.Conv1d(3, 8, 5)
+    with pytest.raises(ValueError, match="conv-style"):
+        model_from_torch_state_dict(net.state_dict())
+
+
+def test_unknown_activation_rejected_at_import():
+    net = _torch_fcnn([4, 3, 2])
+    with pytest.raises(ValueError, match="unknown activations"):
+        model_from_torch_state_dict(net.state_dict(), ["relu", "softmx"])
+
+
+def test_activation_names_stripped():
+    net = _torch_fcnn([4, 3, 2])
+    model = model_from_torch_state_dict(net.state_dict(), ["relu ", " Softmax"])
+    assert [l.activation for l in model.layers] == ["relu", "softmax"]

@@ -415,6 +415,33 @@ def cmd_oracle(args) -> int:
     return 0
 
 
+def cmd_import_torch(args) -> int:
+    """Convert a torch state dict (.pt) to the public model JSON —
+    the reference's commented-out exporter made real
+    (generate_mnist_pytorch.py:68-103)."""
+    try:
+        import torch
+    except ImportError as e:
+        raise ValueError(
+            f"import-torch needs pytorch installed ({e}); pip install torch"
+        ) from e
+
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.interop import model_from_torch_state_dict
+
+    state = torch.load(args.state_dict, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]  # common checkpoint wrapper
+    acts = args.activations.split(",") if args.activations else None
+    model = model_from_torch_state_dict(state, acts)
+    save_model(model, args.out)
+    log.info(
+        "imported %d dense layers (%s) to %s",
+        len(model.layers), "-".join(map(str, model.layer_sizes)), args.out,
+    )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="tdn", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -439,6 +466,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace here")
     p.set_defaults(fn=cmd_infer)
+
+    p = sub.add_parser("import-torch",
+                       help="torch state dict (.pt) -> model JSON")
+    p.add_argument("--state-dict", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--activations",
+                   help="comma list, one per dense layer "
+                        "(default: relu...softmax, the reference tagging)")
+    p.set_defaults(fn=cmd_import_torch)
 
     p = sub.add_parser("train", help="native on-TPU training")
     p.add_argument("--config", help="start from an existing model JSON")

@@ -1,0 +1,133 @@
+"""PyTorch state-dict ↔ JSON-schema model conversion.
+
+The reference trains its MNIST FCNN in torch and (in commented-out
+code, ``scripts/generate_mnist_pytorch.py:68-103``) exports per-neuron
+``{"weights", "bias", "activation"}`` JSON with relu tagging on hidden
+layers and softmax on the output — the same tagging the shipped model
+uses (notebook cell 10). This module is that exporter made real and
+bidirectional, so torch-trained weights drop straight into the TPU
+pipeline and TPU-trained models load back into torch for comparison.
+
+Torch ``nn.Linear`` stores ``weight`` as ``(out_features, in_features)``;
+the schema stores ``(in_dim, out_dim)`` (``grpc_node.py:51`` transpose
+rule), so each weight matrix is transposed on the way through.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from tpu_dist_nn.core.schema import LayerSpec, ModelSpec
+
+
+def _dense_pairs(state_dict: Mapping) -> list[tuple[str, np.ndarray, np.ndarray]]:
+    """Extract ordered (name, weight(out,in), bias(out,)) Linear triples."""
+    pairs = []
+    for key in state_dict:
+        if key != "weight" and not key.endswith(".weight"):
+            continue
+        base = key[: -len("weight")].rstrip(".")
+        w = np.asarray(state_dict[key].detach().cpu().numpy()
+                       if hasattr(state_dict[key], "detach")
+                       else state_dict[key], dtype=np.float64)
+        if w.ndim > 2:
+            raise ValueError(
+                f"{key}: {w.ndim}-D (conv-style) weights are not importable "
+                "from a bare state dict — conv layers need "
+                "in_shape/stride/padding; export them via the JSON schema's "
+                "conv2d layer type instead"
+            )
+        if w.ndim != 2:
+            continue  # 1-D norm scales etc.
+        bias_key = f"{base}.bias" if base else "bias"
+        if bias_key not in state_dict:
+            raise ValueError(f"{base}: Linear layer without a bias "
+                             "(the schema requires per-neuron biases)")
+        b = np.asarray(state_dict[bias_key].detach().cpu().numpy()
+                       if hasattr(state_dict[bias_key], "detach")
+                       else state_dict[bias_key], dtype=np.float64)
+        pairs.append((base, w, b))
+    if not pairs:
+        raise ValueError("state dict contains no Linear (2-D weight) layers")
+    return pairs
+
+
+def model_from_torch_state_dict(
+    state_dict: Mapping,
+    activations: Sequence[str] | None = None,
+) -> ModelSpec:
+    """Convert a torch state dict (or any name→array mapping) to a
+    :class:`ModelSpec`.
+
+    ``activations`` optionally names one activation per dense layer;
+    the default is the reference exporter's tagging — relu on hidden
+    layers, softmax on the output (``generate_mnist_pytorch.py:30-32``
+    + notebook cell 10).
+    """
+    from tpu_dist_nn.core.activations import ACTIVATION_IDS
+
+    pairs = _dense_pairs(state_dict)
+    n = len(pairs)
+    if activations is None:
+        activations = ["relu"] * (n - 1) + ["softmax"]
+    else:
+        # Inference treats unknown names as linear (reference parity,
+        # grpc_node.py:72-73); a user-*supplied* name is validated here
+        # instead, so a typo fails at import rather than silently
+        # serving raw logits.
+        activations = [a.strip().lower() for a in activations]
+        unknown = [a for a in activations if a not in ACTIVATION_IDS]
+        if unknown:
+            raise ValueError(
+                f"unknown activations {unknown}; "
+                f"known: {sorted(ACTIVATION_IDS)}"
+            )
+    if len(activations) != n:
+        raise ValueError(
+            f"got {len(activations)} activations for {n} dense layers"
+        )
+    layers = []
+    for i, ((name, w, b), act) in enumerate(zip(pairs, activations)):
+        if i and w.shape[1] != layers[-1].out_dim:
+            raise ValueError(
+                f"{name}: in_features {w.shape[1]} does not chain from "
+                f"previous layer's out_dim {layers[-1].out_dim}"
+            )
+        layers.append(
+            LayerSpec(
+                weights=w.T.copy(),  # (in, out) — grpc_node.py:51
+                biases=b.copy(),
+                activation=act,
+                type_tag="output" if i == n - 1 else "hidden",
+            )
+        )
+    model = ModelSpec(layers=layers)
+    model.validate_chain()
+    return model
+
+
+def model_to_torch_state_dict(model: ModelSpec):
+    """Inverse conversion: dense :class:`ModelSpec` → an OrderedDict of
+    torch tensors with keys ``layers.{i}.weight/bias`` (weights back to
+    torch's (out, in) layout) — loadable into a module whose Linears
+    live in ``self.layers = nn.ModuleList([...])``, or re-keyed by the
+    caller for other module shapes. Round-trips exactly through
+    :func:`model_from_torch_state_dict` (which matches by order, not
+    name)."""
+    import collections
+
+    import torch
+
+    if not model.is_dense:
+        raise ValueError("only all-dense models convert to Linear stacks")
+    out = collections.OrderedDict()
+    for i, layer in enumerate(model.layers):
+        out[f"layers.{i}.weight"] = torch.from_numpy(
+            np.ascontiguousarray(layer.weights.T)
+        )
+        out[f"layers.{i}.bias"] = torch.from_numpy(
+            np.ascontiguousarray(layer.biases)
+        )
+    return out
