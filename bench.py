@@ -6,9 +6,9 @@ Baseline: the reference's best recorded number — centralized batched
 Keras inference over 60 000 MNIST samples in 4.5490 s, ~76 us/sample =
 13 190 samples/s (notebook cell 9; BASELINE.md). Same workload shape
 here: the reference's torch model size (784-128-64-10,
-generate_mnist_pytorch.py:25-27), 60 000 examples fed host->device
-through the async prefetch queue, end-to-end wall time including
-transfers (matching what the reference measured).
+generate_mnist_pytorch.py:25-27), 60 000 examples resident on the host,
+end-to-end wall time including the host->device transfer (one bulk
+uint8 device_put per pass) — matching what the reference measured.
 """
 
 from __future__ import annotations
@@ -26,7 +26,6 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from tpu_dist_nn.data.feed import batch_iterator, device_prefetch
     from tpu_dist_nn.models.fcnn import forward, init_fcnn
 
     n_samples, dim, batch = 60000, 784, 8192
@@ -65,16 +64,21 @@ def main() -> int:
               "using jit chain", file=sys.stderr)
         apply = jit_apply
 
+    # The pass is ~100% host->device transfer-bound (compute for all
+    # 60k rows is ~30 us on a v5e vs ~29 ms for the 47 MB u8 transfer),
+    # so one bulk device_put + one kernel launch beats chunked
+    # prefetch: same bytes, no per-chunk dispatch overhead.
     def run_pass():
-        outs = []
-        for bx in device_prefetch(batch_iterator(x, batch_size=batch), depth=4):
-            outs.append(apply(params, bx))
-        jax.block_until_ready(outs)
-        return outs
+        dx = jax.device_put(x)
+        out = apply(params, dx)
+        jax.block_until_ready(out)
+        return out
 
-    run_pass()  # warmup / compile (two batch shapes: full + remainder)
+    run_pass()  # warmup / compile
+    # Host->device bandwidth through the harness tunnel jitters run to
+    # run; min-of-7 ~30 ms passes gives a stable throughput figure.
     times = []
-    for _ in range(3):
+    for _ in range(7):
         t0 = time.monotonic()
         run_pass()
         times.append(time.monotonic() - t0)
