@@ -1,0 +1,162 @@
+"""Pallas conv2d(+maxpool) kernel parity (BASELINE configs[3]).
+
+Interpreter mode on the CPU test mesh, same as the other kernels; the
+oracle and the lax conv path are the two independent references.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist_nn.kernels.conv2d import fused_conv2d
+
+
+def _lax_conv(imgs, w, b, stride, padding, act):
+    out = lax.conv_general_dilated(
+        imgs, w, window_strides=stride, padding=padding.upper(),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + b
+    if act == "relu":
+        out = jnp.maximum(out, 0.0)
+    return out
+
+
+@pytest.mark.parametrize("padding", ["valid", "same"])
+@pytest.mark.parametrize("stride", [(1, 1), (2, 2)])
+def test_conv_matches_lax(padding, stride):
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.normal(size=(5, 9, 9, 3)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 3, 3, 7)) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(7,)), jnp.float32)
+    got = fused_conv2d(imgs, w, b, stride=stride, padding=padding,
+                       activation="relu")
+    want = _lax_conv(imgs, w, b, stride, padding, "relu")
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_conv_fused_pool_matches_unfused():
+    rng = np.random.default_rng(1)
+    imgs = jnp.asarray(rng.normal(size=(4, 8, 8, 2)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 3, 2, 6)) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(6,)), jnp.float32)
+    got = fused_conv2d(imgs, w, b, padding="valid", activation="relu",
+                       pool_window=(2, 2))
+    conv = _lax_conv(imgs, w, b, (1, 1), "valid", "relu")
+    want = lax.reduce_window(
+        conv, -jnp.inf, lax.max,
+        window_dimensions=(1, 2, 2, 1), window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    )
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_conv_pool_overlapping_stride():
+    rng = np.random.default_rng(2)
+    imgs = jnp.asarray(rng.normal(size=(3, 7, 7, 2)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, 2, 2, 4)) * 0.4, jnp.float32)
+    b = jnp.zeros((4,), jnp.float32)
+    got = fused_conv2d(imgs, w, b, padding="valid", activation="linear",
+                       pool_window=(3, 3), pool_stride=(1, 1))
+    conv = _lax_conv(imgs, w, b, (1, 1), "valid", "linear")
+    want = lax.reduce_window(
+        conv, -jnp.inf, lax.max,
+        window_dimensions=(1, 3, 3, 1), window_strides=(1, 1, 1, 1),
+        padding="VALID",
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_uneven_batch_tiles():
+    rng = np.random.default_rng(3)
+    imgs = jnp.asarray(rng.normal(size=(5, 6, 6, 2)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 3, 2, 3)) * 0.3, jnp.float32)
+    b = jnp.zeros((3,), jnp.float32)
+    got = fused_conv2d(imgs, w, b, padding="valid", activation="relu",
+                       block_b=2)  # 3 tiles, last partial
+    want = _lax_conv(imgs, w, b, (1, 1), "valid", "relu")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_shape_mismatch_rejected():
+    imgs = jnp.zeros((2, 5, 5, 3), jnp.float32)
+    w = jnp.zeros((3, 3, 4, 6), jnp.float32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        fused_conv2d(imgs, w, jnp.zeros((6,), jnp.float32))
+
+
+def test_network_forward_pallas_flag_matches_oracle(monkeypatch):
+    # Route the conv+pool hybrid model through the Pallas path and
+    # check parity against the float64 oracle end-to-end.
+    import tpu_dist_nn.models.network as network
+    from tpu_dist_nn.models.network import (
+        build_network,
+        init_conv_mlp,
+        network_forward,
+    )
+    from tpu_dist_nn.testing.oracle import oracle_forward_batch
+
+    monkeypatch.setattr(network, "_PALLAS_CONV", True)
+    model = init_conv_mlp(
+        jax.random.key(0), in_shape=(8, 8, 2), conv_filters=(4, 5),
+        hidden=(10,), num_classes=3, pool_after_conv=True,
+    )
+    plan, params = build_network(model)
+    x = np.random.default_rng(4).uniform(0, 1, (6, model.input_dim))
+    got = np.asarray(network_forward(plan, params, jnp.asarray(x, jnp.float32)))
+    want = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_overlapping_pool_phase_decimation():
+    # pool_stride > 1 with stride != window: exercises the phase
+    # reshape + tail-concat decimation path (not the stride==1 shortcut).
+    rng = np.random.default_rng(5)
+    imgs = jnp.asarray(rng.normal(size=(3, 11, 11, 2)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, 2, 2, 4)) * 0.4, jnp.float32)
+    b = jnp.zeros((4,), jnp.float32)
+    got = fused_conv2d(imgs, w, b, padding="valid", activation="relu",
+                       pool_window=(3, 3), pool_stride=(2, 2))
+    conv = _lax_conv(imgs, w, b, (1, 1), "valid", "relu")
+    want = lax.reduce_window(
+        conv, -jnp.inf, lax.max,
+        window_dimensions=(1, 3, 3, 1), window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    )
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_training_unaffected_by_pallas_flag(monkeypatch):
+    # TDN_PALLAS_CONV must not break the training entry: pallas_call
+    # has no reverse-mode autodiff, so network_logits stays on lax ops.
+    import tpu_dist_nn.models.network as network
+    from tpu_dist_nn.models.network import (
+        build_network,
+        init_conv_mlp,
+        network_logits,
+    )
+
+    monkeypatch.setattr(network, "_PALLAS_CONV", True)
+    model = init_conv_mlp(
+        jax.random.key(2), in_shape=(6, 6, 2), conv_filters=(3,),
+        hidden=(8,), num_classes=3, pool_after_conv=True,
+    )
+    plan, params = build_network(model)
+    x = jnp.asarray(np.random.default_rng(6).uniform(0, 1, (4, model.input_dim)),
+                    jnp.float32)
+
+    def loss(params):
+        return jnp.mean(network_logits(plan, params, x) ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert any(float(jnp.abs(g).sum()) > 0
+               for layer in grads for g in layer.values())

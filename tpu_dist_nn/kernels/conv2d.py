@@ -1,0 +1,280 @@
+"""Fused conv2d(+maxpool) Pallas kernel — the CIFAR conv stage
+(BASELINE configs[3]; reference conv capability is the JSON conv2d
+layer type, SURVEY.md §2.2 native-equivalents table).
+
+Formulation: one MXU contraction per kernel tap — ``out +=
+patch(i,j) @ W[i,j]`` over the ``kh*kw`` taps, f32 accumulation, bias +
+activation (+ an optional max-pool) applied before the tile leaves
+VMEM.
+
+What this buys over ``lax.conv_general_dilated`` (which XLA also
+lowers onto the MXU): the **conv→pool fusion**. XLA fuses elementwise
+bias/act into a convolution but materializes the pre-pool activation
+tensor to HBM before ``reduce_window``; here pooling happens while the
+activation tile is still in VMEM, so the (B, H, W, F) pre-pool tensor
+never exists in HBM (4x the bytes of the pooled output for 2x2/2).
+
+Mosaic vector-layout constraints shape the implementation — found by
+compiling against a real v5e, not theory:
+
+* **Lanes are channels, always.** Mosaic cannot reshape across the
+  lane (last) dim (``(8, 3468) -> (8, 34, 102)`` is an "unsupported
+  shape cast"), and strided basic indexing lowers to an unsupported
+  >2-D gather. Every tensor here keeps channels in the lane dim so all
+  reshapes split/merge *sublane* dims (supported) and all window
+  slices are contiguous.
+* Blocks come in as ``(bt, H*W, Cin)`` with the batch tile a multiple
+  of 8 (Mosaic block rule); tap patches are ``x4[:, i:i+ho, j:j+wo, :]``
+  contiguous 4-D slices of the sublane-split view.
+* Lane padding to 128 means small-channel stages cost up to
+  ``128/Cin`` extra VMEM; the batch tile is sized from that padded
+  model, and if even the minimum tile cannot fit (large H*W with tiny
+  Cin — e.g. the 32x32x3 CIFAR *input* stage), the call statically
+  falls back to the equivalent XLA path (which is MXU-native anyway).
+  Strided (>1) convolutions also take the XLA path: strided taps
+  cannot be expressed as contiguous slices.
+
+Selection: ``lax`` conv stays the default; set ``TDN_PALLAS_CONV=1``
+to route eligible conv(+pool) layers through this kernel
+(``models/network.py``). Runs interpreted off-TPU like the other
+kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from tpu_dist_nn.kernels.fused_dense import _apply_named_activation, _interpret
+
+# VMEM budget for the statically-modeled working set (blocks with
+# double-buffering + the big temporaries), conservative vs ~16 MB.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _lanes(c: int) -> int:
+    return -(-c // 128) * 128
+
+
+def _sub(n: int) -> int:
+    return -(-n // 8) * 8
+
+
+def _decimate_sub(a, axis, offset, stride, count):
+    """Strided selection along a *sublane* axis via phase reshape +
+    contiguous slices (+ a concatenated tail element when the final
+    stride period runs past the axis end). Never touches lanes."""
+    idx = [slice(None)] * a.ndim
+    if stride == 1:
+        idx[axis] = slice(offset, offset + count)
+        return a[tuple(idx)]
+    r = offset % stride
+    m = (a.shape[axis] - r) // stride
+    idx[axis] = slice(r, r + m * stride)
+    body = a[tuple(idx)]
+    shape = body.shape[:axis] + (m, stride) + body.shape[axis + 1 :]
+    body = body.reshape(shape)
+    idx2 = [slice(None)] * body.ndim
+    idx2[axis + 1] = 0
+    body = body[tuple(idx2)]
+    start = offset // stride
+    if m >= start + count:
+        idx3 = [slice(None)] * body.ndim
+        idx3[axis] = slice(start, start + count)
+        return body[tuple(idx3)]
+    idx3 = [slice(None)] * body.ndim
+    idx3[axis] = slice(start, start + count - 1)
+    main = body[tuple(idx3)]
+    last_ix = offset + (count - 1) * stride
+    idx4 = [slice(None)] * a.ndim
+    idx4[axis] = slice(last_ix, last_ix + 1)
+    return jnp.concatenate([main, a[tuple(idx4)]], axis=axis)
+
+
+def _conv_kernel(x_ref, w_ref, b_ref, o_ref, *, hwc, khw, out_hw, cout,
+                 activation, pool_window, pool_stride):
+    H, W, cin = hwc
+    kh, kw = khw
+    ho, wo = out_hw
+    bt = x_ref.shape[0]
+    # (bt, H*W, cin) -> (bt, H, W, cin): sublane split, lanes intact.
+    x4 = x_ref[:].astype(jnp.float32).reshape(bt, H, W, cin)
+    acc = jnp.zeros((bt * ho * wo, cout), jnp.float32)
+    for i in range(kh):
+        for j in range(kw):
+            patch = x4[:, i : i + ho, j : j + wo, :]
+            tap = w_ref[(i * kw + j) * cin : (i * kw + j + 1) * cin, :]
+            acc += jnp.dot(
+                patch.reshape(bt * ho * wo, cin),
+                tap,
+                preferred_element_type=jnp.float32,
+            )
+    z = acc + b_ref[:].astype(jnp.float32)
+    out = _apply_named_activation(z, activation).reshape(bt, ho, wo, cout)
+    if pool_window is not None:
+        (pwh, pww), (psh, psw) = pool_window, pool_stride
+        pho = (ho - pwh) // psh + 1
+        pwo = (wo - pww) // psw + 1
+        if (psh, psw) == (pwh, pww):
+            # Non-overlapping (the reference default, eff_stride=window):
+            # pure sublane reshape + max-reduce.
+            trimmed = out[:, : pho * psh, : pwo * psw, :]
+            out = trimmed.reshape(bt, pho, psh, pwo, psw, cout).max(axis=(2, 4))
+        else:
+            pooled = jnp.full((bt, pho, pwo, cout), -jnp.inf, jnp.float32)
+            for i in range(pwh):
+                for j in range(pww):
+                    win = _decimate_sub(out, 1, i, psh, pho)
+                    win = _decimate_sub(win, 2, j, psw, pwo)
+                    pooled = jnp.maximum(pooled, win)
+            out = pooled
+        ho, wo = pho, pwo
+    o_ref[:] = out.reshape(bt, ho * wo, cout).astype(o_ref.dtype)
+
+
+def _lax_conv_pool(imgs, w, b, stride, padding, activation, pool_window,
+                   pool_stride):
+    out = lax.conv_general_dilated(
+        imgs, w, window_strides=stride, padding=padding.upper(),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    out = _apply_named_activation(out + b, activation)
+    if pool_window is not None:
+        out = lax.reduce_window(
+            out, -jnp.inf, lax.max,
+            window_dimensions=(1, *pool_window, 1),
+            window_strides=(1, *pool_stride, 1),
+            padding="VALID",
+        )
+    return out
+
+
+def _fit_batch_tile(B, H, W, cin, cout, ho, wo, out_h, out_w):
+    """Largest batch tile (multiple of 8, or B) whose modeled VMEM
+    working set fits the budget; None if even the minimum does not."""
+    def working_set(bt):
+        x_block = bt * _sub(H * W) * _lanes(cin) * 4 * 2  # double-buffered
+        patch = bt * ho * _sub(wo) * _lanes(cin) * 4
+        gemm_in = _sub(bt * ho * wo) * _lanes(cin) * 4
+        acc = _sub(bt * ho * wo) * _lanes(cout) * 4
+        o_block = bt * _sub(out_h * out_w) * _lanes(cout) * 4 * 2
+        return x_block + patch + gemm_in + acc + o_block
+
+    if B < 8:
+        return B if working_set(B) <= _VMEM_BUDGET_BYTES else None
+    bt = max(8, min(B, 256) // 8 * 8)
+    while bt >= 8:
+        if working_set(bt) <= _VMEM_BUDGET_BYTES:
+            return bt
+        if bt == 8:
+            break
+        bt = max(8, bt // 2 // 8 * 8)
+    return None
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "stride", "padding", "activation", "pool_window", "pool_stride",
+        "block_b",
+    ),
+)
+def fused_conv2d(
+    imgs,
+    w,
+    b,
+    *,
+    stride=(1, 1),
+    padding: str = "valid",
+    activation: str = "linear",
+    pool_window=None,
+    pool_stride=None,
+    block_b: int | None = None,
+):
+    """``act(conv2d(imgs, w) + b)`` (then optional maxpool) as one
+    Pallas kernel per batch tile.
+
+    ``imgs: (B, H, W, Cin)`` NHWC; ``w: (kh, kw, Cin, Cout)`` HWIO;
+    ``padding`` "same"|"valid" ('same' pre-pads in XLA — the kernel
+    always computes a valid conv). ``pool_window`` fuses a VALID
+    max-pool before the activation leaves VMEM (``pool_stride``
+    defaults to the window — the reference pool semantics,
+    schema.MaxPool2DSpec.eff_stride). Strided convs and stages whose
+    working set cannot fit VMEM statically fall back to the equivalent
+    XLA path (module docstring).
+    """
+    B, H, W, cin = imgs.shape
+    kh, kw, cin2, cout = w.shape
+    if cin != cin2 or b.shape != (cout,):
+        raise ValueError(
+            f"shape mismatch: imgs{imgs.shape} conv w{w.shape} + b{b.shape}"
+        )
+    sh, sw = stride
+    if pool_window is not None:
+        pool_stride = tuple(pool_stride or pool_window)
+        pool_window = tuple(pool_window)
+
+    if (sh, sw) != (1, 1):
+        return _lax_conv_pool(
+            imgs, w, b, stride, padding, activation, pool_window, pool_stride
+        )
+
+    if padding.lower() == "same":
+        pad_h, pad_w = kh - 1, kw - 1
+        imgs_k = jnp.pad(
+            imgs,
+            ((0, 0), (pad_h // 2, pad_h - pad_h // 2),
+             (pad_w // 2, pad_w - pad_w // 2), (0, 0)),
+        )
+    elif padding.lower() == "valid":
+        imgs_k = imgs
+    else:
+        raise ValueError(f"unsupported padding: {padding!r}")
+    Hk, Wk = imgs_k.shape[1], imgs_k.shape[2]
+    ho, wo = Hk - kh + 1, Wk - kw + 1
+    if pool_window is not None:
+        out_h = (ho - pool_window[0]) // pool_stride[0] + 1
+        out_w = (wo - pool_window[1]) // pool_stride[1] + 1
+    else:
+        out_h, out_w = ho, wo
+
+    bt = block_b if block_b is not None else _fit_batch_tile(
+        B, Hk, Wk, cin, cout, ho, wo, out_h, out_w
+    )
+    if bt is None:
+        return _lax_conv_pool(
+            imgs, w, b, stride, padding, activation, pool_window, pool_stride
+        )
+    bt = min(bt, B)
+    grid = (pl.cdiv(B, bt),)
+    out_dtype = imgs.dtype if jnp.issubdtype(imgs.dtype, jnp.floating) else jnp.float32
+    out = pl.pallas_call(
+        functools.partial(
+            _conv_kernel,
+            hwc=(Hk, Wk, cin),
+            khw=(kh, kw),
+            out_hw=(ho, wo),
+            cout=cout,
+            activation=activation,
+            pool_window=pool_window,
+            pool_stride=pool_stride,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, Hk * Wk, cin), lambda i: (i, 0, 0)),
+            pl.BlockSpec((kh * kw * cin, cout), lambda i: (0, 0)),
+            pl.BlockSpec((cout,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((bt, out_h * out_w, cout), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, out_h * out_w, cout), out_dtype),
+        interpret=_interpret(),
+    )(
+        imgs_k.reshape(B, Hk * Wk, cin),
+        w.reshape(kh * kw * cin, cout),
+        b,
+    )
+    return out.reshape(B, out_h, out_w, cout)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Sequence
 
 import jax
@@ -114,14 +115,54 @@ def _apply_layer(p: LayerPlan, w: dict, x: jnp.ndarray) -> jnp.ndarray:
     raise ValueError(f"unsupported layer kind: {p.kind}")
 
 
+# Opt-in: route conv layers through the Pallas kernel (fusing a
+# directly-following maxpool into the same kernel). Read once at import
+# — the jitted-forward cache is keyed on plans, not on this flag, so a
+# mid-process flip would go stale anyway.
+_PALLAS_CONV = os.environ.get("TDN_PALLAS_CONV", "0") == "1"
+
+
+def _apply_conv_pallas(p: LayerPlan, w: dict, x: jnp.ndarray,
+                       pool: LayerPlan | None) -> jnp.ndarray:
+    from tpu_dist_nn.kernels.conv2d import fused_conv2d
+
+    h, wd, c = p.in_shape
+    out = fused_conv2d(
+        x.reshape(-1, h, wd, c), w["w"], w["b"],
+        stride=p.stride, padding=p.padding.lower(), activation=p.activation,
+        pool_window=pool.window if pool is not None else None,
+        pool_stride=pool.stride if pool is not None else None,
+    )
+    return out.reshape(out.shape[0], -1)
+
+
 def network_forward(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.ndarray:
-    for p, w in zip(plan, params):
-        x = _apply_layer(p, w, x)
+    i = 0
+    while i < len(plan):
+        p = plan[i]
+        if _PALLAS_CONV and p.kind == "conv2d":
+            # A directly-following maxpool fuses into the conv kernel;
+            # shape compatibility was established by the spec's
+            # validate_chain (pool.in_shape == conv out_shape).
+            pool = None
+            if i + 1 < len(plan) and plan[i + 1].kind == "maxpool2d":
+                pool = plan[i + 1]
+            x = _apply_conv_pallas(p, params[i], x, pool)
+            i += 2 if pool is not None else 1
+            continue
+        x = _apply_layer(p, params[i], x)
+        i += 1
     return x
 
 
 def network_logits(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.ndarray:
-    """Forward with the final layer's activation skipped (for CE loss)."""
+    """Forward with the final layer's activation skipped (for CE loss).
+
+    Deliberately does NOT route through the Pallas conv path:
+    ``pallas_call`` has no reverse-mode autodiff, and this is the
+    training entry (wrapped in ``value_and_grad``) — it must stay on
+    pure lax ops regardless of ``TDN_PALLAS_CONV``.
+    """
     for p, w in zip(plan[:-1], params[:-1]):
         x = _apply_layer(p, w, x)
     last = dataclasses.replace(plan[-1], activation="linear")
