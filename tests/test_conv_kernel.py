@@ -160,3 +160,36 @@ def test_training_unaffected_by_pallas_flag(monkeypatch):
     grads = jax.grad(loss)(params)
     assert any(float(jnp.abs(g).sum()) > 0
                for layer in grads for g in layer.values())
+
+
+def test_pallas_path_activation_semantics_match_default(monkeypatch):
+    # Unknown/case-variant activation names: the reference treats them
+    # as linear (grpc_node.py:72-73); the Pallas route must not diverge.
+    import tpu_dist_nn.models.network as network
+    from tpu_dist_nn.core.schema import Conv2DSpec, LayerSpec, ModelSpec
+    from tpu_dist_nn.models.network import build_network, network_forward
+
+    rng = np.random.default_rng(7)
+    conv = Conv2DSpec(
+        in_shape=(6, 6, 2),
+        weights=rng.normal(size=(3, 3, 2, 4)) * 0.3,
+        biases=np.zeros(4),
+        stride=(1, 1),
+        padding="valid",
+        activation="ReLU-Custom",  # unknown -> linear, both paths
+    )
+    dense = LayerSpec(
+        weights=rng.normal(size=(conv.out_dim, 3)) * 0.3,
+        biases=np.zeros(3),
+        activation="softmax",
+        type_tag="output",
+    )
+    model = ModelSpec(layers=[conv, dense])
+    plan, params = build_network(model)
+    x = jnp.asarray(rng.uniform(0, 1, (4, model.input_dim)), jnp.float32)
+
+    monkeypatch.setattr(network, "_PALLAS_CONV", False)
+    want = np.asarray(network_forward(plan, params, x))
+    monkeypatch.setattr(network, "_PALLAS_CONV", True)
+    got = np.asarray(network_forward(plan, params, x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)

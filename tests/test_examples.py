@@ -1,0 +1,30 @@
+"""The shipped examples stay runnable (reference C10 toolchain parity)."""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+
+
+def test_centralized_experiments_smoke(tmp_path, capsys):
+    import centralized_experiments as ce
+
+    from tpu_dist_nn.data.datasets import synthetic_mnist
+
+    full = synthetic_mnist(600, dim=64)
+    data, eval_data = full.split(0.9)
+    acc = ce.experiment_linear_softmax(data, eval_data)
+    assert 0.0 <= acc <= 1.0
+    params, metrics = ce.experiment_serving_mlp(data, eval_data)
+    assert set(metrics) >= {"accuracy", "precision", "recall", "f1_score"}
+    ce.experiment_per_sample_latency(params, eval_data, n=5)
+    out = tmp_path / "model.json"
+    obj = ce.experiment_export(params, metrics, out)
+    assert obj["inference_metrics"] == metrics
+    assert len(obj["layers"]) == 3
+    nbytes = ce.experiment_payload_size(data)
+    assert nbytes == 64 * 8
+    assert "[e]" in capsys.readouterr().out

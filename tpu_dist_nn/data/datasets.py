@@ -6,9 +6,10 @@ a zero-egress environment. Two native paths instead:
 
 * :func:`synthetic_mnist` — a deterministic class-conditional dataset
   with MNIST's exact shapes (784 features, 10 classes, [0,1] range):
-  per-class template patterns mixed nonlinearly with noise, calibrated
-  so an MLP of the reference's sizes separates it to >97 % while a
-  linear model cannot saturate it.
+  per-class template patterns mixed nonlinearly with noise, separable
+  to >97 % by the reference's model sizes (at default noise, by a
+  linear model too — the class templates are distinct directions in
+  784-D; raise ``noise`` to make the task tighter).
 * :func:`load_mnist_idx` — parser for the standard IDX files
   (``train-images-idx3-ubyte`` etc.), so real MNIST drops in when the
   files exist on disk.

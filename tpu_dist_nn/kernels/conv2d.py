@@ -153,16 +153,18 @@ def _lax_conv_pool(imgs, w, b, stride, padding, activation, pool_window,
     return out
 
 
-def _fit_batch_tile(B, H, W, cin, cout, ho, wo, out_h, out_w):
+def _fit_batch_tile(B, H, W, cin, cout, kh, kw, ho, wo, out_h, out_w):
     """Largest batch tile (multiple of 8, or B) whose modeled VMEM
     working set fits the budget; None if even the minimum does not."""
     def working_set(bt):
         x_block = bt * _sub(H * W) * _lanes(cin) * 4 * 2  # double-buffered
+        w_block = _sub(kh * kw * cin) * _lanes(cout) * 4 * 2
+        b_block = _lanes(cout) * 4 * 2
         patch = bt * ho * _sub(wo) * _lanes(cin) * 4
         gemm_in = _sub(bt * ho * wo) * _lanes(cin) * 4
         acc = _sub(bt * ho * wo) * _lanes(cout) * 4
         o_block = bt * _sub(out_h * out_w) * _lanes(cout) * 4 * 2
-        return x_block + patch + gemm_in + acc + o_block
+        return x_block + w_block + b_block + patch + gemm_in + acc + o_block
 
     if B < 8:
         return B if working_set(B) <= _VMEM_BUDGET_BYTES else None
@@ -243,7 +245,7 @@ def fused_conv2d(
         out_h, out_w = ho, wo
 
     bt = block_b if block_b is not None else _fit_batch_tile(
-        B, Hk, Wk, cin, cout, ho, wo, out_h, out_w
+        B, Hk, Wk, cin, cout, kh, kw, ho, wo, out_h, out_w
     )
     if bt is None:
         return _lax_conv_pool(

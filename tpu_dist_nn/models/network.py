@@ -124,12 +124,18 @@ _PALLAS_CONV = os.environ.get("TDN_PALLAS_CONV", "0") == "1"
 
 def _apply_conv_pallas(p: LayerPlan, w: dict, x: jnp.ndarray,
                        pool: LayerPlan | None) -> jnp.ndarray:
+    from tpu_dist_nn.core.activations import ACTIVATION_NAMES, activation_id
     from tpu_dist_nn.kernels.conv2d import fused_conv2d
 
     h, wd, c = p.in_shape
+    # Canonicalize through the activation registry so this path keeps
+    # the default path's semantics (case-insensitive, unknown->linear,
+    # grpc_node.py:72-73) — the kernel's dispatcher raises on names it
+    # doesn't know.
+    act = ACTIVATION_NAMES[activation_id(p.activation)]
     out = fused_conv2d(
         x.reshape(-1, h, wd, c), w["w"], w["b"],
-        stride=p.stride, padding=p.padding.lower(), activation=p.activation,
+        stride=p.stride, padding=p.padding.lower(), activation=act,
         pool_window=pool.window if pool is not None else None,
         pool_stride=pool.stride if pool is not None else None,
     )
