@@ -51,6 +51,7 @@ def experiment_linear_softmax(data, eval_data):
 
 def experiment_per_sample_latency(params, eval_data, n=100):
     """(b) Notebook cell 4: sequential single-sample inference x100."""
+    n = min(n, len(eval_data))
     apply = jax.jit(forward)
     x = jnp.asarray(eval_data.x[:n], jnp.float32)
     jax.block_until_ready(apply(params, x[:1]))  # compile once

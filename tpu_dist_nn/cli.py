@@ -254,6 +254,10 @@ def cmd_lm(args) -> int:
                 f"positions within --seq-len {args.seq_len}"
             )
 
+    if args.remat and moe:
+        # The MoE forward is not scan-based; a silently ignored flag is
+        # worse than an error.
+        raise ValueError("--remat supports the dense LM only")
     common = dict(
         vocab_size=256,  # byte-level
         d_model=args.d_model,
@@ -262,6 +266,7 @@ def cmd_lm(args) -> int:
         d_ff=4 * args.d_model,
         max_seq_len=args.seq_len,
         compute_dtype="bfloat16" if args.bf16 else "float32",
+        remat=args.remat,
     )
     mesh = None
     step_fn = None
@@ -513,6 +518,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", type=int, default=4)
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (f32 master params + CE)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize block activations in the backward "
+                        "(jax.checkpoint per block: long-context memory "
+                        "for ~1/3 more FLOPs)")
     p.add_argument("--experts", type=int, default=0,
                    help="MoE: experts per block (0 = dense MLP)")
     p.add_argument("--capacity-factor", type=float, default=1.25)

@@ -54,6 +54,7 @@ class TransformerConfig:
     max_seq_len: int = 256
     causal: bool = True
     compute_dtype: str = "float32"
+    remat: bool = False
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -175,6 +176,25 @@ def block_apply(block: dict, x: jnp.ndarray, cfg: TransformerConfig,
     return ffn_sublayer(block, attn_sublayer(block, x, cfg, attn_fn))
 
 
+def maybe_remat(cfg: TransformerConfig, apply=block_apply):
+    """``apply`` wrapped in per-block rematerialization when
+    ``cfg.remat`` — the one definition of the trade for every scan body
+    (single-chip, pipelined, ring, tensor-parallel): drop each block's
+    internal activations after the forward, recompute them in the
+    backward. HBM residency falls from O(n_layers * per-block) to one
+    block's worth, bought with ~1/3 more FLOPs (MXU FLOPs are the cheap
+    resource; HBM is the bottleneck). Trailing args of ``apply`` beyond
+    (block, x) must be static (hashable)."""
+    if not cfg.remat:
+        return apply
+    import inspect
+
+    n_args = len(inspect.signature(apply).parameters)
+    return jax.checkpoint(
+        apply, static_argnums=tuple(range(2, n_args)), prevent_cse=False
+    )
+
+
 def embed(params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
     """``tokens: (batch, T) int32 -> (batch, T, D)`` activations."""
     T = tokens.shape[-1]
@@ -198,8 +218,10 @@ def forward(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
     params = cfg.cast_params(params)
     x = embed(params, tokens)
 
+    apply = maybe_remat(cfg)
+
     def body(carry, block):
-        return block_apply(block, carry, cfg, attn_fn), None
+        return apply(block, carry, cfg, attn_fn), None
 
     x, _ = lax.scan(body, x, params["blocks"])
     return unembed(params, x)

@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 from tpu_dist_nn.models.transformer import (
     TransformerConfig,
     block_apply,
+    maybe_remat,
     layer_norm,
 )
 from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_SEQ
@@ -118,8 +119,10 @@ def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig):
         pos = idx * T_loc + jnp.arange(T_loc)
         x = params["tok_embed"][tokens] + params["pos_embed"][pos]
 
+        apply = maybe_remat(cfg)
+
         def body(carry, block):
-            return block_apply(block, carry, cfg, attn_fn=attn_fn), None
+            return apply(block, carry, cfg, attn_fn), None
 
         x, _ = lax.scan(body, x, params["blocks"])
         x = layer_norm(x, params["lnf_g"], params["lnf_b"])

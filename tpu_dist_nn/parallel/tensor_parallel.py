@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from tpu_dist_nn.core.activations import apply_activation_by_id
 from tpu_dist_nn.models.transformer import (
+    maybe_remat,
     TransformerConfig,
     dot_product_attention,
     layer_norm,
@@ -169,8 +170,10 @@ def make_tp_lm_forward(mesh, cfg: TransformerConfig, attn_fn=dot_product_attenti
         T = tokens.shape[1]
         x = embed_params["tok_embed"][tokens] + embed_params["pos_embed"][:T]
 
+        apply = maybe_remat(cfg, tp_block_apply)
+
         def body(carry, block):
-            return tp_block_apply(block, carry, cfg, n, attn_fn), None
+            return apply(block, carry, cfg, n, attn_fn), None
 
         x, _ = lax.scan(body, x, blocks)
         x = layer_norm(x, embed_params["lnf_g"], embed_params["lnf_b"])

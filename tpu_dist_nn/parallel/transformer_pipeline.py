@@ -25,6 +25,7 @@ from jax import lax
 from tpu_dist_nn.models.transformer import (
     TransformerConfig,
     block_apply,
+    maybe_remat,
     dot_product_attention,
     embed,
     next_token_ce,
@@ -64,10 +65,12 @@ def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
     ``num_microbatches * mesh data size``.
     """
 
+    apply = maybe_remat(cfg)
+
     def stage_fn(stage_blocks, x):
         # stage_blocks leaves: (L/S, ...); scan the local block group.
         def body(carry, block):
-            return block_apply(block, carry, cfg, attn_fn), None
+            return apply(block, carry, cfg, attn_fn), None
 
         y, _ = lax.scan(body, x, stage_blocks)
         return y
@@ -183,8 +186,10 @@ def make_pipeline_tp_lm_forward(mesh, cfg: TransformerConfig,
             for k, v in stage_blocks.items()
         }
 
+        apply = maybe_remat(cfg, tp_block_apply)
+
         def body(carry, block):
-            return tp_block_apply(block, carry, cfg, n_tp, attn_fn), None
+            return apply(block, carry, cfg, n_tp, attn_fn), None
 
         y, _ = lax.scan(body, x, blocks)
         return y
