@@ -258,6 +258,8 @@ def cmd_lm(args) -> int:
         # The MoE forward is not scan-based; a silently ignored flag is
         # worse than an error.
         raise ValueError("--remat supports the dense LM only")
+    if args.zero1 and moe:
+        raise ValueError("--zero1 supports the dense LM only")
     common = dict(
         vocab_size=256,  # byte-level
         d_model=args.d_model,
@@ -312,10 +314,31 @@ def cmd_lm(args) -> int:
         cfg = TransformerConfig(**common)
         init_fn, eval_fn = init_transformer, evaluate_lm
         if args.stages > 1:
+            if args.zero1:
+                raise ValueError(
+                    "--zero1 composes with --data-parallel only (optimizer "
+                    "state already lives per-stage in the pipeline)"
+                )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 
             mesh = build_mesh(
                 MeshSpec(stage=args.stages, data=args.data_parallel)
+            )
+        elif args.zero1:
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.parallel.zero import make_zero_lm_train_step
+
+            if args.data_parallel < 2:
+                raise ValueError("--zero1 needs --data-parallel >= 2")
+            if args.batch_size % args.data_parallel:
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible by "
+                    f"--data-parallel {args.data_parallel}"
+                )
+            zero_mesh = build_mesh(MeshSpec(data=args.data_parallel))
+            # `params` is assigned below, before train_lm invokes this.
+            step_fn = lambda opt: make_zero_lm_train_step(  # noqa: E731
+                zero_mesh, cfg, opt, params
             )
 
     text, source = load_corpus(args.corpus)
@@ -522,6 +545,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize block activations in the backward "
                         "(jax.checkpoint per block: long-context memory "
                         "for ~1/3 more FLOPs)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard Adam moments over the data axis "
+                        "(with --data-parallel N; dense LM)")
     p.add_argument("--experts", type=int, default=0,
                    help="MoE: experts per block (0 = dense MLP)")
     p.add_argument("--capacity-factor", type=float, default=1.25)

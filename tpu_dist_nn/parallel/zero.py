@@ -1,0 +1,89 @@
+"""ZeRO-1: optimizer-state sharding over the ``data`` mesh axis.
+
+The reference has no distributed optimizer at all (SURVEY.md §2.3:
+"no optimizer exists in the distributed path"); plain data parallelism
+replicates Adam's two moment buffers on every device — 2x the model
+size wasted per replica. ZeRO stage 1 shards those buffers across the
+data-parallel group instead; with XLA's partitioner the step stays a
+single jitted function and the reduce-scatter/all-gather pattern falls
+out of the sharding annotations:
+
+* params replicated, tokens batch-sharded over ``data`` — the
+  gradient all-reduce XLA inserts for any data-parallel step;
+* optimizer-state leaves pinned (``out_shardings``) to a sharded
+  layout — each device materializes only its 1/N slice of ``mu``/``nu``
+  and the corresponding slice of the update, and the partitioner turns
+  the grad reduction feeding it into a reduce-scatter + the applied
+  update into an all-gather (the ZeRO-1 communication schedule) rather
+  than keeping N full copies.
+
+Per-leaf layout: the largest axis divisible by the data-group size is
+sharded; leaves with no such axis (scalars, odd shapes) stay
+replicated — correctness never depends on divisibility.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.models.transformer import TransformerConfig, lm_loss
+from tpu_dist_nn.parallel.mesh import AXIS_DATA
+
+
+def zero_opt_shardings(opt_state_shapes, mesh, axis: str = AXIS_DATA):
+    """NamedSharding pytree for an optimizer state: each leaf's largest
+    ``axis``-divisible dimension sharded, everything else replicated.
+
+    ``opt_state_shapes`` may be real arrays or ``jax.eval_shape``
+    structs — only ``.shape``/``.ndim`` are read.
+    """
+    n = mesh.shape[axis]
+
+    def leaf_sharding(leaf):
+        shape = getattr(leaf, "shape", ())
+        cands = [(size, i) for i, size in enumerate(shape) if size % n == 0
+                 and size >= n]
+        if not cands:
+            return NamedSharding(mesh, P())
+        _, i = max(cands)
+        spec = [None] * len(shape)
+        spec[i] = axis
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree.map(leaf_sharding, opt_state_shapes)
+
+
+def make_zero_lm_train_step(mesh, cfg: TransformerConfig, optimizer, params,
+                            attn_fn=None):
+    """jitted ZeRO-1 ``step(params, opt_state, tokens)`` for the dense LM.
+
+    ``params`` supplies structure only (shardings are derived via
+    ``jax.eval_shape`` — nothing is allocated here). Pass the *same*
+    optimizer instance used for ``optimizer.init``. The returned step
+    accepts an unsharded ``opt_state`` on first use; ``in_shardings``
+    places it (each device keeps its slice from then on).
+    """
+    from tpu_dist_nn.train.lm_trainer import _resolve_attn_fn, make_step_body
+
+    attn_fn = _resolve_attn_fn(attn_fn)
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    opt_sh = zero_opt_shardings(opt_shapes, mesh)
+    rep = NamedSharding(mesh, P())
+    p_sh = jax.tree.map(lambda _: rep, params)
+    tok_sh = NamedSharding(mesh, P(AXIS_DATA, None))
+
+    step = jax.jit(
+        make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer),
+        in_shardings=(p_sh, opt_sh, tok_sh),
+        out_shardings=(p_sh, opt_sh, None),
+    )
+    # Sharded init: the whole point of ZeRO-1 is that full replicated
+    # moments (2x model size) never exist — an eager optimizer.init
+    # would materialize exactly that before the step's in_shardings
+    # could redistribute it. Training loops pick this up via
+    # getattr(step, "init_opt_state", optimizer.init).
+    step.init_opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)
+    return step

@@ -44,6 +44,20 @@ def _resolve_attn_fn(attn_fn):
     return default_attn_fn()
 
 
+def make_step_body(loss_fn, optimizer):
+    """The one training-step body every LM variant jits:
+    value_and_grad over ``loss_fn(params, tokens)``, optimizer update,
+    apply. Single definition so baseline / pipelined / MoE / ZeRO steps
+    cannot drift apart (a change like grad clipping lands everywhere)."""
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
 def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
     """jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
 
@@ -51,16 +65,9 @@ def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
     on TPU, the jnp reference elsewhere).
     """
     attn_fn = _resolve_attn_fn(attn_fn)
-
-    @jax.jit
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: lm_loss(p, tokens, cfg, attn_fn)
-        )(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    return step
+    return jax.jit(
+        make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer)
+    )
 
 
 def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
@@ -71,14 +78,7 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     loss_fn = make_pipeline_lm_loss(
         mesh, cfg, num_stages, num_microbatches, _resolve_attn_fn(attn_fn)
     )
-
-    @jax.jit
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    return step
+    return jax.jit(make_step_body(loss_fn, optimizer))
 
 
 def make_moe_lm_train_step(cfg, optimizer, mesh=None, attn_fn=None):
@@ -99,14 +99,7 @@ def make_moe_lm_train_step(cfg, optimizer, mesh=None, attn_fn=None):
             return moe_lm_loss(p, t, cfg, attn_fn=attn_fn)
     else:
         loss_fn = make_ep_lm_forward(mesh, cfg, attn_fn, with_loss=True)
-
-    @jax.jit
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    return step
+    return jax.jit(make_step_body(loss_fn, optimizer))
 
 
 def evaluate_moe_lm(params, cfg, rows: np.ndarray,
@@ -147,7 +140,9 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         )
     else:
         step = make_lm_train_step(cfg, optimizer)
-    opt_state = optimizer.init(params)
+    # A step may carry its own (e.g. sharded, ZeRO-1) state init —
+    # eager optimizer.init would materialize full replicated moments.
+    opt_state = getattr(step, "init_opt_state", optimizer.init)(params)
     start_step, state = resume_or_init(
         checkpoints, {"params": params, "opt_state": opt_state}
     )
