@@ -260,6 +260,8 @@ def cmd_lm(args) -> int:
         raise ValueError("--remat supports the dense LM only")
     if args.zero1 and moe:
         raise ValueError("--zero1 supports the dense LM only")
+    if args.fsdp and moe:
+        raise ValueError("--fsdp supports the dense LM only")
     common = dict(
         vocab_size=256,  # byte-level
         d_model=args.d_model,
@@ -314,32 +316,38 @@ def cmd_lm(args) -> int:
         cfg = TransformerConfig(**common)
         init_fn, eval_fn = init_transformer, evaluate_lm
         if args.stages > 1:
-            if args.zero1:
+            if args.zero1 or args.fsdp:
                 raise ValueError(
-                    "--zero1 composes with --data-parallel only (optimizer "
-                    "state already lives per-stage in the pipeline)"
+                    "--zero1/--fsdp compose with --data-parallel only "
+                    "(state already lives per-stage in the pipeline)"
                 )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 
             mesh = build_mesh(
                 MeshSpec(stage=args.stages, data=args.data_parallel)
             )
-        elif args.zero1:
+        elif args.zero1 or args.fsdp:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
-            from tpu_dist_nn.parallel.zero import make_zero_lm_train_step
+            from tpu_dist_nn.parallel.zero import (
+                make_fsdp_lm_train_step,
+                make_zero_lm_train_step,
+            )
 
+            flag = "--fsdp" if args.fsdp else "--zero1"
+            if args.zero1 and args.fsdp:
+                raise ValueError("--fsdp already shards the optimizer "
+                                 "state; drop --zero1")
             if args.data_parallel < 2:
-                raise ValueError("--zero1 needs --data-parallel >= 2")
+                raise ValueError(f"{flag} needs --data-parallel >= 2")
             if args.batch_size % args.data_parallel:
                 raise ValueError(
                     f"--batch-size {args.batch_size} must be divisible by "
                     f"--data-parallel {args.data_parallel}"
                 )
             zero_mesh = build_mesh(MeshSpec(data=args.data_parallel))
+            make = make_fsdp_lm_train_step if args.fsdp else make_zero_lm_train_step
             # `params` is assigned below, before train_lm invokes this.
-            step_fn = lambda opt: make_zero_lm_train_step(  # noqa: E731
-                zero_mesh, cfg, opt, params
-            )
+            step_fn = lambda opt: make(zero_mesh, cfg, opt, params)  # noqa: E731
 
     text, source = load_corpus(args.corpus)
     tokens = encode(text)
@@ -548,6 +556,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard Adam moments over the data axis "
                         "(with --data-parallel N; dense LM)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded (ZeRO-3): shard params AND Adam "
+                        "moments over the data axis (dense LM)")
     p.add_argument("--experts", type=int, default=0,
                    help="MoE: experts per block (0 = dense MLP)")
     p.add_argument("--capacity-factor", type=float, default=1.25)

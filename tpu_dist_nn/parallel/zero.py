@@ -1,4 +1,5 @@
-"""ZeRO-1: optimizer-state sharding over the ``data`` mesh axis.
+"""ZeRO-1 / FSDP: optimizer-state (and optionally parameter) sharding
+over the ``data`` mesh axis.
 
 The reference has no distributed optimizer at all (SURVEY.md §2.3:
 "no optimizer exists in the distributed path"); plain data parallelism
@@ -56,6 +57,33 @@ def zero_opt_shardings(opt_state_shapes, mesh, axis: str = AXIS_DATA):
     return jax.tree.map(leaf_sharding, opt_state_shapes)
 
 
+def _make_sharded_step(mesh, cfg, optimizer, params, shard_params, attn_fn):
+    from tpu_dist_nn.train.lm_trainer import _resolve_attn_fn, make_step_body
+
+    attn_fn = _resolve_attn_fn(attn_fn)
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    opt_sh = zero_opt_shardings(opt_shapes, mesh)
+    if shard_params:
+        p_sh = zero_opt_shardings(params, mesh)
+    else:
+        rep = NamedSharding(mesh, P())
+        p_sh = jax.tree.map(lambda _: rep, params)
+    tok_sh = NamedSharding(mesh, P(AXIS_DATA, None))
+
+    step = jax.jit(
+        make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer),
+        in_shardings=(p_sh, opt_sh, tok_sh),
+        out_shardings=(p_sh, opt_sh, None),
+    )
+    # Sharded init: the whole point of state sharding is that full
+    # replicated moments (2x model size) never exist — an eager
+    # optimizer.init would materialize exactly that before the step's
+    # in_shardings could redistribute it. Training loops pick this up
+    # via getattr(step, "init_opt_state", optimizer.init).
+    step.init_opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)
+    return step
+
+
 def make_zero_lm_train_step(mesh, cfg: TransformerConfig, optimizer, params,
                             attn_fn=None):
     """jitted ZeRO-1 ``step(params, opt_state, tokens)`` for the dense LM.
@@ -66,24 +94,20 @@ def make_zero_lm_train_step(mesh, cfg: TransformerConfig, optimizer, params,
     accepts an unsharded ``opt_state`` on first use; ``in_shardings``
     places it (each device keeps its slice from then on).
     """
-    from tpu_dist_nn.train.lm_trainer import _resolve_attn_fn, make_step_body
+    return _make_sharded_step(mesh, cfg, optimizer, params, False, attn_fn)
 
-    attn_fn = _resolve_attn_fn(attn_fn)
-    opt_shapes = jax.eval_shape(optimizer.init, params)
-    opt_sh = zero_opt_shardings(opt_shapes, mesh)
-    rep = NamedSharding(mesh, P())
-    p_sh = jax.tree.map(lambda _: rep, params)
-    tok_sh = NamedSharding(mesh, P(AXIS_DATA, None))
 
-    step = jax.jit(
-        make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer),
-        in_shardings=(p_sh, opt_sh, tok_sh),
-        out_shardings=(p_sh, opt_sh, None),
-    )
-    # Sharded init: the whole point of ZeRO-1 is that full replicated
-    # moments (2x model size) never exist — an eager optimizer.init
-    # would materialize exactly that before the step's in_shardings
-    # could redistribute it. Training loops pick this up via
-    # getattr(step, "init_opt_state", optimizer.init).
-    step.init_opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)
-    return step
+def make_fsdp_lm_train_step(mesh, cfg: TransformerConfig, optimizer, params,
+                            attn_fn=None):
+    """Fully-sharded step (the FSDP / ZeRO-3 analogue): params AND
+    optimizer moments sharded over ``data``; per-device persistent
+    state falls to ~1/N of (model + 2x moments).
+
+    Same per-leaf layout rule as the moments. The forward still
+    computes with full weights — XLA's partitioner inserts the
+    all-gather at each use and the reduce-scatter on the grads (the
+    FSDP communication schedule) from the sharding annotations alone;
+    nothing is hand-scheduled. Transient all-gathered weights exist
+    only inside the step.
+    """
+    return _make_sharded_step(mesh, cfg, optimizer, params, True, attn_fn)
