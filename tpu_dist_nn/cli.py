@@ -419,7 +419,8 @@ def cmd_lm(args) -> int:
         # eager dispatch would pay a host->device round trip per op.
         sample_fn = jax.jit(
             lambda p, t, k: generate(
-                p, cfg, t, n, temperature=args.temperature, key=k
+                p, cfg, t, n, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p, key=k
             )
         )
         out = sample_fn(
@@ -570,6 +571,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sample-bytes", type=int, default=0,
                    help="generate this many bytes after training")
     p.add_argument("--prompt", default="The ", help="generation prompt")
+    p.add_argument("--top-k", type=int, default=None,
+                   help="sample from the k highest-probability bytes only")
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: smallest set with cumulative "
+                        "probability >= p")
     p.add_argument("--temperature", type=float, default=0.8,
                    help="0 = greedy")
     p.set_defaults(fn=cmd_lm)

@@ -94,15 +94,50 @@ def decode_step(params: dict, cache: dict, pos, token: jnp.ndarray,
     return unembed(params, x)[:, 0], {"k": ks, "v": vs}
 
 
+def _truncate_logits(logits: jnp.ndarray, top_k: int | None,
+                     top_p: float | None) -> jnp.ndarray:
+    """Restrict ``logits (B, V)`` to the top-k and/or nucleus (top-p)
+    candidate sets by pushing everything else to -inf.
+
+    Both filters are static (jit-recompiles per setting, like
+    temperature). Top-p keeps the smallest prefix of
+    probability-sorted tokens whose cumulative mass reaches ``p``
+    (the first token always survives, so the set is never empty).
+    """
+    neg = jnp.finfo(jnp.float32).min
+    logits = logits.astype(jnp.float32)
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Token i survives if the mass *before* it is < p; the largest
+        # surviving sorted logit is the cutoff.
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
+            axis=-1,
+        )
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
 def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
              max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: int | None = None, top_p: float | None = None,
              key: jax.Array | None = None):
     """Generate ``(B, max_new_tokens)`` continuations of ``prompt (B, T)``.
 
     Greedy when ``temperature == 0`` (no key needed), else samples from
-    ``softmax(logits / temperature)`` using ``key``. Total length
-    ``T + max_new_tokens`` must fit ``cfg.max_seq_len`` (positional
-    table). jit-compatible: static ``max_new_tokens``/``temperature``.
+    ``softmax(logits / temperature)`` using ``key``, optionally
+    restricted to the ``top_k`` highest-probability tokens and/or the
+    ``top_p`` nucleus. Total length ``T + max_new_tokens`` must fit
+    ``cfg.max_seq_len`` (positional table). jit-compatible: static
+    ``max_new_tokens``/``temperature``/``top_k``/``top_p``.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
@@ -124,6 +159,17 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, {cfg.vocab_size}], got {top_k}"
+        )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature == 0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p shape the sampling distribution; greedy "
+            "decoding (temperature == 0) would silently ignore them"
+        )
     if key is None:
         key = jax.random.key(0)  # unused on the greedy path
 
@@ -133,8 +179,9 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
     def sample(logits, k):
         if temperature == 0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _truncate_logits(logits, top_k, top_p)
         return jax.random.categorical(
-            k, logits.astype(jnp.float32) / temperature, axis=-1
+            k, logits / temperature, axis=-1
         ).astype(jnp.int32)
 
     first = sample(logits[:, T - 1], key)
