@@ -1,0 +1,142 @@
+"""Optimizer factory: defaults reproduce bare Adam; controls behave."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpu_dist_nn.train.optimizers import build_optimizer
+
+
+def _params():
+    return {"w": jnp.asarray([[1.0, -2.0], [3.0, 0.5]])}
+
+
+def _grads(scale=1.0):
+    return {"w": jnp.asarray([[10.0, -20.0], [30.0, 5.0]]) * scale}
+
+
+def test_default_is_exactly_adam():
+    opt = build_optimizer(1e-3)
+    ref = optax.adam(1e-3)
+    p = _params()
+    s0, s1 = opt.init(p), ref.init(p)
+    u0, _ = opt.update(_grads(), s0, p)
+    u1, _ = ref.update(_grads(), s1, p)
+    for a, b in zip(jax.tree.leaves(u0), jax.tree.leaves(u1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_clip_norm_bounds_update_magnitude():
+    opt = build_optimizer(1e-3, clip_norm=1.0)
+    p = _params()
+    s = opt.init(p)
+    big, _ = opt.update(_grads(1e6), s, p)
+    small, _ = opt.update(_grads(1e-6), opt.init(p), p)
+    # Adam normalizes scale anyway on step 1; the real check is that the
+    # clipped-gradient path produces finite, bounded updates for a 1e6
+    # gradient (unclipped Adam is fine too — so compare the *clipped
+    # gradient* directly through the transform chain's first stage).
+    clip = optax.clip_by_global_norm(1.0)
+    g, _ = clip.update(_grads(1e6), clip.init(p))
+    norm = jnp.sqrt(sum(jnp.sum(x**2) for x in jax.tree.leaves(g)))
+    np.testing.assert_allclose(float(norm), 1.0, rtol=1e-6)
+    assert all(jnp.isfinite(x).all() for x in jax.tree.leaves(big))
+    assert all(jnp.isfinite(x).all() for x in jax.tree.leaves(small))
+
+
+def test_warmup_ramps_learning_rate():
+    opt = build_optimizer(1.0, warmup_steps=10)
+    p = _params()
+    s = opt.init(p)
+    # Step 0 should apply ~0 lr: params barely move.
+    u, s = opt.update(_grads(), s, p)
+    first = max(float(jnp.abs(x).max()) for x in jax.tree.leaves(u))
+    for _ in range(15):
+        u, s = opt.update(_grads(), s, p)
+    late = max(float(jnp.abs(x).max()) for x in jax.tree.leaves(u))
+    assert first < 0.2 * late
+
+
+def test_cosine_decays_to_zero():
+    opt = build_optimizer(1.0, schedule="cosine", warmup_steps=2,
+                          total_steps=20)
+    p = _params()
+    s = opt.init(p)
+    mags = []
+    for _ in range(20):
+        u, s = opt.update(_grads(), s, p)
+        mags.append(max(float(jnp.abs(x).max()) for x in jax.tree.leaves(u)))
+    assert mags[-1] < 0.1 * max(mags)
+
+
+def test_weight_decay_uses_adamw():
+    opt = build_optimizer(1e-1, weight_decay=0.1)
+    p = _params()
+    s = opt.init(p)
+    zero_g = jax.tree.map(jnp.zeros_like, _grads())
+    u, _ = opt.update(zero_g, s, p)
+    # With zero grads, AdamW still decays toward zero: update opposes w.
+    assert float(jnp.sum(u["w"] * p["w"])) < 0
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="schedule"):
+        build_optimizer(1e-3, schedule="triangle")
+    with pytest.raises(ValueError, match="total_steps"):
+        build_optimizer(1e-3, schedule="cosine", total_steps=None)
+    with pytest.raises(ValueError, match="clip_norm"):
+        build_optimizer(1e-3, clip_norm=-1)
+
+
+def test_trainer_integration_with_controls():
+    from tpu_dist_nn.data.datasets import synthetic_mnist
+    from tpu_dist_nn.models.fcnn import init_fcnn
+    from tpu_dist_nn.train.trainer import TrainConfig, train_fcnn
+
+    data = synthetic_mnist(256, dim=32, num_classes=4)
+    params = init_fcnn(jax.random.key(0), [32, 16, 4])
+    cfg = TrainConfig(
+        learning_rate=3e-3, epochs=3, batch_size=64, clip_norm=1.0,
+        warmup_steps=2, lr_schedule="cosine",
+    )
+    _, history = train_fcnn(params, data, cfg)
+    assert history[-1]["loss"] < history[0]["loss"]
+
+
+def test_negative_weight_decay_rejected():
+    with pytest.raises(ValueError, match="weight_decay"):
+        build_optimizer(1e-3, weight_decay=-0.01)
+
+
+def test_pipelined_weight_decay_preserves_identity_fillers():
+    # AdamW's decay bypasses gradient masking; the update mask must
+    # keep the pass-through structure (w=1 diagonals of filler blocks)
+    # bit-intact or padded stages silently scale activations.
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.data.datasets import synthetic_mnist
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.testing.oracle import oracle_forward_batch
+    from tpu_dist_nn.train.pipeline_trainer import train_pipelined
+    from tpu_dist_nn.train.trainer import TrainConfig
+    from tpu_dist_nn.parallel.pipeline import extract_model, pipeline_forward
+
+    # Uneven widths force padding + (with an empty stage) identity fill.
+    model = random_model([20, 12, 6, 4], seed=0)
+    params = build_pipeline_params(partition_model(model, [1, 1, 0, 1]))
+    mesh = build_mesh(MeshSpec(stage=4))
+    data = synthetic_mnist(128, dim=20, num_classes=4, seed=1)
+    cfg = TrainConfig(learning_rate=1e-3, epochs=3, batch_size=32,
+                      weight_decay=0.1)
+    trained, _ = train_pipelined(params, mesh, data, cfg, num_microbatches=2)
+
+    # The pipelined forward of the trained weights must agree with the
+    # oracle on the exported model — broken fillers would diverge.
+    exported = extract_model(trained, model, [1, 1, 0, 1])
+    x = data.x[:16]
+    got = np.asarray(pipeline_forward(mesh, trained, x, num_microbatches=2))
+    want = oracle_forward_batch(exported, x)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)

@@ -185,6 +185,8 @@ def cmd_train(args) -> int:
     cfg = TrainConfig(
         learning_rate=args.lr, epochs=args.epochs,
         batch_size=args.batch_size, seed=args.seed,
+        clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
+        lr_schedule=args.lr_schedule, weight_decay=args.weight_decay,
     )
     checkpoints = None
     if args.checkpoint_dir:
@@ -368,6 +370,8 @@ def cmd_lm(args) -> int:
     train_cfg = LMTrainConfig(
         learning_rate=args.lr, steps=args.steps,
         batch_size=args.batch_size, seq_len=args.seq_len,
+        clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
+        lr_schedule=args.lr_schedule, weight_decay=args.weight_decay,
     )
     batches = lm_batches(
         train_rows, args.batch_size, seed=args.seed, epochs=None
@@ -526,6 +530,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--clip-norm", type=float, default=None,
+                   help="global-norm gradient clipping")
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default="constant")
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help="decoupled (AdamW) weight decay")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", help="export trained model JSON here")
     p.add_argument("--checkpoint-dir",
@@ -543,6 +554,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--clip-norm", type=float, default=None,
+                   help="global-norm gradient clipping")
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default="constant")
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help="decoupled (AdamW) weight decay")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")

@@ -34,6 +34,10 @@ class LMTrainConfig:
     batch_size: int = 16
     seq_len: int = 128
     log_every: int = 50
+    clip_norm: float | None = None
+    warmup_steps: int = 0
+    lr_schedule: str = "constant"
+    weight_decay: float = 0.0
 
 
 def _resolve_attn_fn(attn_fn):
@@ -129,7 +133,16 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     """
     from tpu_dist_nn.checkpoint.store import resume_or_init
 
-    optimizer = optax.adam(train_cfg.learning_rate)
+    from tpu_dist_nn.train.optimizers import build_optimizer
+
+    optimizer = build_optimizer(
+        train_cfg.learning_rate,
+        schedule=train_cfg.lr_schedule,
+        warmup_steps=train_cfg.warmup_steps,
+        total_steps=train_cfg.steps,
+        clip_norm=train_cfg.clip_norm,
+        weight_decay=train_cfg.weight_decay,
+    )
     pipelined = step_fn is None and mesh is not None and num_stages > 1
     if step_fn is not None:
         step = step_fn(optimizer)

@@ -1,0 +1,75 @@
+"""Optimizer construction shared by every trainer.
+
+The reference's recipe is bare Adam at a fixed lr
+(``generate_mnist_pytorch.py:37``, notebook cell 8); that stays the
+default here (``build_optimizer(lr)`` == ``optax.adam(lr)`` exactly).
+On top of it, the standard training controls every modern recipe
+expects, applied uniformly to the FCNN, pipelined, and LM trainers so
+the families cannot drift:
+
+* ``clip_norm`` — global-norm gradient clipping (first in the chain).
+* ``warmup_steps`` — linear 0→lr warmup.
+* ``schedule="cosine"`` — cosine decay to ~0 over ``total_steps``
+  (after warmup); ``"constant"`` holds lr after warmup.
+* ``weight_decay`` — decoupled AdamW-style decay.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def build_optimizer(
+    learning_rate: float,
+    *,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    clip_norm: float | None = None,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """-> the trainers' gradient transformation (see module docstring).
+
+    ``total_steps`` is required for ``schedule="cosine"`` (the decay
+    horizon) and otherwise unused.
+    """
+    if schedule not in ("constant", "cosine"):
+        raise ValueError(f"unknown lr schedule: {schedule!r}")
+    if warmup_steps < 0:
+        raise ValueError(f"warmup_steps must be >= 0, got {warmup_steps}")
+    if clip_norm is not None and clip_norm <= 0:
+        raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
+    if weight_decay < 0:
+        raise ValueError(f"weight_decay must be >= 0, got {weight_decay}")
+
+    if schedule == "cosine":
+        if not total_steps or total_steps <= warmup_steps:
+            raise ValueError(
+                f"cosine schedule needs total_steps > warmup_steps "
+                f"({total_steps} vs {warmup_steps})"
+            )
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+        )
+    elif warmup_steps:
+        lr = optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, learning_rate, warmup_steps),
+                optax.constant_schedule(learning_rate),
+            ],
+            boundaries=[warmup_steps],
+        )
+    else:
+        lr = learning_rate
+
+    parts = []
+    if clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(clip_norm))
+    if weight_decay:
+        parts.append(optax.adamw(lr, weight_decay=weight_decay))
+    else:
+        parts.append(optax.adam(lr))
+    return optax.chain(*parts) if len(parts) > 1 else parts[0]

@@ -76,6 +76,12 @@ def make_pipeline_train_step(mesh, meta: PipelineMeta, num_microbatches: int, op
         loss, grads = jax.value_and_grad(loss_fn)(weights, xs, labels, label_mask)
         grads = PipelineWeights(w=grads.w * w_mask, b=grads.b * b_mask)
         updates, opt_state = optimizer.update(grads, opt_state, weights)
+        # Mask the UPDATES too, not just the grads: decoupled weight
+        # decay (AdamW) derives its term from the weights directly,
+        # bypassing gradient masking — unmasked it would shrink the
+        # identity pass-through filler blocks (w=1 diagonals) that the
+        # masks exist to protect (pipeline.py grad_masks docstring).
+        updates = PipelineWeights(w=updates.w * w_mask, b=updates.b * b_mask)
         weights = optax.apply_updates(weights, updates)
         return weights, opt_state, loss
 
@@ -100,7 +106,9 @@ def train_pipelined(
     """
     weights, meta = params
     data_size = mesh.shape[AXIS_DATA]
-    optimizer = optax.adam(config.learning_rate)
+    from tpu_dist_nn.train.trainer import optimizer_for
+
+    optimizer = optimizer_for(config, train_data)
     opt_state = optimizer.init(weights)
     step = make_pipeline_train_step(mesh, meta, num_microbatches, optimizer, weights.w.dtype)
 

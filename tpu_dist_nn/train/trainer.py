@@ -36,6 +36,28 @@ class TrainConfig:
     batch_size: int = 64
     seed: int = 0
     log_every: int = 0  # batches; 0 = epoch-level only
+    # Optimizer controls (train/optimizers.py); defaults reproduce the
+    # reference's bare Adam recipe exactly.
+    clip_norm: float | None = None
+    warmup_steps: int = 0
+    lr_schedule: str = "constant"
+    weight_decay: float = 0.0
+
+
+def optimizer_for(config: TrainConfig, train_data: "Dataset"):
+    """Build the configured optimizer; the cosine horizon is the run's
+    actual step count (epochs x steps/epoch, drop-remainder batching)."""
+    from tpu_dist_nn.train.optimizers import build_optimizer
+
+    steps_per_epoch = max(1, len(train_data) // config.batch_size)
+    return build_optimizer(
+        config.learning_rate,
+        schedule=config.lr_schedule,
+        warmup_steps=config.warmup_steps,
+        total_steps=steps_per_epoch * config.epochs,
+        clip_norm=config.clip_norm,
+        weight_decay=config.weight_decay,
+    )
 
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -142,7 +164,7 @@ def train_fcnn(
 ):
     """Train a dense params pytree; returns (params, history)."""
     wb, acts = _split_params(params)
-    optimizer = optax.adam(config.learning_rate)
+    optimizer = optimizer_for(config, train_data)
     opt_state = optimizer.init(wb)
     step = make_train_step(acts, optimizer)
     eval_fn = None
@@ -200,7 +222,7 @@ def train_network(
     checkpoints=None,
 ):
     """Train a mixed-layer network; returns (params, history)."""
-    optimizer = optax.adam(config.learning_rate)
+    optimizer = optimizer_for(config, train_data)
     opt_state = optimizer.init(params)
     step = make_network_train_step(plan, optimizer)
     eval_fn = None
