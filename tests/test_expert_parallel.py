@@ -151,3 +151,118 @@ def test_moe_lm_loss_decreases_under_adam():
         params, state, loss = step(params, state)
         first = first if first is not None else float(loss)
     assert float(loss) < first
+
+
+def test_topk_k1_identical_to_top1():
+    from tpu_dist_nn.parallel.expert_parallel import route_top1, route_topk
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+    d0, c0, a0 = route_top1(x, w, capacity=12)
+    d1, c1, a1 = route_topk(x, w, capacity=12, k=1)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    assert float(a0) == float(a1)
+
+
+def test_top2_routes_two_experts_with_normalized_gates():
+    from tpu_dist_nn.parallel.expert_parallel import route_topk
+
+    rng = np.random.default_rng(1)
+    S, D, E = 16, 8, 4
+    x = jnp.asarray(rng.normal(size=(S, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, E)), jnp.float32)
+    # Ample capacity: nothing dropped.
+    d, c, _ = route_topk(x, w, capacity=S, k=2)
+    d, c = np.asarray(d), np.asarray(c)
+    # Every token dispatched to exactly 2 slots, total gate 1.
+    np.testing.assert_array_equal(d.sum(axis=(1, 2)), np.full(S, 2.0))
+    np.testing.assert_allclose(c.sum(axis=(1, 2)), np.ones(S), rtol=1e-6)
+    # The two chosen experts are the argmax-2 of the router.
+    probs = np.asarray(jax.nn.softmax(x @ w, axis=-1))
+    for s in range(S):
+        chosen = set(np.nonzero(d[s].sum(-1))[0])
+        assert chosen == set(np.argsort(probs[s])[-2:])
+
+
+def test_top2_respects_capacity_rank_order():
+    from tpu_dist_nn.parallel.expert_parallel import route_topk
+
+    # All tokens prefer expert 0 then expert 1 (fixed logits).
+    S, E, cap = 6, 3, 2
+    x = jnp.ones((S, 1), jnp.float32)
+    w = jnp.asarray([[3.0, 2.0, -5.0]], jnp.float32)
+    d, c, _ = route_topk(x, w, capacity=cap, k=2)
+    d = np.asarray(d)
+    # Expert 0 holds exactly cap rank-0 tokens; expert 1 exactly cap
+    # rank-1 tokens; slots never exceed capacity and never collide.
+    assert d[:, 0].sum() == cap and d[:, 1].sum() == cap
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()  # one token per slot
+
+
+def test_ep_sharded_top2_matches_grouped_oracle():
+    from tpu_dist_nn.parallel.expert_parallel import (
+        MoEConfig,
+        ep_shard_blocks,
+        init_moe_transformer,
+        make_ep_lm_forward,
+        moe_forward,
+    )
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+
+    ep, dp = 2, 2
+    cfg = MoEConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16, n_experts=4, capacity_factor=2.0, router_top_k=2,
+    )
+    params = init_moe_transformer(jax.random.key(0), cfg)
+    mesh = build_mesh(MeshSpec(expert=ep, data=dp))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (ep * dp * 2, 16)), jnp.int32
+    )
+    want, _ = moe_forward(params, tokens, cfg, n_groups=ep * dp)
+    params_ep = dict(params, blocks=ep_shard_blocks(params["blocks"], ep))
+    fwd = make_ep_lm_forward(mesh, cfg)
+    got = fwd(params_ep, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_top2_training_learns():
+    import optax
+
+    from tpu_dist_nn.parallel.expert_parallel import (
+        MoEConfig,
+        init_moe_transformer,
+    )
+    from tpu_dist_nn.train.lm_trainer import make_moe_lm_train_step
+
+    cfg = MoEConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16, n_experts=4, router_top_k=2,
+    )
+    params = init_moe_transformer(jax.random.key(1), cfg)
+    step = make_moe_lm_train_step(cfg, optax.adam(3e-3))
+    opt_state = optax.adam(3e-3).init(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 32, (8, 16)), jnp.int32
+    )
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_capacity_scales_with_top_k_and_k_validated():
+    from tpu_dist_nn.parallel.expert_parallel import MoEConfig
+
+    base = dict(vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+                max_seq_len=16, n_experts=4, capacity_factor=1.25)
+    c1 = MoEConfig(**base, router_top_k=1)
+    c2 = MoEConfig(**base, router_top_k=2)
+    assert c2.capacity(256) == 2 * c1.capacity(256)
+    with pytest.raises(ValueError, match="router_top_k"):
+        MoEConfig(**dict(base, n_experts=1), router_top_k=2)

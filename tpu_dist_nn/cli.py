@@ -294,6 +294,7 @@ def cmd_lm(args) -> int:
         cfg = MoEConfig(
             **common, n_experts=args.experts,
             capacity_factor=args.capacity_factor,
+            router_top_k=args.router_top_k,
         )
         init_fn, eval_fn = init_moe_transformer, evaluate_moe_lm
         ep, dp = args.expert_parallel, args.data_parallel
@@ -581,6 +582,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--experts", type=int, default=0,
                    help="MoE: experts per block (0 = dense MLP)")
     p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--router-top-k", type=int, default=1, choices=[1, 2],
+                   help="experts per token: 1 = Switch, 2 = GShard gates")
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="shard experts over this many devices (all_to_all)")
     p.add_argument("--checkpoint-dir",

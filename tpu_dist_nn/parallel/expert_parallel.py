@@ -55,11 +55,26 @@ class MoEConfig(TransformerConfig):
     n_experts: int = 4
     capacity_factor: float = 1.25
     router_aux_weight: float = 1e-2
+    router_top_k: int = 1
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not 1 <= self.router_top_k <= self.n_experts:
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]"
+            )
 
     def capacity(self, tokens_per_group: int) -> int:
+        # Scales with router_top_k (GShard): top-k routing produces k*S
+        # assignments, so slots must scale with k or top-2 would
+        # structurally drop ~(1 - cf/k) of them and underperform top-1.
         return max(
             1,
-            int(np.ceil(self.capacity_factor * tokens_per_group / self.n_experts)),
+            int(np.ceil(
+                self.router_top_k * self.capacity_factor
+                * tokens_per_group / self.n_experts
+            )),
         )
 
 
@@ -94,36 +109,56 @@ def init_moe_transformer(key: jax.Array, cfg: MoEConfig, dtype=jnp.float32):
     return dict(base, blocks=blocks)
 
 
-def route_top1(x_flat: jnp.ndarray, w_router: jnp.ndarray, capacity: int):
-    """Top-1 routing for one token group.
+def route_topk(x_flat: jnp.ndarray, w_router: jnp.ndarray, capacity: int,
+               k: int = 1):
+    """Top-k routing for one token group.
 
     ``x_flat: (S, D)`` -> ``(dispatch (S, E, C) {0,1}, combine (S, E, C)
-    gate-weighted, aux_loss scalar)``. Tokens beyond an expert's
-    capacity are dropped (their combine weights are zero, so the
-    residual stream carries them through unchanged — same semantics as
-    Switch).
+    gate-weighted, aux_loss scalar)``. ``k=1`` is Switch routing (gate =
+    the raw top probability); ``k>=2`` is GShard-style (gates are the
+    top-k probabilities renormalized to sum to 1). Buffer slots fill
+    rank-by-rank — every rank-0 choice is placed before any rank-1
+    choice competes — and tokens beyond an expert's capacity are
+    dropped at that rank only (their combine weight is zero; the
+    residual stream carries them through unchanged).
     """
     E = w_router.shape[-1]
     logits = (x_flat @ w_router).astype(jnp.float32)  # (S, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # (S,)
-    gate = jnp.max(probs, axis=-1)  # (S,)
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (S, E)
+    top_p, top_i = lax.top_k(probs, k)  # (S, k)
+    if k == 1:
+        gates = top_p  # Switch convention: unnormalized
+    else:
+        gates = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
 
-    # Position of each token within its expert's buffer; drop overflow.
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (S, E), -1 where unrouted
-    kept = onehot * (pos < capacity)
-    pos_idx = jnp.sum(pos * kept, axis=-1).astype(jnp.int32)  # (S,)
-    dispatch = kept[:, :, None] * jax.nn.one_hot(
-        pos_idx, capacity, dtype=jnp.float32
-    )[:, None, :]  # (S, E, C)
-    combine = dispatch * gate[:, None, None]
+    dispatch = jnp.zeros((x_flat.shape[0], E, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    filled = jnp.zeros((E,), jnp.float32)  # slots used by earlier ranks
+    for r in range(k):
+        onehot = jax.nn.one_hot(top_i[:, r], E, dtype=jnp.float32)  # (S, E)
+        # Position within the expert buffer = earlier ranks' fill +
+        # this rank's running count; drop overflow at this rank.
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + filled[None, :]) * onehot
+        kept = onehot * (pos < capacity) * (pos >= 0)
+        pos_idx = jnp.sum(pos * kept, axis=-1).astype(jnp.int32)  # (S,)
+        disp_r = kept[:, :, None] * jax.nn.one_hot(
+            pos_idx, capacity, dtype=jnp.float32
+        )[:, None, :]  # (S, E, C)
+        dispatch = dispatch + disp_r
+        combine = combine + disp_r * gates[:, r][:, None, None]
+        filled = filled + jnp.sum(kept, axis=0)
 
-    # Switch load-balancing loss: E * Σ_e fraction_routed_e · mean_prob_e.
-    frac = jnp.mean(onehot, axis=0)
+    # Load-balancing loss over rank-0 assignments (Switch/GShard):
+    # E * Σ_e fraction_routed_e · mean_prob_e.
+    frac = jnp.mean(jax.nn.one_hot(top_i[:, 0], E, dtype=jnp.float32), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux
+
+
+def route_top1(x_flat: jnp.ndarray, w_router: jnp.ndarray, capacity: int):
+    """Switch top-1 routing (see :func:`route_topk`)."""
+    return route_topk(x_flat, w_router, capacity, k=1)
 
 
 def _expert_ffn(w_up, b_up, w_down, b_down, buf):
@@ -151,7 +186,9 @@ def moe_ffn_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
     xg = x.reshape(n_groups, S // n_groups, D)
 
     def per_group(xf):
-        dispatch, combine, aux = route_top1(xf, block["w_router"], cap)
+        dispatch, combine, aux = route_topk(
+            xf, block["w_router"], cap, cfg.router_top_k
+        )
         buf = jnp.einsum("sec,sd->ecd", dispatch, xf.astype(jnp.float32))
         out = _expert_ffn(
             block["w_up"], block["b_up"], block["w_down"], block["b_down"],
@@ -276,7 +313,9 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
         S = b * T
         cap = cfg.capacity(S)
         hf = h.reshape(S, D)
-        dispatch, combine, aux = route_top1(hf, block["w_router"], cap)
+        dispatch, combine, aux = route_topk(
+            hf, block["w_router"], cap, cfg.router_top_k
+        )
         buf = jnp.einsum("sec,sd->ecd", dispatch, hf.astype(jnp.float32))
         buf = buf.astype(h.dtype)  # (E, C, D)
         # Exchange: each device keeps its E/n_ep local experts and
