@@ -166,3 +166,117 @@ def test_save_older_than_retention_window_rejected(tmp_path):
     with pytest.raises(ValueError, match="retention window"):
         mgr.save(1, {"x": np.zeros(1)})
     assert mgr.steps() == [5, 6]
+
+
+def test_async_manager_saves_and_restores(tmp_path):
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(tmp_path, keep=2)
+    state = {"w": np.arange(6.0).reshape(2, 3)}
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": state["w"] * step}, metadata={"step": step})
+    mgr.wait()
+    assert mgr.steps() == [2, 3]  # retention applied in order
+    got_step, got = mgr.restore({"w": np.zeros((2, 3))})
+    assert got_step == 3
+    np.testing.assert_allclose(got["w"], state["w"] * 3)
+    mgr.close()
+
+
+def test_async_manager_restore_flushes_pending(tmp_path):
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(tmp_path, keep=3)
+    mgr.save(7, {"w": np.ones(4)})
+    # No explicit wait: restore must see the enqueued save.
+    step, got = mgr.restore({"w": np.zeros(4)})
+    assert step == 7
+    np.testing.assert_allclose(got["w"], np.ones(4))
+    mgr.close()
+
+
+def test_async_manager_surfaces_worker_errors(tmp_path):
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(tmp_path, keep=1)
+    mgr.save(5, {"w": np.ones(2)})
+    mgr.wait()
+    # Saving an out-of-retention step fails in the worker; the error
+    # must surface on wait(), not vanish.
+    mgr.save(1, {"w": np.ones(2)})
+    with pytest.raises(ValueError, match="retention"):
+        mgr.wait()
+    mgr.close()
+
+
+def test_lm_training_with_async_checkpoints_resumes(tmp_path):
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.train.lm_trainer import LMTrainConfig, train_lm
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16,
+    )
+    tcfg = LMTrainConfig(steps=4, batch_size=4, seq_len=16, log_every=2)
+    rows = np.random.default_rng(0).integers(0, 32, (64, 17)).astype(np.int32)
+
+    def batches():
+        rng = np.random.default_rng(1)
+        while True:
+            yield rows[rng.integers(0, len(rows), 4)]
+
+    params = init_transformer(jax.random.key(0), cfg)
+    mgr = AsyncCheckpointManager(tmp_path, keep=3)
+    _, history = train_lm(params, cfg, batches(), tcfg, checkpoints=mgr,
+                          checkpoint_every=2)
+    assert mgr.latest_step() == 4  # flushed before return
+    # A fresh manager resumes from the durable step.
+    mgr2 = AsyncCheckpointManager(tmp_path, keep=3)
+    _, history2 = train_lm(params, cfg, batches(), tcfg, checkpoints=mgr2,
+                           checkpoint_every=2)
+    assert history2 == [] or history2[0]["step"] > 2
+    mgr.close(); mgr2.close()
+
+
+def test_async_save_after_close_raises(tmp_path):
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(tmp_path)
+    mgr.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        mgr.save(1, {"w": np.ones(2)})
+
+
+def test_flush_runs_when_training_raises(tmp_path):
+    # Crash-resume guarantee: a save enqueued before the loop dies must
+    # still be durable.
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.train.lm_trainer import LMTrainConfig, train_lm
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq_len=16,
+    )
+    tcfg = LMTrainConfig(steps=6, batch_size=4, seq_len=16, log_every=2)
+    good = np.random.default_rng(0).integers(0, 32, (4, 17)).astype(np.int32)
+
+    def batches():
+        yield good
+        yield good
+        raise RuntimeError("simulated data-pipeline crash")
+
+    params = init_transformer(jax.random.key(0), cfg)
+    mgr = AsyncCheckpointManager(tmp_path, keep=3)
+    with pytest.raises(RuntimeError, match="simulated"):
+        train_lm(params, cfg, batches(), tcfg, checkpoints=mgr,
+                 checkpoint_every=2)
+    assert mgr.latest_step() == 2  # the enqueued save landed
+    mgr.close()

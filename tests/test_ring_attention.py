@@ -144,3 +144,23 @@ class TestSeqParallelLM:
         grads = jax.jit(jax.grad(loss_fn))(params, tokens)
         gnorm = sum(float(jnp.sum(g**2)) for g in jax.tree.leaves(grads))
         assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_ring_remat_grads_match():
+    import dataclasses as dc
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (4, 16)), jnp.int32
+    )
+    mesh = build_mesh(MeshSpec(seq=2, data=4))
+    g0 = jax.jit(jax.grad(make_seq_parallel_lm_loss(mesh, cfg)))(params, tokens)
+    cfg_r = dc.replace(cfg, remat=True)
+    g1 = jax.jit(jax.grad(make_seq_parallel_lm_loss(mesh, cfg_r)))(params, tokens)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -92,3 +92,28 @@ class TestFcnnTP:
         got = np.asarray(jax.jit(fwd)(params_tp, x))
         np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
         np.testing.assert_allclose(got.sum(-1), 1.0, atol=1e-5)  # softmax rows
+
+
+def test_tp_remat_grads_match():
+    import dataclasses as dc
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (4, 16)), jnp.int32
+    )
+    mesh = build_mesh(MeshSpec(model=2, data=4))
+    params_tp = dict(params, blocks=tp_shard_blocks(params["blocks"], cfg, 2))
+
+    def loss(c):
+        fwd = make_tp_lm_forward(mesh, c)
+        return lambda p, t: jnp.mean(fwd(p, t) ** 2)
+
+    g0 = jax.jit(jax.grad(loss(cfg)))(params_tp, tokens)
+    g1 = jax.jit(jax.grad(loss(dc.replace(cfg, remat=True))))(params_tp, tokens)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -12,9 +12,17 @@ pytree with atomic writes, retention, and epoch-level resume.
 """
 
 from tpu_dist_nn.checkpoint.store import (
+    AsyncCheckpointManager,
     CheckpointManager,
+    flush,
     restore_pytree,
     save_pytree,
 )
 
-__all__ = ["CheckpointManager", "save_pytree", "restore_pytree"]
+__all__ = [
+    "AsyncCheckpointManager",
+    "CheckpointManager",
+    "flush",
+    "save_pytree",
+    "restore_pytree",
+]

@@ -165,6 +165,86 @@ class CheckpointManager:
             return None
 
 
+class AsyncCheckpointManager(CheckpointManager):
+    """Non-blocking saves: the training loop enqueues and moves on.
+
+    JAX arrays are immutable, so the enqueued pytree IS a consistent
+    snapshot — no copy needed before the step function produces *new*
+    arrays for the next state. One daemon worker drains the queue in
+    order (retention and the manifest stay race-free because only the
+    worker touches them); the device->host transfer also moves off the
+    step loop. A worker failure is re-raised on the next ``save``,
+    ``wait``, or ``restore`` — never swallowed.
+
+    ``wait()`` blocks until everything enqueued is durable; trainers
+    call it (via :func:`flush`) before returning, and ``restore``
+    flushes first so a just-enqueued save is visible.
+    """
+
+    def __init__(self, directory: str | Path, keep: int = 3):
+        super().__init__(directory, keep)
+        import queue
+        import threading
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._error: BaseException | None = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="tdn-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                step, state, metadata = item
+                CheckpointManager.save(self, step, state, metadata)
+            except BaseException as e:  # surfaced on the caller's side
+                self._error = e
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def save(self, step: int, state: Any, metadata: dict | None = None) -> Path:
+        if self._closed:
+            # Enqueueing with no consumer would deadlock a later wait().
+            raise RuntimeError("AsyncCheckpointManager is closed")
+        self._raise_pending()
+        self._queue.put((int(step), state, metadata))
+        return self._path(int(step))
+
+    def wait(self) -> None:
+        """Block until every enqueued checkpoint is on disk."""
+        self._queue.join()
+        self._raise_pending()
+
+    def restore(self, template: Any, step: int | None = None):
+        self.wait()
+        return super().restore(template, step)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join()
+        self._raise_pending()
+
+
+def flush(checkpoints) -> None:
+    """Make enqueued saves durable; no-op for sync managers/None."""
+    wait = getattr(checkpoints, "wait", None)
+    if wait is not None:
+        wait()
+
+
 def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
     """Shared trainer resume step: restore the newest checkpoint into
     ``state``'s structure, or keep ``state`` as-is when none exists.

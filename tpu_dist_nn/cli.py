@@ -190,9 +190,10 @@ def cmd_train(args) -> int:
     )
     checkpoints = None
     if args.checkpoint_dir:
-        from tpu_dist_nn.checkpoint import CheckpointManager
+        from tpu_dist_nn.checkpoint import AsyncCheckpointManager, CheckpointManager
 
-        checkpoints = CheckpointManager(args.checkpoint_dir, keep=args.keep_checkpoints)
+        manager = AsyncCheckpointManager if args.async_checkpoints else CheckpointManager
+        checkpoints = manager(args.checkpoint_dir, keep=args.keep_checkpoints)
     history = engine.train(data, cfg, eval_data=eval_data, checkpoints=checkpoints)
     for h in history:
         msg = f"epoch {h['epoch']}: loss {h['loss']:.4f} ({h['seconds']:.2f}s)"
@@ -379,9 +380,10 @@ def cmd_lm(args) -> int:
     )
     checkpoints = None
     if args.checkpoint_dir:
-        from tpu_dist_nn.checkpoint import CheckpointManager
+        from tpu_dist_nn.checkpoint import AsyncCheckpointManager, CheckpointManager
 
-        checkpoints = CheckpointManager(
+        manager = AsyncCheckpointManager if args.async_checkpoints else CheckpointManager
+        checkpoints = manager(
             args.checkpoint_dir, keep=args.keep_checkpoints
         )
     t0 = time.monotonic()
@@ -543,6 +545,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir",
                    help="save per-epoch training state here and resume from it")
     p.add_argument("--keep-checkpoints", type=int, default=3)
+    p.add_argument("--async-checkpoints", action="store_true",
+                   help="write checkpoints on a background thread "
+                        "(the step loop never blocks on disk)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("lm", help="train + eval the Tiny-Transformer LM")
@@ -589,6 +594,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir",
                    help="save per-interval training state here and resume")
     p.add_argument("--keep-checkpoints", type=int, default=3)
+    p.add_argument("--async-checkpoints", action="store_true",
+                   help="write checkpoints on a background thread "
+                        "(the step loop never blocks on disk)")
     p.add_argument("--sample-bytes", type=int, default=0,
                    help="generate this many bytes after training")
     p.add_argument("--prompt", default="The ", help="generation prompt")

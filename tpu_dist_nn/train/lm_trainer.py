@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tpu_dist_nn.checkpoint.store import flush
 from tpu_dist_nn.models.transformer import TransformerConfig, lm_loss
 from tpu_dist_nn.parallel.transformer_pipeline import (
     make_pipeline_lm_loss,
@@ -164,24 +165,29 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
 
     history = []
     t0 = time.monotonic()
-    for i, batch in enumerate(batches):
-        if i >= train_cfg.steps:
-            break
-        if i < start_step:
-            continue  # replay-skip: keeps a seeded stream aligned
-        params, opt_state, loss = step(params, opt_state, jnp.asarray(batch))
-        if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
-            history.append(
-                {"step": i + 1, "loss": float(loss),
-                 "seconds": time.monotonic() - t0}
-            )
-        if checkpoints is not None and (
-            (i + 1) % every == 0 or i == train_cfg.steps - 1
-        ):
-            checkpoints.save(
-                i + 1, {"params": params, "opt_state": opt_state},
-                metadata={"step": i + 1, "loss": float(loss)},
-            )
+    try:
+        for i, batch in enumerate(batches):
+            if i >= train_cfg.steps:
+                break
+            if i < start_step:
+                continue  # replay-skip: keeps a seeded stream aligned
+            params, opt_state, loss = step(params, opt_state, jnp.asarray(batch))
+            if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
+                history.append(
+                    {"step": i + 1, "loss": float(loss),
+                     "seconds": time.monotonic() - t0}
+                )
+            if checkpoints is not None and (
+                (i + 1) % every == 0 or i == train_cfg.steps - 1
+            ):
+                checkpoints.save(
+                    i + 1, {"params": params, "opt_state": opt_state},
+                    metadata={"step": i + 1, "loss": float(loss)},
+                )
+    finally:
+        # Enqueued async saves become durable even when the loop
+        # raises — the crash-resume guarantee is the point.
+        flush(checkpoints)
     if pipelined:
         params = dict(params, blocks=unshard_blocks(params["blocks"]))
     return params, history

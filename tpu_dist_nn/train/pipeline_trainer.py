@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tpu_dist_nn.checkpoint.store import flush
 from tpu_dist_nn.data.datasets import Dataset
 from tpu_dist_nn.data.feed import batch_iterator
 from tpu_dist_nn.parallel.mesh import AXIS_DATA
@@ -123,42 +124,47 @@ def train_pipelined(
         checkpoints, {"weights": weights, "opt_state": opt_state}
     )
     weights, opt_state = state["weights"], state["opt_state"]
-    for epoch in range(start_epoch, config.epochs):
-        t0 = time.monotonic()
-        losses = []
-        batches = batch_iterator(
-            train_data.x,
-            train_data.y,
-            config.batch_size,
-            shuffle=True,
-            seed=config.seed + epoch,
-            drop_remainder=True,
-        )
-        for bx, by in batches:
-            xs, labels, mask = prepare_pipeline_batch(
-                meta, bx, by, num_microbatches, data_size, weights.w.dtype
+    try:
+        for epoch in range(start_epoch, config.epochs):
+            t0 = time.monotonic()
+            losses = []
+            batches = batch_iterator(
+                train_data.x,
+                train_data.y,
+                config.batch_size,
+                shuffle=True,
+                seed=config.seed + epoch,
+                drop_remainder=True,
             )
-            weights, opt_state, loss = step(
-                weights, opt_state, jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask)
-            )
-            losses.append(loss)
-        record = {
-            "epoch": epoch,
-            "loss": float(jnp.stack(losses).mean()),
-            "seconds": time.monotonic() - t0,
-        }
-        new_params = PipelineParams(weights=weights, meta=meta)
-        if eval_data is not None:
-            record["eval"] = evaluate_pipelined(
-                new_params, mesh, eval_data, num_microbatches=num_microbatches
-            )
-        history.append(record)
-        if checkpoints is not None:
-            checkpoints.save(
-                epoch + 1,
-                {"weights": weights, "opt_state": opt_state},
-                metadata=record,
-            )
+            for bx, by in batches:
+                xs, labels, mask = prepare_pipeline_batch(
+                    meta, bx, by, num_microbatches, data_size, weights.w.dtype
+                )
+                weights, opt_state, loss = step(
+                    weights, opt_state, jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask)
+                )
+                losses.append(loss)
+            record = {
+                "epoch": epoch,
+                "loss": float(jnp.stack(losses).mean()),
+                "seconds": time.monotonic() - t0,
+            }
+            new_params = PipelineParams(weights=weights, meta=meta)
+            if eval_data is not None:
+                record["eval"] = evaluate_pipelined(
+                    new_params, mesh, eval_data, num_microbatches=num_microbatches
+                )
+            history.append(record)
+            if checkpoints is not None:
+                checkpoints.save(
+                    epoch + 1,
+                    {"weights": weights, "opt_state": opt_state},
+                    metadata=record,
+                )
+    finally:
+        # Enqueued async saves become durable even when the loop
+        # raises — the crash-resume guarantee is the point.
+        flush(checkpoints)
     return PipelineParams(weights=weights, meta=meta), history
 
 

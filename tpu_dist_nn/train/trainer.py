@@ -24,6 +24,7 @@ from tpu_dist_nn.core.schema import ModelSpec, save_model
 from tpu_dist_nn.data.datasets import Dataset
 from tpu_dist_nn.data.feed import batch_iterator
 from tpu_dist_nn.models.fcnn import forward, forward_logits, spec_from_params
+from tpu_dist_nn.checkpoint.store import flush
 from tpu_dist_nn.train.metrics import classification_metrics
 
 
@@ -122,36 +123,41 @@ def run_training_loop(
         checkpoints, {"params": params, "opt_state": opt_state}
     )
     params, opt_state = state["params"], state["opt_state"]
-    for epoch in range(start_epoch, config.epochs):
-        t0 = time.monotonic()
-        losses = []
-        batches = batch_iterator(
-            train_data.x,
-            train_data.y,
-            config.batch_size,
-            shuffle=True,
-            seed=config.seed + epoch,
-            drop_remainder=True,  # stable shapes: one compiled step
-        )
-        for bx, by in batches:
-            params, opt_state, loss = step(
-                params, opt_state, jnp.asarray(bx, jnp.float32), jnp.asarray(by)
+    try:
+        for epoch in range(start_epoch, config.epochs):
+            t0 = time.monotonic()
+            losses = []
+            batches = batch_iterator(
+                train_data.x,
+                train_data.y,
+                config.batch_size,
+                shuffle=True,
+                seed=config.seed + epoch,
+                drop_remainder=True,  # stable shapes: one compiled step
             )
-            losses.append(loss)
-        record = {
-            "epoch": epoch,
-            "loss": float(jnp.stack(losses).mean()),
-            "seconds": time.monotonic() - t0,
-        }
-        if eval_fn is not None:
-            record["eval"] = eval_fn(params)
-        history.append(record)
-        if checkpoints is not None:
-            checkpoints.save(
-                epoch + 1,
-                {"params": params, "opt_state": opt_state},
-                metadata=record,
-            )
+            for bx, by in batches:
+                params, opt_state, loss = step(
+                    params, opt_state, jnp.asarray(bx, jnp.float32), jnp.asarray(by)
+                )
+                losses.append(loss)
+            record = {
+                "epoch": epoch,
+                "loss": float(jnp.stack(losses).mean()),
+                "seconds": time.monotonic() - t0,
+            }
+            if eval_fn is not None:
+                record["eval"] = eval_fn(params)
+            history.append(record)
+            if checkpoints is not None:
+                checkpoints.save(
+                    epoch + 1,
+                    {"params": params, "opt_state": opt_state},
+                    metadata=record,
+                )
+    finally:
+        # Enqueued async saves become durable even when the loop
+        # raises — the crash-resume guarantee is the point.
+        flush(checkpoints)
     return params, history
 
 
