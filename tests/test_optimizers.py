@@ -140,3 +140,85 @@ def test_pipelined_weight_decay_preserves_identity_fillers():
     got = np.asarray(pipeline_forward(mesh, trained, x, num_microbatches=2))
     want = oracle_forward_batch(exported, x)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_grad_accum_matches_large_batch():
+    # k micro-steps of batch B with grad_accum=k == 1 step of batch k*B
+    # (grad averaging) — exact trajectory parity.
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.train.lm_trainer import make_lm_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, (8, 16)), jnp.int32
+    )
+
+    big = build_optimizer(1e-2)
+    p_big, s_big = params, big.init(params)
+    step_big = make_lm_train_step(cfg, big)
+    p_big, s_big, _ = step_big(p_big, s_big, tokens)
+
+    acc = build_optimizer(1e-2, grad_accum=2)
+    p_acc, s_acc = params, acc.init(params)
+    step_acc = make_lm_train_step(cfg, acc)
+    for half in (tokens[:4], tokens[4:]):
+        p_acc, s_acc, _ = step_acc(p_acc, s_acc, half)
+
+    # Mean-of-half-means == full mean up to float reassociation; Adam's
+    # rsqrt then amplifies that ~1e-7 grad noise to a few % of lr at
+    # near-zero-gradient coordinates — compare at the lr scale.
+    for orig, a, b in zip(
+        jax.tree.leaves(params), jax.tree.leaves(p_big), jax.tree.leaves(p_acc)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+        assert not np.array_equal(np.asarray(b), np.asarray(orig))
+
+
+def test_grad_accum_no_update_until_k_steps():
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.train.lm_trainer import make_lm_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq_len=16,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 32, (4, 16)), jnp.int32
+    )
+    opt = build_optimizer(1e-2, grad_accum=3)
+    step = make_lm_train_step(cfg, opt)
+    p, s, _ = step(params, opt.init(params), tokens)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grad_accum_validation():
+    with pytest.raises(ValueError, match="grad_accum"):
+        build_optimizer(1e-3, grad_accum=0)
+
+
+def test_grad_accum_unit_conversion_and_validation():
+    import warnings
+
+    # Micro-step units convert internally: this was a crash when the
+    # caller pre-scaled total but not warmup.
+    opt = build_optimizer(1e-3, schedule="cosine", warmup_steps=60,
+                          total_steps=200, grad_accum=4)
+    assert opt is not None
+    with pytest.raises(ValueError, match="no optimizer update"):
+        build_optimizer(1e-3, total_steps=2, grad_accum=4)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        build_optimizer(1e-3, total_steps=10, grad_accum=4)
+    assert any("never apply" in str(x.message) for x in w)

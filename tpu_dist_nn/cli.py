@@ -187,6 +187,7 @@ def cmd_train(args) -> int:
         batch_size=args.batch_size, seed=args.seed,
         clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
         lr_schedule=args.lr_schedule, weight_decay=args.weight_decay,
+        grad_accum=args.grad_accum,
     )
     checkpoints = None
     if args.checkpoint_dir:
@@ -374,6 +375,7 @@ def cmd_lm(args) -> int:
         batch_size=args.batch_size, seq_len=args.seq_len,
         clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
         lr_schedule=args.lr_schedule, weight_decay=args.weight_decay,
+        grad_accum=args.grad_accum,
     )
     batches = lm_batches(
         train_rows, args.batch_size, seed=args.seed, epochs=None
@@ -540,6 +542,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="constant")
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="decoupled (AdamW) weight decay")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="average gradients over N micro-steps per "
+                        "optimizer update (N x effective batch at one "
+                        "micro-batch's memory)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", help="export trained model JSON here")
     p.add_argument("--checkpoint-dir",
@@ -567,6 +573,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="constant")
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help="decoupled (AdamW) weight decay")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="average gradients over N micro-steps per "
+                        "optimizer update (N x effective batch at one "
+                        "micro-batch's memory)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")

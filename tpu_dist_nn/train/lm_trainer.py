@@ -39,6 +39,7 @@ class LMTrainConfig:
     warmup_steps: int = 0
     lr_schedule: str = "constant"
     weight_decay: float = 0.0
+    grad_accum: int = 1
 
 
 def _resolve_attn_fn(attn_fn):
@@ -143,6 +144,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         total_steps=train_cfg.steps,
         clip_norm=train_cfg.clip_norm,
         weight_decay=train_cfg.weight_decay,
+        grad_accum=train_cfg.grad_accum,
     )
     pipelined = step_fn is None and mesh is not None and num_stages > 1
     if step_fn is not None:

@@ -27,11 +27,22 @@ def build_optimizer(
     total_steps: int | None = None,
     clip_norm: float | None = None,
     weight_decay: float = 0.0,
+    grad_accum: int = 1,
 ) -> optax.GradientTransformation:
     """-> the trainers' gradient transformation (see module docstring).
 
     ``total_steps`` is required for ``schedule="cosine"`` (the decay
-    horizon) and otherwise unused.
+    horizon) and otherwise unused. ``grad_accum > 1`` wraps the chain
+    in ``optax.MultiSteps``: gradients average over that many
+    micro-steps before one real update — an N× effective batch at one
+    micro-batch's activation memory (the single-chip complement of the
+    pipeline's microbatching).
+
+    **Units:** ``warmup_steps`` and ``total_steps`` are in the
+    caller's *micro*-steps (what ``--steps``/``--warmup-steps`` mean);
+    the conversion to real optimizer updates (which is what schedules
+    tick on under MultiSteps) happens here, in one place, so callers
+    cannot drift.
     """
     if schedule not in ("constant", "cosine"):
         raise ValueError(f"unknown lr schedule: {schedule!r}")
@@ -41,6 +52,28 @@ def build_optimizer(
         raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
     if weight_decay < 0:
         raise ValueError(f"weight_decay must be >= 0, got {weight_decay}")
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if grad_accum > 1:
+        if total_steps is not None:
+            if total_steps < grad_accum:
+                raise ValueError(
+                    f"total_steps={total_steps} < grad_accum={grad_accum}: "
+                    "no optimizer update would ever run"
+                )
+            if total_steps % grad_accum:
+                import warnings
+
+                warnings.warn(
+                    f"total_steps={total_steps} is not a multiple of "
+                    f"grad_accum={grad_accum}: the final "
+                    f"{total_steps % grad_accum} micro-steps accumulate "
+                    "gradients that never apply",
+                    stacklevel=2,
+                )
+            total_steps = total_steps // grad_accum
+        # Ceil: "at least this much warmup" survives the conversion.
+        warmup_steps = -(-warmup_steps // grad_accum)
 
     if schedule == "cosine":
         if not total_steps or total_steps <= warmup_steps:
@@ -72,4 +105,7 @@ def build_optimizer(
         parts.append(optax.adamw(lr, weight_decay=weight_decay))
     else:
         parts.append(optax.adam(lr))
-    return optax.chain(*parts) if len(parts) > 1 else parts[0]
+    opt = optax.chain(*parts) if len(parts) > 1 else parts[0]
+    if grad_accum > 1:
+        opt = optax.MultiSteps(opt, every_k_schedule=grad_accum)
+    return opt

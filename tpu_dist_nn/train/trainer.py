@@ -43,6 +43,7 @@ class TrainConfig:
     warmup_steps: int = 0
     lr_schedule: str = "constant"
     weight_decay: float = 0.0
+    grad_accum: int = 1
 
 
 def optimizer_for(config: TrainConfig, train_data: "Dataset"):
@@ -58,6 +59,7 @@ def optimizer_for(config: TrainConfig, train_data: "Dataset"):
         total_steps=steps_per_epoch * config.epochs,
         clip_norm=config.clip_norm,
         weight_decay=config.weight_decay,
+        grad_accum=config.grad_accum,
     )
 
 
