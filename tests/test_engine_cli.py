@@ -254,3 +254,26 @@ def test_serve_loop_tears_down(model_file):
     _serve_loop(engine, max_seconds=0.3)
     with pytest.raises(UnavailableError):
         engine.infer(np.zeros((1, 12)))
+
+
+def test_engine_idempotent_relaunch(model_file):
+    # The reference's clean-teardown / stateless-relaunch contract
+    # (run_grpc_fcnn.py:329-344 + stale-resource sweep on next launch):
+    # down() then up() from the same JSON reproduces identical outputs,
+    # and down() twice is harmless.
+    x = random_inputs(6, 12, seed=5)
+    e1 = Engine.up(model_file, [1, 1, 1])
+    first = e1.run_inference(x).outputs
+    e1.down()
+    e1.down()  # idempotent
+    assert not e1.health()["ready"]
+    from tpu_dist_nn.utils.errors import UnavailableError
+
+    with pytest.raises(UnavailableError):
+        e1.run_inference(x)
+    e2 = Engine.up(model_file, [1, 1, 1])
+    second = e2.run_inference(x).outputs
+    np.testing.assert_allclose(
+        np.asarray(first), np.asarray(second), rtol=1e-6
+    )
+    e2.down()

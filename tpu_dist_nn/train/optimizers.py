@@ -77,9 +77,15 @@ def build_optimizer(
 
     if schedule == "cosine":
         if not total_steps or total_steps <= warmup_steps:
+            detail = f"({total_steps} vs {warmup_steps}"
+            if grad_accum > 1:
+                detail += (
+                    f" real updates, converted from the given micro-step "
+                    f"counts by grad_accum={grad_accum}"
+                )
             raise ValueError(
                 f"cosine schedule needs total_steps > warmup_steps "
-                f"({total_steps} vs {warmup_steps})"
+                f"{detail})"
             )
         lr = optax.warmup_cosine_decay_schedule(
             init_value=0.0,
