@@ -277,3 +277,24 @@ def test_engine_idempotent_relaunch(model_file):
         np.asarray(first), np.asarray(second), rtol=1e-6
     )
     e2.down()
+
+
+def test_cli_lm_seq_parallel(capsys):
+    # Ring-attention training from the CLI: seq axis 2 x data 4.
+    rc = cli_main([
+        "lm", "--d-model", "16", "--heads", "2", "--layers", "1",
+        "--seq-len", "15", "--steps", "3", "--batch-size", "8",
+        "--seq-parallel", "2", "--data-parallel", "4",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["final_train_loss"] > 0
+
+
+def test_cli_lm_seq_parallel_rejections(capsys):
+    assert cli_main(["lm", "--experts", "2", "--seq-parallel", "2"]) == 2
+    assert "dense LM only" in capsys.readouterr().err
+    assert cli_main([
+        "lm", "--seq-parallel", "2", "--seq-len", "16", "--steps", "1",
+    ]) == 2
+    assert "divisible" in capsys.readouterr().err

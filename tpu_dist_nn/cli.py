@@ -264,6 +264,8 @@ def cmd_lm(args) -> int:
         raise ValueError("--remat supports the dense LM only")
     if args.zero1 and moe:
         raise ValueError("--zero1 supports the dense LM only")
+    if args.seq_parallel > 1 and moe:
+        raise ValueError("--seq-parallel supports the dense LM only")
     if args.fsdp and moe:
         raise ValueError("--fsdp supports the dense LM only")
     common = dict(
@@ -272,7 +274,10 @@ def cmd_lm(args) -> int:
         n_heads=args.heads,
         n_layers=args.layers,
         d_ff=4 * args.d_model,
-        max_seq_len=args.seq_len,
+        # The sp loss feeds full (seq_len+1)-token rows (inputs +
+        # next-token targets) through the forward, so its positional
+        # table needs one extra row.
+        max_seq_len=args.seq_len + (1 if args.seq_parallel > 1 else 0),
         compute_dtype="bfloat16" if args.bf16 else "float32",
         remat=args.remat,
     )
@@ -326,10 +331,43 @@ def cmd_lm(args) -> int:
                     "--zero1/--fsdp compose with --data-parallel only "
                     "(state already lives per-stage in the pipeline)"
                 )
+            if args.seq_parallel > 1:
+                raise ValueError(
+                    "--seq-parallel with --stages is not supported yet"
+                )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 
             mesh = build_mesh(
                 MeshSpec(stage=args.stages, data=args.data_parallel)
+            )
+        elif args.seq_parallel > 1:
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.train.lm_trainer import (
+                make_seq_parallel_lm_train_step,
+            )
+
+            if args.zero1 or args.fsdp:
+                raise ValueError(
+                    "--seq-parallel does not compose with --zero1/--fsdp yet"
+                )
+            # LM rows carry seq_len+1 tokens (inputs + next-token
+            # targets); the sp loss feeds the full row to the ring.
+            if (args.seq_len + 1) % args.seq_parallel:
+                raise ValueError(
+                    f"--seq-len+1 ({args.seq_len + 1}) must be divisible "
+                    f"by --seq-parallel {args.seq_parallel} (rows carry "
+                    "the next-token target)"
+                )
+            if args.batch_size % args.data_parallel:
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible by "
+                    f"--data-parallel {args.data_parallel}"
+                )
+            sp_mesh = build_mesh(
+                MeshSpec(seq=args.seq_parallel, data=args.data_parallel)
+            )
+            step_fn = lambda opt: make_seq_parallel_lm_train_step(  # noqa: E731
+                sp_mesh, cfg, opt
             )
         elif args.zero1 or args.fsdp:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
@@ -581,6 +619,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
     p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="shard the sequence axis over N devices "
+                        "(ring attention) for long-context training")
     p.add_argument("--microbatches", type=int, default=4)
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (f32 master params + CE)")

@@ -141,6 +141,15 @@ def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig):
             raise ValueError(
                 f"sequence length {T} not divisible by seq axis {seq_devices}"
             )
+        if T > cfg.max_seq_len:
+            # Without this, the global-position gather into pos_embed
+            # would silently clamp at the table edge (wrong embeddings
+            # for the tail positions).
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (sp feeds full input+target rows: "
+                "size the table seq_len+1)"
+            )
         return fn(params, tokens)
 
     return forward

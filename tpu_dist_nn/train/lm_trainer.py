@@ -87,6 +87,15 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
+def make_seq_parallel_lm_train_step(mesh, cfg: TransformerConfig, optimizer):
+    """Sequence-parallel (ring attention) train step over the mesh's
+    ``seq`` axis; tokens arrive as full (inputs+target) rows — the sp
+    loss masks position 0 instead of slicing (ring_attention.py)."""
+    from tpu_dist_nn.parallel.ring_attention import make_seq_parallel_lm_loss
+
+    return jax.jit(make_step_body(make_seq_parallel_lm_loss(mesh, cfg), optimizer))
+
+
 def make_moe_lm_train_step(cfg, optimizer, mesh=None, attn_fn=None):
     """MoE train step: single-chip (``mesh=None``, grouped oracle) or
     expert-parallel over the mesh's ``expert`` axis (all_to_all
