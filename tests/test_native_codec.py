@@ -186,3 +186,44 @@ def test_nested_weights_rejected_native():
         {"weights": [[1.0, 2.0], [3.0, 4.0]], "bias": 0.0}]}]}
     with pytest.raises(ValueError, match="flat array"):
         parse_model_layers(json.dumps(obj).encode())
+
+
+def test_fuzz_model_roundtrip_native_vs_python():
+    # Randomized models: native parse vs pure-python parse must agree
+    # bit-for-bit on shapes, values, and activations (the C++ codec is
+    # the fast path for the same schema, never a different dialect).
+    import json
+
+    from tpu_dist_nn.core.schema import ModelSpec
+    from tpu_dist_nn.testing.factories import random_model
+
+    rng = np.random.default_rng(0)
+    acts = ["relu", "sigmoid", "tanh", "softmax", "linear", "weird-name"]
+    for trial in range(25):
+        depth = int(rng.integers(1, 5))
+        sizes = [int(rng.integers(1, 9)) for _ in range(depth + 1)]
+        layer_acts = [str(rng.choice(acts)) for _ in range(depth)]
+        model = random_model(sizes, activations=layer_acts, seed=trial)
+        blob = json.dumps(model.to_json_dict()).encode()
+        native_layers, _span = parse_model_layers(blob)
+        ref = ModelSpec.from_json_dict(json.loads(blob))
+        assert len(native_layers) == len(ref.layers)
+        for nat, r in zip(native_layers, ref.layers):
+            np.testing.assert_array_equal(nat["weights"], r.weights)
+            np.testing.assert_array_equal(nat["biases"], r.biases)
+            assert nat["activation"] == r.activation
+
+
+def test_fuzz_examples_roundtrip_native(tmp_path):
+    rng = np.random.default_rng(1)
+    for trial in range(10):
+        n = int(rng.integers(1, 40))
+        d = int(rng.integers(1, 30))
+        x = rng.uniform(-5, 5, (n, d))
+        y = rng.integers(0, 10, n)
+        p = tmp_path / f"ex_{trial}.json"
+        save_examples(x, y, p)
+        x2, y2 = load_examples(p)
+        # Bit-exact: the writer uses %.17g precisely so f64 survives.
+        np.testing.assert_array_equal(x2, x)
+        np.testing.assert_array_equal(y2, y)
