@@ -16,8 +16,11 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# Keep f32 matmuls exact on CPU so oracle-parity tolerances are meaningful.
-os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+# Keep f32 matmuls exact on CPU so oracle-parity tolerances are
+# meaningful. NOT under TDN_TEST_TPU=1: the hardware gates measure the
+# chip's default-precision MXU path, which this would mask.
+if os.environ.get("TDN_TEST_TPU", "0") != "1":
+    os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
 import jax  # noqa: E402
 

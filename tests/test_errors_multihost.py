@@ -79,3 +79,28 @@ def test_single_process_topology_noop():
     assert not topo.is_multihost
     assert topo.local_device_count == topo.global_device_count == 8
     assert current_topology() == topo
+
+
+def test_cli_multihost_noop_and_oracle_path(tmp_path, capsys):
+    # Without --coordinator the init is a single-process no-op; the
+    # oracle subcommand (which doesn't register the multihost args)
+    # must also pass through _init_multihost's getattr defaults.
+    from tpu_dist_nn.cli import main as cli_main
+    from tpu_dist_nn.core.schema import save_examples, save_model
+    from tpu_dist_nn.testing.factories import random_inputs, random_model
+
+    model = random_model([6, 4, 3], seed=0)
+    mp = tmp_path / "m.json"
+    save_model(model, mp)
+    xp = tmp_path / "x.json"
+    save_examples(random_inputs(2, 6, seed=1), np.array([0, 1]), xp)
+    assert cli_main(["oracle", "--config", str(mp), "--inputs", str(xp)]) == 0
+    assert "Average inference time" in capsys.readouterr().out
+
+
+def test_cli_rejects_host_flags_without_coordinator(capsys):
+    from tpu_dist_nn.cli import main as cli_main
+
+    rc = cli_main(["lm", "--steps", "1", "--num-hosts", "4", "--host-id", "1"])
+    assert rc == 2
+    assert "--coordinator" in capsys.readouterr().err

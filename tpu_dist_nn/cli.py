@@ -28,6 +28,52 @@ logging.basicConfig(
 log = logging.getLogger("tpu_dist_nn.cli")
 
 
+def _add_multihost_args(p):
+    p.add_argument("--coordinator",
+                   help="multi-host: coordinator address host:port "
+                        "(jax.distributed over DCN); every host runs "
+                        "the same command")
+    p.add_argument("--num-hosts", type=int, default=None)
+    p.add_argument("--host-id", type=int, default=None)
+
+
+def _init_multihost(args) -> None:
+    """Join the multi-process job BEFORE any backend use (multihost.py
+    notes why ordering matters).
+
+    Only runs for subcommands that registered the multihost args —
+    oracle/import-torch never touch JAX and must not initialize the
+    backend (on a TPU host, libtpu acquisition is exclusive). Without
+    ``--coordinator`` or a pod environment nothing is called at all.
+    """
+    import os
+
+    if not hasattr(args, "coordinator"):
+        return
+    if args.coordinator is None:
+        if args.num_hosts is not None or args.host_id is not None:
+            raise ValueError(
+                "--num-hosts/--host-id require --coordinator (without it "
+                "this process would silently train single-host)"
+            )
+        auto_env = any(
+            v in os.environ
+            for v in ("COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID",
+                      "TPU_WORKER_ID")
+        )
+        if not auto_env:
+            return  # plain single-host run: touch nothing
+    from tpu_dist_nn.parallel.multihost import initialize_multihost
+
+    topo = initialize_multihost(args.coordinator, args.num_hosts, args.host_id)
+    if topo.is_multihost:
+        log.info(
+            "multi-host job: process %d/%d, %d local / %d global devices",
+            topo.process_id, topo.num_processes,
+            topo.local_device_count, topo.global_device_count,
+        )
+
+
 def _parse_distribution(text):
     if text is None:
         return None
@@ -532,6 +578,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("up", help="validate, place, compile (orchestrator)")
     _add_up_args(p)
+    _add_multihost_args(p)
     p.add_argument("--probe-latency", action="store_true",
                    help="report p50/p90/p99 pipeline step latency "
                         "(the BASELINE per-stage metric)")
@@ -543,6 +590,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("infer", help="run inference (client)")
     p.add_argument("input_index", nargs="?", type=int, default=None)
     _add_up_args(p)
+    _add_multihost_args(p)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--port", type=int, default=None,
                    help="compat no-op (no sockets in the data path)")
@@ -561,6 +609,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_import_torch)
 
     p = sub.add_parser("train", help="native on-TPU training")
+    _add_multihost_args(p)
     p.add_argument("--config", help="start from an existing model JSON")
     p.add_argument("--layers", default="784,128,64,10",
                    help="fresh model sizes (generate_mnist_pytorch.py:25-27)")
@@ -595,6 +644,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("lm", help="train + eval the Tiny-Transformer LM")
+    _add_multihost_args(p)
     p.add_argument("--corpus", help="path to a text corpus (WikiText-2); "
                    "falls back to the synthetic corpus")
     p.add_argument("--d-model", type=int, default=128)
@@ -671,6 +721,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
+        _init_multihost(args)
         return args.fn(args)
     except (ValueError, FileNotFoundError) as e:
         # Config/placement errors are user errors, not crashes — the
