@@ -1,0 +1,100 @@
+"""Orbax-backed checkpoint manager — the industry-standard TPU format.
+
+Same call surface as :class:`tpu_dist_nn.checkpoint.CheckpointManager`
+(``save / restore / restore_or_none / steps / latest_step``), so every
+trainer's ``checkpoints=`` parameter accepts it unchanged, and
+``resume_or_init`` works as-is. Use it when checkpoints must interop
+with the wider JAX ecosystem (multi-host sharded saves, OCDBT); the
+native msgpack store (``store.py``) remains the zero-dependency default
+and the reference-parity JSON model file remains the public interchange
+format (SURVEY.md §5 checkpoint: "the JSON model file IS the
+checkpoint format" — both stores only add the training-state fast path
+the reference never had).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+
+class OrbaxCheckpointManager:
+    """Step-indexed Orbax checkpoints with retention.
+
+    Writes through ``orbax.checkpoint.CheckpointManager`` with
+    ``StandardSave/RestoreArgs`` — sharded arrays save per-host shards
+    and restore to the template's placement, which is exactly the
+    template-based restore contract of the native store.
+
+    Note: Orbax rejects bare numpy *scalars* (``np.int32(3)``) as
+    leaves; use 0-d arrays. Trainer states here hold jax arrays, which
+    are fine.
+    """
+
+    def __init__(self, directory: str | Path, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True
+            ),
+        )
+
+    def steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: Any, metadata: dict | None = None):
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                **(
+                    {"metadata": ocp.args.JsonSave(metadata)}
+                    if metadata else {}
+                ),
+            ),
+        )
+        return self.directory / str(int(step))
+
+    def restore(self, template: Any, step: int | None = None):
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints in {self.directory}"
+                )
+        restored = self._mgr.restore(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template)
+            ),
+        )
+        return int(step), restored["state"]
+
+    def restore_or_none(self, template: Any):
+        try:
+            return self.restore(template)
+        except FileNotFoundError:
+            return None
+
+    def wait(self) -> None:
+        """Drain any async Orbax writes (same contract as
+        :meth:`AsyncCheckpointManager.wait` — trainers' ``flush`` picks
+        this up)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

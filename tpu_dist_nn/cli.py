@@ -74,6 +74,38 @@ def _init_multihost(args) -> None:
         )
 
 
+def _validate_checkpoint_flags(args) -> None:
+    """Fail flag-combination errors BEFORE data loading / Engine.up
+    (which is expensive on real hardware)."""
+    if getattr(args, "checkpoint_format", "native") != "orbax":
+        return
+    if args.async_checkpoints:
+        raise ValueError(
+            "--async-checkpoints is the native store's writer; Orbax "
+            "has its own async pipeline (drop the flag)"
+        )
+    try:
+        import orbax.checkpoint  # noqa: F401
+    except ImportError as e:
+        raise ValueError(
+            f"--checkpoint-format orbax needs orbax installed ({e}); "
+            "pip install orbax-checkpoint"
+        ) from e
+
+
+def _make_checkpoint_manager(args):
+    if args.checkpoint_format == "orbax":
+        from tpu_dist_nn.checkpoint.orbax_store import OrbaxCheckpointManager
+
+        return OrbaxCheckpointManager(
+            args.checkpoint_dir, keep=args.keep_checkpoints
+        )
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager, CheckpointManager
+
+    manager = AsyncCheckpointManager if args.async_checkpoints else CheckpointManager
+    return manager(args.checkpoint_dir, keep=args.keep_checkpoints)
+
+
 def _parse_distribution(text):
     if text is None:
         return None
@@ -177,6 +209,7 @@ def cmd_infer(args) -> int:
 
 
 def cmd_train(args) -> int:
+    _validate_checkpoint_flags(args)
     from tpu_dist_nn.core.schema import load_model
     from tpu_dist_nn.data.datasets import (
         load_mnist_idx,
@@ -237,10 +270,7 @@ def cmd_train(args) -> int:
     )
     checkpoints = None
     if args.checkpoint_dir:
-        from tpu_dist_nn.checkpoint import AsyncCheckpointManager, CheckpointManager
-
-        manager = AsyncCheckpointManager if args.async_checkpoints else CheckpointManager
-        checkpoints = manager(args.checkpoint_dir, keep=args.keep_checkpoints)
+        checkpoints = _make_checkpoint_manager(args)
     history = engine.train(data, cfg, eval_data=eval_data, checkpoints=checkpoints)
     for h in history:
         msg = f"epoch {h['epoch']}: loss {h['loss']:.4f} ({h['seconds']:.2f}s)"
@@ -304,6 +334,7 @@ def cmd_lm(args) -> int:
                 f"positions within --seq-len {args.seq_len}"
             )
 
+    _validate_checkpoint_flags(args)
     if args.remat and moe:
         # The MoE forward is not scan-based; a silently ignored flag is
         # worse than an error.
@@ -466,12 +497,7 @@ def cmd_lm(args) -> int:
     )
     checkpoints = None
     if args.checkpoint_dir:
-        from tpu_dist_nn.checkpoint import AsyncCheckpointManager, CheckpointManager
-
-        manager = AsyncCheckpointManager if args.async_checkpoints else CheckpointManager
-        checkpoints = manager(
-            args.checkpoint_dir, keep=args.keep_checkpoints
-        )
+        checkpoints = _make_checkpoint_manager(args)
     t0 = time.monotonic()
     params, history = train_lm(
         params, cfg, batches, train_cfg, mesh=mesh,
@@ -641,6 +667,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--async-checkpoints", action="store_true",
                    help="write checkpoints on a background thread "
                         "(the step loop never blocks on disk)")
+    p.add_argument("--checkpoint-format", choices=["native", "orbax"],
+                   default="native",
+                   help="native msgpack store or the Orbax ecosystem "
+                        "format")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("lm", help="train + eval the Tiny-Transformer LM")
@@ -698,6 +728,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--async-checkpoints", action="store_true",
                    help="write checkpoints on a background thread "
                         "(the step loop never blocks on disk)")
+    p.add_argument("--checkpoint-format", choices=["native", "orbax"],
+                   default="native",
+                   help="native msgpack store or the Orbax ecosystem "
+                        "format")
     p.add_argument("--sample-bytes", type=int, default=0,
                    help="generate this many bytes after training")
     p.add_argument("--prompt", default="The ", help="generation prompt")

@@ -6,7 +6,9 @@ training array, plus a dtype normalize when the wire format is integer
 (uint8 pixels). Both run as multithreaded C++
 (``native/tdn_loader.cc``) when the native library is available and
 fall back to numpy transparently — results are bit-identical either
-way.
+way. (The reference has no data loader at all: it json.loads the
+whole examples file on the client, ``run_grpc_inference.py:35-52``;
+this is the native fast path that SURVEY.md §7 hard part 4 calls for.)
 """
 
 from __future__ import annotations

@@ -1,4 +1,9 @@
-"""Test/bench fixtures: random models and synthetic datasets."""
+"""Test/bench fixtures: random models and synthetic datasets.
+
+Models follow the reference schema semantics (per-neuron rows,
+``config/config_sample.json`` shape) so every factory-made model also
+round-trips the public JSON contract.
+"""
 
 from __future__ import annotations
 
