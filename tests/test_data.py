@@ -101,3 +101,16 @@ def test_synthetic_fashion_mnist_shapes_and_determinism():
 
     c = synthetic_mnist(64, dim=784, seed=3)
     assert not np.allclose(a.x, c.x)
+
+
+def test_shard_for_host_single_process_identity():
+    from tpu_dist_nn.data.feed import shard_for_host
+
+    x = np.arange(12).reshape(6, 2)
+    y = np.arange(6)
+    gx, gy = shard_for_host(x, y)
+    np.testing.assert_array_equal(gx, x)
+    np.testing.assert_array_equal(gy, y)
+    np.testing.assert_array_equal(shard_for_host(x), x)
+    with pytest.raises(ValueError, match="leading dim"):
+        shard_for_host(x, np.arange(5))

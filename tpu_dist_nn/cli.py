@@ -77,6 +77,8 @@ def _init_multihost(args) -> None:
 def _validate_checkpoint_flags(args) -> None:
     """Fail flag-combination errors BEFORE data loading / Engine.up
     (which is expensive on real hardware)."""
+    if not getattr(args, "checkpoint_dir", None):
+        return  # no manager will be built; flags are inert
     if getattr(args, "checkpoint_format", "native") != "orbax":
         return
     if args.async_checkpoints:
@@ -252,6 +254,14 @@ def cmd_train(args) -> int:
             num_classes=model.output_dim, seed=args.seed,
         )
         data, eval_data = full.split(0.9, seed=args.seed)
+
+    from tpu_dist_nn.data.datasets import Dataset
+    from tpu_dist_nn.data.feed import shard_for_host
+
+    # Multi-host: each process trains on its own stripe (eval stays
+    # global so every host reports the same metrics).
+    sx, sy = shard_for_host(data.x, data.y)
+    data = Dataset(sx, sy, data.num_classes)
 
     from tpu_dist_nn.api.engine import Engine
 
@@ -474,6 +484,10 @@ def cmd_lm(args) -> int:
     rows = lm_sequences(tokens, args.seq_len)
     split = max(1, int(len(rows) * 0.95))
     train_rows, eval_rows = rows[:split], rows[split:]
+    from tpu_dist_nn.data.feed import shard_for_host
+
+    # Multi-host: per-process training stripe; eval stays global.
+    train_rows = shard_for_host(train_rows)
     params = init_fn(jax.random.key(args.seed), cfg)
     if unshard_fn is not None:  # EP mesh path: apply the shard layout
         params = dict(

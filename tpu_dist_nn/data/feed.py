@@ -78,3 +78,35 @@ def device_prefetch(batches: Iterable, depth: int = 2, sharding=None) -> Iterato
         except StopIteration:
             pass
         yield out
+
+
+def shard_for_host(*arrays):
+    """Slice this process's stripe of globally-ordered host data.
+
+    Multi-host SPMD (``parallel/multihost.py``) requires every process
+    to run the same program on *different* data; the reference's
+    analogue is that each container only ever saw its own gRPC inputs.
+    Rows are striped contiguously: process ``p`` of ``N`` takes rows
+    ``[p*per, (p+1)*per)`` with ``per = len // N`` — every process
+    holds exactly the same count (trailing remainder rows are DROPPED;
+    unequal shards would desynchronize the hosts' collective counts
+    and deadlock the job). Single-process: identity, nothing dropped.
+
+    Returns one array or a tuple matching the inputs; all inputs must
+    share their leading dimension.
+    """
+    import jax
+
+    n = jax.process_count()
+    lens = {len(a) for a in arrays}
+    if len(lens) != 1:
+        raise ValueError(f"arrays disagree on leading dim: {sorted(lens)}")
+    if n == 1:
+        return arrays[0] if len(arrays) == 1 else arrays
+    total = lens.pop()
+    per = total // n
+    if per == 0:
+        raise ValueError(f"{total} rows cannot stripe over {n} processes")
+    start = jax.process_index() * per
+    out = tuple(a[start : start + per] for a in arrays)
+    return out[0] if len(out) == 1 else out
