@@ -298,3 +298,16 @@ def test_cli_lm_seq_parallel_rejections(capsys):
         "lm", "--seq-parallel", "2", "--seq-len", "16", "--steps", "1",
     ]) == 2
     assert "divisible" in capsys.readouterr().err
+
+
+def test_cli_metrics_out(tmp_path, capsys):
+    out = tmp_path / "metrics.jsonl"
+    rc = cli_main([
+        "train", "--layers", "12,8,4", "--num-examples", "200",
+        "--epochs", "2", "--batch-size", "32",
+        "--metrics-out", str(out),
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(records) == 2
+    assert {"epoch", "loss", "seconds"} <= set(records[0])

@@ -108,6 +108,24 @@ def _make_checkpoint_manager(args):
     return manager(args.checkpoint_dir, keep=args.keep_checkpoints)
 
 
+def _write_metrics_jsonl(path, records) -> None:
+    """One JSON object per line — the structured metrics channel
+    (SURVEY.md §5 metrics: the reference only printed; this persists).
+
+    Multi-host: process 0 only — concurrent writes to a shared path
+    would interleave, and per-host records would cover only that
+    host's data stripe.
+    """
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    log.info("wrote %d metric records to %s", len(records), path)
+
+
 def _parse_distribution(text):
     if text is None:
         return None
@@ -282,6 +300,8 @@ def cmd_train(args) -> int:
     if args.checkpoint_dir:
         checkpoints = _make_checkpoint_manager(args)
     history = engine.train(data, cfg, eval_data=eval_data, checkpoints=checkpoints)
+    if args.metrics_out:
+        _write_metrics_jsonl(args.metrics_out, history)
     for h in history:
         msg = f"epoch {h['epoch']}: loss {h['loss']:.4f} ({h['seconds']:.2f}s)"
         if "eval" in h:
@@ -540,6 +560,10 @@ def cmd_lm(args) -> int:
         "eval_split": "held-out" if held_out else "full-dataset",
         **{k: round(v, 4) for k, v in eval_metrics.items()},
     }
+    if args.metrics_out:
+        _write_metrics_jsonl(
+            args.metrics_out, history + [{"final_report": report}]
+        )
     if args.sample_bytes > 0:
         import jax.numpy as jnp
 
@@ -675,6 +699,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "micro-batch's memory)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", help="export trained model JSON here")
+    p.add_argument("--metrics-out",
+                   help="write per-epoch training records as JSONL here")
     p.add_argument("--checkpoint-dir",
                    help="save per-epoch training state here and resume from it")
     p.add_argument("--keep-checkpoints", type=int, default=3)
@@ -746,6 +772,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default="native",
                    help="native msgpack store or the Orbax ecosystem "
                         "format")
+    p.add_argument("--metrics-out",
+                   help="write per-step training records + the final "
+                        "eval report as JSONL here")
     p.add_argument("--sample-bytes", type=int, default=0,
                    help="generate this many bytes after training")
     p.add_argument("--prompt", default="The ", help="generation prompt")

@@ -95,8 +95,6 @@ def shard_for_host(*arrays):
     Returns one array or a tuple matching the inputs; all inputs must
     share their leading dimension.
     """
-    import jax
-
     n = jax.process_count()
     lens = {len(a) for a in arrays}
     if len(lens) != 1:

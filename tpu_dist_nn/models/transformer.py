@@ -26,7 +26,6 @@ can swap in blockwise attention while reusing everything else.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp

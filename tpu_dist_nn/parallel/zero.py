@@ -26,7 +26,6 @@ replicated — correctness never depends on divisibility.
 from __future__ import annotations
 
 import jax
-import optax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
