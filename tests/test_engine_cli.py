@@ -309,5 +309,47 @@ def test_cli_metrics_out(tmp_path, capsys):
     ])
     assert rc == 0
     records = [json.loads(l) for l in out.read_text().splitlines()]
-    assert len(records) == 2
-    assert {"epoch", "loss", "seconds"} <= set(records[0])
+    assert records[0] == {"run": "begin"}  # per-invocation marker
+    epochs = records[1:]
+    assert len(epochs) == 2
+    assert {"epoch", "loss", "seconds"} <= set(epochs[0])
+
+
+def test_cli_train_conv_config_pipelined(tmp_path, capsys):
+    # A conv+MLP model JSON through `tdn train --config` with a hetero
+    # placement: trains, exports, and the export re-serves.
+    import jax as _jax
+
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.models.network import init_conv_mlp
+
+    model = init_conv_mlp(
+        _jax.random.key(0), in_shape=(6, 6, 1), conv_filters=(3,),
+        hidden=(8,), num_classes=3,
+    )
+    mp = tmp_path / "conv.json"
+    save_model(model, mp)
+    out = tmp_path / "trained.json"
+    rc = cli_main([
+        "train", "--config", str(mp), "--num-examples", "200",
+        "--epochs", "2", "--batch-size", "32",
+        "--distribution", "2,1,1", "--out", str(out),
+    ])
+    assert rc == 0
+    assert out.exists()
+    from tpu_dist_nn.core.schema import load_model
+
+    trained = load_model(out)
+    assert [type(l).__name__ for l in trained.layers] == \
+        [type(l).__name__ for l in model.layers]
+    # The export actually re-serves: infer on it end-to-end.
+    from tpu_dist_nn.core.schema import save_examples
+
+    xp = tmp_path / "ex.json"
+    save_examples(
+        np.random.default_rng(0).uniform(0, 1, (4, model.input_dim)),
+        np.array([0, 1, 2, 0]), xp,
+    )
+    rc = cli_main(["infer", "--config", str(out), "--inputs", str(xp)])
+    assert rc == 0
+    assert "Total inference time" in capsys.readouterr().out

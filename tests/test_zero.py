@@ -139,3 +139,24 @@ def test_fsdp_matches_unsharded_loss_trajectory():
         p0, o0, l0 = base_step(p0, o0, tokens)
         p1, o1, l1 = fsdp_step(p1, o1, tokens)
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-4)
+
+
+def test_fsdp_composes_with_bf16_and_remat():
+    import dataclasses as dc
+
+    from tpu_dist_nn.parallel.zero import make_fsdp_lm_train_step
+
+    cfg = dc.replace(CFG, compute_dtype="bfloat16", remat=True)
+    mesh = build_mesh(MeshSpec(data=8))
+    params = init_transformer(jax.random.key(0), cfg)
+    optimizer = optax.adam(1e-3)
+    step = make_fsdp_lm_train_step(mesh, cfg, optimizer, params)
+    opt_state = step.init_opt_state(params)
+    p = params
+    losses = []
+    for i in range(4):
+        p, opt_state, loss = step(p, opt_state, _tokens(16, key=i % 2))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # Master params remain f32 (bf16 is the compute cast, not storage).
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(p))

@@ -108,9 +108,28 @@ def _make_checkpoint_manager(args):
     return manager(args.checkpoint_dir, keep=args.keep_checkpoints)
 
 
+def _validate_metrics_out(args) -> None:
+    """Fail a bad --metrics-out path BEFORE training, not after hours
+    of work (same up-front convention as _validate_checkpoint_flags)."""
+    import os
+
+    path = getattr(args, "metrics_out", None)
+    if not path:
+        return
+    parent = os.path.dirname(os.path.abspath(path))
+    if not os.path.isdir(parent):
+        raise ValueError(f"--metrics-out directory does not exist: {parent}")
+    if not os.access(parent, os.W_OK):
+        raise ValueError(f"--metrics-out directory is not writable: {parent}")
+
+
 def _write_metrics_jsonl(path, records) -> None:
     """One JSON object per line — the structured metrics channel
     (SURVEY.md §5 metrics: the reference only printed; this persists).
+
+    Appends with a ``{"run": "begin"}`` marker per invocation, so a
+    resumed run extends the file instead of overwriting the pre-crash
+    epochs (the lineage stays readable as one stream).
 
     Multi-host: process 0 only — concurrent writes to a shared path
     would interleave, and per-host records would cover only that
@@ -120,7 +139,8 @@ def _write_metrics_jsonl(path, records) -> None:
 
     if jax.process_index() != 0:
         return
-    with open(path, "w") as f:
+    with open(path, "a") as f:
+        f.write(json.dumps({"run": "begin"}) + "\n")
         for r in records:
             f.write(json.dumps(r) + "\n")
     log.info("wrote %d metric records to %s", len(records), path)
@@ -230,6 +250,7 @@ def cmd_infer(args) -> int:
 
 def cmd_train(args) -> int:
     _validate_checkpoint_flags(args)
+    _validate_metrics_out(args)
     from tpu_dist_nn.core.schema import load_model
     from tpu_dist_nn.data.datasets import (
         load_mnist_idx,
@@ -365,6 +386,7 @@ def cmd_lm(args) -> int:
             )
 
     _validate_checkpoint_flags(args)
+    _validate_metrics_out(args)
     if args.remat and moe:
         # The MoE forward is not scan-based; a silently ignored flag is
         # worse than an error.
