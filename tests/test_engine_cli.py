@@ -353,3 +353,11 @@ def test_cli_train_conv_config_pipelined(tmp_path, capsys):
     rc = cli_main(["infer", "--config", str(out), "--inputs", str(xp)])
     assert rc == 0
     assert "Total inference time" in capsys.readouterr().out
+
+
+def test_cli_doctor(capsys):
+    rc = cli_main(["doctor"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["healthy"] and report["oracle_parity"]
+    assert len(report["devices"]) == 8

@@ -110,17 +110,17 @@ def _make_checkpoint_manager(args):
 
 def _validate_metrics_out(args) -> None:
     """Fail a bad --metrics-out path BEFORE training, not after hours
-    of work (same up-front convention as _validate_checkpoint_flags)."""
-    import os
-
+    of work (same up-front convention as _validate_checkpoint_flags).
+    Probes with a real append-open, so directory targets, permission
+    problems, and missing parents all surface now."""
     path = getattr(args, "metrics_out", None)
     if not path:
         return
-    parent = os.path.dirname(os.path.abspath(path))
-    if not os.path.isdir(parent):
-        raise ValueError(f"--metrics-out directory does not exist: {parent}")
-    if not os.access(parent, os.W_OK):
-        raise ValueError(f"--metrics-out directory is not writable: {parent}")
+    try:
+        with open(path, "a"):
+            pass
+    except OSError as e:
+        raise ValueError(f"--metrics-out path is not writable: {e}") from e
 
 
 def _write_metrics_jsonl(path, records) -> None:
@@ -128,8 +128,9 @@ def _write_metrics_jsonl(path, records) -> None:
     (SURVEY.md §5 metrics: the reference only printed; this persists).
 
     Appends with a ``{"run": "begin"}`` marker per invocation, so a
-    resumed run extends the file instead of overwriting the pre-crash
-    epochs (the lineage stays readable as one stream).
+    checkpoint-resumed rerun pointed at the same path extends the
+    earlier invocation's records instead of overwriting them (markers
+    keep the per-invocation lineage readable as one stream).
 
     Multi-host: process 0 only — concurrent writes to a shared path
     would interleave, and per-host records would cover only that
@@ -658,6 +659,57 @@ def cmd_import_torch(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Environment self-check: what a support request needs up front —
+    backend, devices, native library, kernel lowering, oracle parity.
+    The operational analogue of the reference's readiness poll
+    (run_grpc_fcnn.py:157-172), extended to the whole stack."""
+    import jax
+
+    report = {}
+    report["backend"] = jax.default_backend()
+    report["devices"] = [str(d) for d in jax.devices()]
+    report["process_count"] = jax.process_count()
+
+    from tpu_dist_nn.native.loader import get_library
+
+    report["native_library"] = get_library() is not None
+
+    import numpy as _np
+
+    from tpu_dist_nn.models.fcnn import forward, init_fcnn, spec_from_params
+    from tpu_dist_nn.testing.oracle import oracle_forward_batch
+
+    params = init_fcnn(jax.random.key(0), [16, 8, 4])
+    model = spec_from_params(params, ["relu", "softmax"])
+    x = _np.random.default_rng(0).uniform(0, 1, (4, 16)).astype(_np.float32)
+    got = _np.asarray(jax.jit(forward)(params, x))
+    want = oracle_forward_batch(model, x)
+    err = float(_np.max(_np.abs(got - want)))
+    report["oracle_max_abs_err"] = err
+    report["oracle_parity"] = err < (5e-3 if report["backend"] == "tpu" else 1e-5)
+
+    try:
+        from tpu_dist_nn.kernels.fused_dense import fused_dense
+
+        import jax.numpy as jnp
+
+        out = fused_dense(
+            jnp.ones((8, 16)), jnp.ones((16, 8)), jnp.zeros((8,)),
+            activation="relu",
+        )
+        jax.block_until_ready(out)
+        report["pallas_kernels"] = "ok"
+    except Exception as e:  # pragma: no cover - backend-specific
+        report["pallas_kernels"] = f"unavailable: {type(e).__name__}"
+
+    report["healthy"] = bool(
+        report["oracle_parity"] and report["devices"]
+    )
+    print(json.dumps(report, indent=2))
+    return 0 if report["healthy"] else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="tdn", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -808,6 +860,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=0.8,
                    help="0 = greedy")
     p.set_defaults(fn=cmd_lm)
+
+    p = sub.add_parser("doctor",
+                       help="environment self-check (backend, devices, "
+                            "native lib, kernels, oracle parity)")
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")
     p.add_argument("--config", required=True)
