@@ -46,6 +46,11 @@ def main() -> int:
         lambda p, bx: forward(p, bx.astype(jnp.float32) * scale)
     )
     try:
+        if jax.default_backend() != "tpu":
+            # Off-TPU the Pallas kernel runs in interpreter mode —
+            # orders of magnitude slower than the jit chain and not
+            # what this benchmark measures.
+            raise RuntimeError("non-TPU backend: benching the jit chain")
         from tpu_dist_nn.kernels.fused_dense import _fcnn_fused_call
 
         shapes = tuple((p["w"].shape, p["b"].shape) for p in params)
