@@ -388,13 +388,22 @@ class Engine:
         config: TrainConfig = TrainConfig(),
         eval_data: Dataset | None = None,
         checkpoints=None,
+        schedule: str = "gpipe",
     ) -> list[dict]:
         """Train in place (pipelined if placed that way); returns history.
 
         ``checkpoints`` (a :class:`tpu_dist_nn.checkpoint.CheckpointManager`)
         turns on epoch-level save + resume for whichever trainer flavor
-        this engine's placement selects.
+        this engine's placement selects. ``schedule`` ("gpipe" | "1f1b")
+        picks the pipeline training schedule; it only applies to the
+        pipelined placement (other placements have no schedule).
         """
+        # Validate regardless of placement: a typo'd schedule on a
+        # non-pipelined engine must not silently train with the default.
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {schedule!r}: use 'gpipe' or '1f1b'"
+            )
         if self._hp is not None:
             # The heterogeneous executor serves inference only; train on
             # the single-program executor and re-place the stages after
@@ -422,6 +431,7 @@ class Engine:
                 num_microbatches=self.num_microbatches,
                 eval_data=eval_data,
                 checkpoints=checkpoints,
+                schedule=schedule,
             )
             self.model = extract_model(self._pp, self.model, self.distribution)
         elif self._plan is not None:

@@ -321,7 +321,10 @@ def cmd_train(args) -> int:
     checkpoints = None
     if args.checkpoint_dir:
         checkpoints = _make_checkpoint_manager(args)
-    history = engine.train(data, cfg, eval_data=eval_data, checkpoints=checkpoints)
+    history = engine.train(
+        data, cfg, eval_data=eval_data, checkpoints=checkpoints,
+        schedule=args.schedule,
+    )
     if args.metrics_out:
         _write_metrics_jsonl(args.metrics_out, history)
     for h in history:
@@ -757,6 +760,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--distribution")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--microbatches", type=int, default=4)
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                   help="pipeline training schedule: gpipe (AD through the "
+                        "forward schedule) or 1f1b (activation-recompute, "
+                        "O(stages) live memory)")
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=1e-3)

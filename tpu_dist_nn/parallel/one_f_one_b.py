@@ -1,0 +1,290 @@
+"""1F1B (one-forward-one-backward) pipelined training schedule.
+
+The GPipe path (:mod:`tpu_dist_nn.train.pipeline_trainer`) differentiates
+straight through the forward schedule, which makes XLA stash every
+scan-step activation: live memory grows with the microbatch count M.
+This module hand-rolls the standard 1F1B schedule instead: each stage
+interleaves one backward between forwards as soon as the first gradient
+arrives, so at most ``S - s`` microbatches are ever in flight at stage
+``s`` — the activation stash is a ring buffer of ``min(S, M)`` slots,
+independent of M. Combined with activation recomputation (the backward
+tick re-runs the stage forward from the stashed *input* instead of
+keeping per-layer intermediates), live memory per stage is O(S·|mb|)
+instead of O(M·|mb|) — the reason 1F1B is the production schedule for
+deep pipelines.
+
+Timing: forward of microbatch ``f`` at stage ``s`` runs at tick
+``a(s,f) = s + 2f``; backward at ``b(s,f) = 2S-1-s + 2f``.  Forward and
+backward ticks of one stage fall on opposite parities, so every tick a
+stage does exactly one of {forward, backward, idle} — selected with
+``lax.switch`` on a device-local predicate so only the taken branch
+executes — while both hand-off wires (activations down, gradients up)
+ride a single unconditional ``lax.ppermute`` pair per tick over ICI.
+Total ticks ``T = 2(M + S - 1)``, the same bubble fraction as GPipe.
+
+The reference never trains across stages at all (SURVEY.md §3.5: its
+training is centralized Keras/torch); both schedules are part of the
+capability the build adds on top of the reference's inference-only
+pipeline (``grpc_node.py:120-147``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
+from tpu_dist_nn.parallel.pipeline import PipelineMeta, PipelineWeights, _stage_apply
+
+
+def make_1f1b(
+    mesh,
+    stage_fn,
+    tail_fn,
+    num_stages: int,
+    num_microbatches: int,
+    *,
+    microbatch_spec=None,
+    stage_params_spec=None,
+    aux_spec=None,
+):
+    """Generic 1F1B executor over the ``(stage, data)`` mesh axes.
+
+    Model-agnostic counterpart of :func:`tpu_dist_nn.parallel.gpipe.make_gpipe`
+    for the backward pass:
+
+    * ``stage_fn(stage_params, stage_static, x) -> y`` — one stage's
+      compute on a microbatch; ``y.shape == x.shape`` uniform across
+      stages. ``stage_params`` (differentiated) and ``stage_static``
+      (not differentiated — integer tables etc.) are pytrees whose
+      leaves carry a leading length-1 stage-shard axis already stripped
+      by this wrapper.
+    * ``tail_fn(tail_params, y, *aux_f) -> scalar`` — the per-microbatch
+      loss applied to the LAST stage's output (e.g. unembed + CE). It
+      must return this microbatch's *contribution* to the total loss
+      (pre-scaled: fold any 1/num_microbatches or mask normalization in
+      before calling). ``aux_f`` are the microbatch-f slices of the
+      ``aux`` operand arrays (labels, masks, targets, ...).
+
+    Returns ``f(xs, stage_params, stage_static, tail_params, aux) ->
+    (loss, stage_grads, tail_grads, dx0)`` where ``stage_grads`` keeps
+    the leading stage-shard axis (like the weights), ``tail_grads`` is
+    replicated, and ``dx0: (M, *microbatch_shape)`` is the loss gradient
+    w.r.t. each input microbatch — backpropagate it through whatever
+    produced ``xs`` (e.g. the embedding) outside the schedule.
+
+    Restriction: ``stage_fn``/``tail_fn`` must not contain collectives
+    (the 1F1B tick wraps them in ``lax.switch``/``lax.cond`` branches,
+    where a collective would need every mesh participant to take the
+    same branch). Intra-stage tensor parallelism therefore stays on the
+    GPipe schedule for now.
+    """
+    S, M = num_stages, num_microbatches
+    K = min(S, M)
+    T = 2 * (M + S - 1)
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    bwd_perm = [(i + 1, i) for i in range(S - 1)]
+    vary = (AXIS_STAGE, AXIS_DATA)
+    if microbatch_spec is None:
+        microbatch_spec = P(AXIS_DATA)
+    if stage_params_spec is None:
+        stage_params_spec = P(AXIS_STAGE)
+    if aux_spec is None:
+        aux_spec = P(None, *microbatch_spec)
+    xs_spec = P(None, *microbatch_spec)
+
+    def device_fn(xs, stage_params, stage_static, tail_params, aux):
+        # Strip the length-1 stage-shard axis; mark all differentiated
+        # params varying over `data` (and tail over `stage` too): see
+        # compiled_1f1b_grad's note — otherwise jax.vjp inserts an
+        # implicit psum per backward tick (a collective, which inside
+        # the lax.switch branch would also break SPMD).
+        sp = jax.tree.map(
+            lambda a: lax.pcast(a[0], (AXIS_DATA,), to="varying"), stage_params
+        )
+        st = jax.tree.map(lambda a: a[0], stage_static)
+        tp = jax.tree.map(lambda a: lax.pcast(a, vary, to="varying"), tail_params)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        mb_shape = xs.shape[1:]
+        dt = xs.dtype
+
+        def fwd_only(p, x):
+            return stage_fn(p, st, x)
+
+        def vcast(z):
+            return lax.pcast(z, vary, to="varying")
+
+        zeros_wire = vcast(jnp.zeros(mb_shape, dt))
+        carry0 = (
+            zeros_wire,                                  # activations from s-1
+            zeros_wire,                                  # grads from s+1
+            vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
+            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), sp),
+            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), tp),
+            vcast(jnp.zeros((M, *mb_shape), dt)),        # dx at stage 0
+            vcast(jnp.zeros((), jnp.float32)),           # loss accumulator
+        )
+
+        def tick(carry, t):
+            fwd_wire, bwd_wire, stash, g_sp, g_tp, dx0, loss_acc = carry
+            tf = t - s_idx
+            tb = t - (2 * S - 1 - s_idx)
+            is_f = (tf >= 0) & (tf < 2 * M) & (tf % 2 == 0)
+            is_b = (tb >= 0) & (tb < 2 * M) & (tb % 2 == 0)
+            f_f = jnp.clip(tf // 2, 0, M - 1)
+            f_b = jnp.clip(tb // 2, 0, M - 1)
+            is_last = s_idx == S - 1
+
+            def idle(_):
+                return zeros_wire, zeros_wire, stash, g_sp, g_tp, dx0, loss_acc
+
+            def fwd(_):
+                inp = lax.dynamic_index_in_dim(xs, f_f, 0, keepdims=False)
+                x_in = jnp.where(s_idx == 0, inp, fwd_wire)
+                new_stash = lax.dynamic_update_index_in_dim(
+                    stash, x_in, f_f % K, 0
+                )
+                y = fwd_only(sp, x_in)
+                return y, zeros_wire, new_stash, g_sp, g_tp, dx0, loss_acc
+
+            def bwd(_):
+                x_in = lax.dynamic_index_in_dim(stash, f_b % K, 0, keepdims=False)
+                y, svjp = jax.vjp(fwd_only, sp, x_in)
+                aux_f = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, f_b, 0, keepdims=False),
+                    aux,
+                )
+
+                def tail_live(_):
+                    loss_f, tvjp = jax.vjp(
+                        lambda tpar, yy: tail_fn(tpar, yy, *aux_f), tp, y
+                    )
+                    d_tp, dy = tvjp(vcast(jnp.ones((), loss_f.dtype)))
+                    return loss_f.astype(jnp.float32), dy, d_tp
+
+                def tail_skip(_):
+                    return (
+                        vcast(jnp.zeros((), jnp.float32)),
+                        zeros_wire,
+                        jax.tree.map(lambda a: vcast(jnp.zeros_like(a)), tp),
+                    )
+
+                # Only the last stage pays the tail (head/loss) FLOPs.
+                loss_f, dy_tail, d_tp = lax.cond(is_last, tail_live, tail_skip, 0)
+                dy = jnp.where(is_last, dy_tail, bwd_wire)
+                d_sp, dx = svjp(dy)
+                new_dx0 = jnp.where(
+                    s_idx == 0,
+                    lax.dynamic_update_index_in_dim(dx0, dx, f_b, 0),
+                    dx0,
+                )
+                return (
+                    zeros_wire,
+                    dx,
+                    stash,
+                    jax.tree.map(jnp.add, g_sp, d_sp),
+                    jax.tree.map(jnp.add, g_tp, d_tp),
+                    new_dx0,
+                    loss_acc + loss_f,
+                )
+
+            branch = is_f.astype(jnp.int32) + 2 * is_b.astype(jnp.int32)
+            send_y, send_dx, stash, g_sp, g_tp, dx0, loss_acc = lax.switch(
+                branch, [idle, fwd, bwd], 0
+            )
+            with jax.named_scope("f1b_ppermute_hop"):
+                nxt_fwd = (
+                    lax.ppermute(send_y, AXIS_STAGE, fwd_perm)
+                    if fwd_perm
+                    else send_y
+                )
+                nxt_bwd = (
+                    lax.ppermute(send_dx, AXIS_STAGE, bwd_perm)
+                    if bwd_perm
+                    else send_dx
+                )
+            return (nxt_fwd, nxt_bwd, stash, g_sp, g_tp, dx0, loss_acc), None
+
+        (_aw, _gw, _st, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        # Cross-shard reductions happen ONCE here, not per tick: data
+        # shards each saw a slice of the rows; tail grads and loss live
+        # only on the last stage; dx0 only on stage 0.
+        g_sp = jax.tree.map(lambda a: lax.psum(a, AXIS_DATA)[None], g_sp)
+        g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
+        dx0 = lax.psum(dx0, AXIS_STAGE)
+        loss = lax.psum(loss_acc, vary)
+        return loss, g_sp, g_tp, dx0
+
+    return jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(
+            xs_spec,
+            stage_params_spec,
+            stage_params_spec,
+            P(),
+            aux_spec,
+        ),
+        out_specs=(P(), stage_params_spec, P(), xs_spec),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_1f1b_grad(mesh, meta: PipelineMeta, num_microbatches: int, dtype):
+    """Build + jit the 1F1B loss-and-grad executor for the dense chain.
+
+    Returns ``f(weights, xs, labels, mask) -> (loss, grads)`` with the
+    same semantics as ``jax.value_and_grad`` over the GPipe trainer's
+    ``loss_fn`` — masked mean CE over real rows — so the two schedules
+    are drop-in interchangeable (and tested for numerical parity).
+    """
+    final_dim = meta.final_dim
+
+    def stage_fn(sp, st, x):
+        return _stage_apply(sp["w"], sp["b"], st["act"], st["width"], x)
+
+    def tail_fn(_tail_params, logits, lbl, msk_scaled):
+        # Masked softmax-CE over the first final_dim columns; padding
+        # columns are excluded from the normalizer with -inf (matching
+        # pipeline._masked_activation's softmax semantics).
+        col = lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logp = jax.nn.log_softmax(
+            jnp.where(col < final_dim, logits, -jnp.inf), axis=-1
+        )
+        ll = jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
+        return -(ll * msk_scaled).sum()
+
+    mapped = make_1f1b(
+        mesh,
+        stage_fn,
+        tail_fn,
+        meta.num_stages,
+        num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None),
+        aux_spec=P(None, AXIS_DATA),
+    )
+    act = jnp.asarray(meta.act_array(logits=True))
+    width = jnp.asarray(meta.width_array())
+
+    @jax.jit
+    def run(weights: PipelineWeights, xs, labels, mask):
+        # labels/mask arrive flat (M*B,) in microbatch-major order (the
+        # layout prepare_pipeline_batch produces); fold back to (M, B).
+        m, bsz = xs.shape[0], xs.shape[1]
+        labels = labels.reshape(m, bsz)
+        # Fold the global mean-normalizer into the mask so tail_fn needs
+        # no cross-microbatch state.
+        mask = mask.reshape(m, bsz).astype(dtype)
+        mask = mask / mask.sum()
+        sp = {"w": weights.w, "b": weights.b}
+        st = {"act": act, "width": width}
+        loss, g_sp, _g_tail, _dx0 = mapped(xs, sp, st, {}, (labels, mask))
+        return loss, PipelineWeights(w=g_sp["w"], b=g_sp["b"])
+
+    return run

@@ -54,27 +54,54 @@ def prepare_pipeline_batch(
     return xs, labels, mask
 
 
-def make_pipeline_train_step(mesh, meta: PipelineMeta, num_microbatches: int, optimizer, dtype=jnp.float32):
+def make_pipeline_train_step(
+    mesh,
+    meta: PipelineMeta,
+    num_microbatches: int,
+    optimizer,
+    dtype=jnp.float32,
+    schedule: str = "gpipe",
+):
     """Build the jitted pipelined train step.
 
-    The forward reuses the same compiled GPipe executor as inference
-    (logits variant); grads flow through ppermute/scan, then get masked
-    to the real layer blocks before the optax update.
+    ``schedule`` picks the pipeline schedule:
+
+    * ``"gpipe"`` — forward via the shared GPipe executor (logits
+      variant), grads by AD through ppermute/scan. Activation memory
+      grows with the microbatch count M.
+    * ``"1f1b"`` — the hand-rolled one-forward-one-backward schedule
+      with activation recompute (:mod:`tpu_dist_nn.parallel.one_f_one_b`);
+      activation memory is O(num_stages), independent of M. Numerically
+      identical (tests/test_pipeline_1f1b.py).
+
+    Either way grads get masked to the real layer blocks before the
+    optax update.
     """
-    apply = compiled_pipeline(mesh, meta, num_microbatches, True, dtype)
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}: use 'gpipe' or '1f1b'")
     w_mask_np, b_mask_np = meta.grad_masks()
     w_mask = jnp.asarray(w_mask_np, dtype)
     b_mask = jnp.asarray(b_mask_np, dtype)
 
-    def loss_fn(weights: PipelineWeights, xs, labels, label_mask):
-        logits = apply(weights, xs)  # (M*B, final_dim)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
-        return -(ll * label_mask).sum() / label_mask.sum()
+    if schedule == "1f1b":
+        from tpu_dist_nn.parallel.one_f_one_b import compiled_1f1b_grad
+
+        grad_fn = compiled_1f1b_grad(mesh, meta, num_microbatches, dtype)
+    else:
+        apply = compiled_pipeline(mesh, meta, num_microbatches, True, dtype)
+
+        def loss_fn(weights: PipelineWeights, xs, labels, label_mask):
+            logits = apply(weights, xs)  # (M*B, final_dim)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+            return -(ll * label_mask).sum() / label_mask.sum()
+
+        def grad_fn(weights, xs, labels, label_mask):
+            return jax.value_and_grad(loss_fn)(weights, xs, labels, label_mask)
 
     @jax.jit
     def step(weights: PipelineWeights, opt_state, xs, labels, label_mask):
-        loss, grads = jax.value_and_grad(loss_fn)(weights, xs, labels, label_mask)
+        loss, grads = grad_fn(weights, xs, labels, label_mask)
         grads = PipelineWeights(w=grads.w * w_mask, b=grads.b * b_mask)
         updates, opt_state = optimizer.update(grads, opt_state, weights)
         # Mask the UPDATES too, not just the grads: decoupled weight
@@ -98,6 +125,7 @@ def train_pipelined(
     num_microbatches: int = 4,
     eval_data: Dataset | None = None,
     checkpoints=None,
+    schedule: str = "gpipe",
 ):
     """Train pipelined weights over the mesh; returns (params, history).
 
@@ -111,7 +139,9 @@ def train_pipelined(
 
     optimizer = optimizer_for(config, train_data)
     opt_state = optimizer.init(weights)
-    step = make_pipeline_train_step(mesh, meta, num_microbatches, optimizer, weights.w.dtype)
+    step = make_pipeline_train_step(
+        mesh, meta, num_microbatches, optimizer, weights.w.dtype, schedule=schedule
+    )
 
     from tpu_dist_nn.checkpoint.store import resume_or_init
 
