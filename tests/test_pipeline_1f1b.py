@@ -15,7 +15,6 @@ from tpu_dist_nn.core.schema import partition_model
 from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 from tpu_dist_nn.parallel.one_f_one_b import compiled_1f1b_grad
 from tpu_dist_nn.parallel.pipeline import (
-    PipelineWeights,
     build_pipeline_params,
     compiled_pipeline,
 )
@@ -118,4 +117,45 @@ def test_1f1b_rejects_unknown_schedule():
     with pytest.raises(ValueError, match="schedule"):
         make_pipeline_train_step(
             mesh, params.meta, 2, optax.adam(1e-3), schedule="pipedream"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Transformer LM pipeline on the generic 1F1B executor
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_lm_1f1b_matches_gpipe(remat):
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        make_pipeline_lm_1f1b_grad,
+        make_pipeline_lm_loss,
+        shard_blocks,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=37, d_model=16, n_heads=2, n_layers=4, d_ff=32,
+        max_seq_len=12, remat=remat,
+    )
+    stages, data, mbatches = 2, 2, 4
+    mesh = build_mesh(MeshSpec(stage=stages, data=data))
+    params = init_transformer(jax.random.key(0), cfg)
+    params = dict(params, blocks=shard_blocks(params["blocks"], stages))
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (mbatches * data * 2, 13)), jnp.int32)
+
+    loss_fn = make_pipeline_lm_loss(mesh, cfg, stages, mbatches)
+    loss_g, grads_g = jax.jit(jax.value_and_grad(loss_fn))(params, tokens)
+    vag = jax.jit(make_pipeline_lm_1f1b_grad(mesh, cfg, stages, mbatches))
+    loss_f, grads_f = vag(params, tokens)
+
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+    flat_g = jax.tree.flatten_with_path(grads_g)[0]
+    flat_f = jax.tree.flatten_with_path(grads_f)[0]
+    for (path_g, leaf_g), (path_f, leaf_f) in zip(flat_g, flat_f):
+        assert path_g == path_f
+        np.testing.assert_allclose(
+            np.asarray(leaf_f), np.asarray(leaf_g), rtol=2e-4, atol=1e-6,
+            err_msg=str(path_g),
         )

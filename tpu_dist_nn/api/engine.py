@@ -400,9 +400,15 @@ class Engine:
         """
         # Validate regardless of placement: a typo'd schedule on a
         # non-pipelined engine must not silently train with the default.
-        if schedule not in ("gpipe", "1f1b"):
+        from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
+
+        validate_schedule(schedule)
+        if schedule != "gpipe" and not self.pipelined:
             raise ValueError(
-                f"unknown pipeline schedule {schedule!r}: use 'gpipe' or '1f1b'"
+                "schedule='1f1b' applies to the pipelined placement only "
+                "(this engine was placed "
+                + ("heterogeneous" if self._hp is not None else "single-program")
+                + "); place with a multi-stage distribution to use it"
             )
         if self._hp is not None:
             # The heterogeneous executor serves inference only; train on

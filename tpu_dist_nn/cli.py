@@ -558,11 +558,17 @@ def cmd_lm(args) -> int:
     checkpoints = None
     if args.checkpoint_dir:
         checkpoints = _make_checkpoint_manager(args)
+    if args.schedule != "gpipe" and (args.stages <= 1 or step_fn is not None):
+        raise ValueError(
+            "--schedule 1f1b applies to the pipelined dense LM only "
+            "(--stages > 1, without --experts/--seq-parallel/--zero1/--fsdp)"
+        )
     t0 = time.monotonic()
     params, history = train_lm(
         params, cfg, batches, train_cfg, mesh=mesh,
         num_stages=args.stages, num_microbatches=args.microbatches,
         checkpoints=checkpoints, step_fn=step_fn,
+        schedule=args.schedule,
     )
     train_seconds = time.monotonic() - t0
     if unshard_fn is not None:
@@ -819,6 +825,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                   help="pipeline training schedule when --stages > 1")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="shard the sequence axis over N devices "

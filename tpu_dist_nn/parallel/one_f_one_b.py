@@ -40,6 +40,20 @@ from jax.sharding import PartitionSpec as P
 from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
 from tpu_dist_nn.parallel.pipeline import PipelineMeta, PipelineWeights, _stage_apply
 
+#: The pipeline training schedules the framework implements.
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def validate_schedule(schedule: str) -> str:
+    """The single validation point for schedule names (CLI choices lists
+    aside) — every trainer/engine entry path funnels through here."""
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}: use "
+            + " or ".join(repr(s) for s in SCHEDULES)
+        )
+    return schedule
+
 
 def make_1f1b(
     mesh,
@@ -51,6 +65,7 @@ def make_1f1b(
     microbatch_spec=None,
     stage_params_spec=None,
     aux_spec=None,
+    want_dx0: bool = True,
 ):
     """Generic 1F1B executor over the ``(stage, data)`` mesh axes.
 
@@ -75,7 +90,11 @@ def make_1f1b(
     the leading stage-shard axis (like the weights), ``tail_grads`` is
     replicated, and ``dx0: (M, *microbatch_shape)`` is the loss gradient
     w.r.t. each input microbatch — backpropagate it through whatever
-    produced ``xs`` (e.g. the embedding) outside the schedule.
+    produced ``xs`` (e.g. the embedding) outside the schedule. When
+    ``xs`` is raw data with nothing upstream, pass ``want_dx0=False``:
+    the M-sized cotangent buffer (which would scale live memory with M
+    again) and its end-of-scan psum are skipped entirely and the dx0
+    slot returns a scalar zero.
 
     Restriction: ``stage_fn``/``tail_fn`` must not contain collectives
     (the 1F1B tick wraps them in ``lax.switch``/``lax.cond`` branches,
@@ -116,7 +135,12 @@ def make_1f1b(
             return stage_fn(p, st, x)
 
         def vcast(z):
-            return lax.pcast(z, vary, to="varying")
+            # Idempotent "mark varying over (stage, data)": zeros_like of
+            # an already-varying tracer is itself varying, and pcast
+            # rejects re-adding axes.
+            have = getattr(jax.typeof(z), "vma", frozenset())
+            need = tuple(a for a in vary if a not in have)
+            return lax.pcast(z, need, to="varying") if need else z
 
         zeros_wire = vcast(jnp.zeros(mb_shape, dt))
         carry0 = (
@@ -125,7 +149,9 @@ def make_1f1b(
             vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
             jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), sp),
             jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), tp),
-            vcast(jnp.zeros((M, *mb_shape), dt)),        # dx at stage 0
+            # dx cotangents at stage 0 (skipped when not wanted: the
+            # M-sized buffer would re-couple live memory to M).
+            vcast(jnp.zeros((M if want_dx0 else 1, *mb_shape), dt)),
             vcast(jnp.zeros((), jnp.float32)),           # loss accumulator
         )
 
@@ -177,11 +203,14 @@ def make_1f1b(
                 loss_f, dy_tail, d_tp = lax.cond(is_last, tail_live, tail_skip, 0)
                 dy = jnp.where(is_last, dy_tail, bwd_wire)
                 d_sp, dx = svjp(dy)
-                new_dx0 = jnp.where(
-                    s_idx == 0,
-                    lax.dynamic_update_index_in_dim(dx0, dx, f_b, 0),
-                    dx0,
-                )
+                if want_dx0:
+                    new_dx0 = jnp.where(
+                        s_idx == 0,
+                        lax.dynamic_update_index_in_dim(dx0, dx, f_b, 0),
+                        dx0,
+                    )
+                else:
+                    new_dx0 = dx0
                 return (
                     zeros_wire,
                     dx,
@@ -217,7 +246,10 @@ def make_1f1b(
         # only on the last stage; dx0 only on stage 0.
         g_sp = jax.tree.map(lambda a: lax.psum(a, AXIS_DATA)[None], g_sp)
         g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
-        dx0 = lax.psum(dx0, AXIS_STAGE)
+        if want_dx0:
+            dx0 = lax.psum(dx0, AXIS_STAGE)
+        else:
+            dx0 = jnp.zeros((), jnp.float32)  # invariant placeholder
         loss = lax.psum(loss_acc, vary)
         return loss, g_sp, g_tp, dx0
 
@@ -231,7 +263,7 @@ def make_1f1b(
             P(),
             aux_spec,
         ),
-        out_specs=(P(), stage_params_spec, P(), xs_spec),
+        out_specs=(P(), stage_params_spec, P(), xs_spec if want_dx0 else P()),
     )
 
 
@@ -268,6 +300,7 @@ def compiled_1f1b_grad(mesh, meta: PipelineMeta, num_microbatches: int, dtype):
         num_microbatches,
         microbatch_spec=P(AXIS_DATA, None),
         aux_spec=P(None, AXIS_DATA),
+        want_dx0=False,  # xs is raw data; nothing upstream to backprop
     )
     act = jnp.asarray(meta.act_array(logits=True))
     width = jnp.asarray(meta.width_array())

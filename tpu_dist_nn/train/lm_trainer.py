@@ -50,14 +50,20 @@ def _resolve_attn_fn(attn_fn):
     return default_attn_fn()
 
 
-def make_step_body(loss_fn, optimizer):
+def make_step_body(loss_fn, optimizer, value_and_grad=None):
     """The one training-step body every LM variant jits:
     value_and_grad over ``loss_fn(params, tokens)``, optimizer update,
     apply. Single definition so baseline / pipelined / MoE / ZeRO steps
-    cannot drift apart (a change like grad clipping lands everywhere)."""
+    cannot drift apart (a change like grad clipping lands everywhere).
+
+    ``value_and_grad`` overrides the AD-derived gradient with a
+    hand-scheduled ``(params, tokens) -> (loss, grads)`` (the 1F1B
+    pipeline schedule); the optimizer half stays shared either way.
+    """
+    vag = value_and_grad if value_and_grad is not None else jax.value_and_grad(loss_fn)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        loss, grads = vag(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -78,12 +84,27 @@ def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
 
 def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
                                 num_microbatches: int, optimizer,
-                                attn_fn=None):
+                                attn_fn=None, schedule: str = "gpipe"):
     """Pipelined train step; ``params["blocks"]`` must be stage-grouped
-    (:func:`tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`)."""
-    loss_fn = make_pipeline_lm_loss(
-        mesh, cfg, num_stages, num_microbatches, _resolve_attn_fn(attn_fn)
-    )
+    (:func:`tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`).
+
+    ``schedule``: "gpipe" (AD through the forward schedule) or "1f1b"
+    (hand-rolled one-forward-one-backward with activation recompute,
+    O(num_stages) live activations — see
+    :mod:`tpu_dist_nn.parallel.one_f_one_b`).
+    """
+    from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
+
+    validate_schedule(schedule)
+    attn = _resolve_attn_fn(attn_fn)
+    if schedule == "1f1b":
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            make_pipeline_lm_1f1b_grad,
+        )
+
+        vag = make_pipeline_lm_1f1b_grad(mesh, cfg, num_stages, num_microbatches, attn)
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+    loss_fn = make_pipeline_lm_loss(mesh, cfg, num_stages, num_microbatches, attn)
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
@@ -127,7 +148,8 @@ def evaluate_moe_lm(params, cfg, rows: np.ndarray,
 def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
              train_cfg: LMTrainConfig, *, mesh=None, num_stages: int = 1,
              num_microbatches: int = 1, checkpoints=None,
-             checkpoint_every: int | None = None, step_fn=None):
+             checkpoint_every: int | None = None, step_fn=None,
+             schedule: str = "gpipe"):
     """Run the training loop; pipelined when ``mesh``+``num_stages>1``.
 
     ``checkpoints`` (a CheckpointManager) enables step-level save +
@@ -155,13 +177,17 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         weight_decay=train_cfg.weight_decay,
         grad_accum=train_cfg.grad_accum,
     )
+    from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
+
+    validate_schedule(schedule)
     pipelined = step_fn is None and mesh is not None and num_stages > 1
     if step_fn is not None:
         step = step_fn(optimizer)
     elif pipelined:
         params = dict(params, blocks=shard_blocks(params["blocks"], num_stages))
         step = make_pipeline_lm_train_step(
-            mesh, cfg, num_stages, num_microbatches, optimizer
+            mesh, cfg, num_stages, num_microbatches, optimizer,
+            schedule=schedule,
         )
     else:
         step = make_lm_train_step(cfg, optimizer)

@@ -77,8 +77,9 @@ def make_pipeline_train_step(
     Either way grads get masked to the real layer blocks before the
     optax update.
     """
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"unknown pipeline schedule {schedule!r}: use 'gpipe' or '1f1b'")
+    from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
+
+    validate_schedule(schedule)
     w_mask_np, b_mask_np = meta.grad_masks()
     w_mask = jnp.asarray(w_mask_np, dtype)
     b_mask = jnp.asarray(b_mask_np, dtype)
