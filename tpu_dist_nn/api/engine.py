@@ -403,12 +403,15 @@ class Engine:
         from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
         validate_schedule(schedule)
-        if schedule != "gpipe" and not self.pipelined:
+        # The heterogeneous executor sets pipelined=True but trains via
+        # the single-program trainer, so it must reject 1f1b too.
+        if schedule != "gpipe" and (not self.pipelined or self._hp is not None):
             raise ValueError(
-                "schedule='1f1b' applies to the pipelined placement only "
-                "(this engine was placed "
+                "schedule='1f1b' applies to the dense pipelined placement "
+                "only (this engine was placed "
                 + ("heterogeneous" if self._hp is not None else "single-program")
-                + "); place with a multi-stage distribution to use it"
+                + "); place a dense model with a multi-stage distribution "
+                "to use it"
             )
         if self._hp is not None:
             # The heterogeneous executor serves inference only; train on

@@ -525,6 +525,14 @@ def cmd_lm(args) -> int:
             # `params` is assigned below, before train_lm invokes this.
             step_fn = lambda opt: make(zero_mesh, cfg, opt, params)  # noqa: E731
 
+    # Fail fast with the other flag-compatibility checks — before corpus
+    # load, param init, or checkpoint-dir creation do any work.
+    if args.schedule != "gpipe" and (args.stages <= 1 or step_fn is not None):
+        raise ValueError(
+            "--schedule 1f1b applies to the pipelined dense LM only "
+            "(--stages > 1, without --experts/--seq-parallel/--zero1/--fsdp)"
+        )
+
     text, source = load_corpus(args.corpus)
     tokens = encode(text)
     rows = lm_sequences(tokens, args.seq_len)
@@ -558,11 +566,6 @@ def cmd_lm(args) -> int:
     checkpoints = None
     if args.checkpoint_dir:
         checkpoints = _make_checkpoint_manager(args)
-    if args.schedule != "gpipe" and (args.stages <= 1 or step_fn is not None):
-        raise ValueError(
-            "--schedule 1f1b applies to the pipelined dense LM only "
-            "(--stages > 1, without --experts/--seq-parallel/--zero1/--fsdp)"
-        )
     t0 = time.monotonic()
     params, history = train_lm(
         params, cfg, batches, train_cfg, mesh=mesh,

@@ -181,6 +181,11 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
 
     validate_schedule(schedule)
     pipelined = step_fn is None and mesh is not None and num_stages > 1
+    if schedule != "gpipe" and not pipelined:
+        raise ValueError(
+            "schedule='1f1b' requires the pipelined dense LM path "
+            "(mesh + num_stages > 1, no custom step_fn)"
+        )
     if step_fn is not None:
         step = step_fn(optimizer)
     elif pipelined:
