@@ -1,0 +1,161 @@
+"""REAL multi-process tests: two OS processes, one JAX job, gloo CPU
+collectives over a localhost coordinator.
+
+The rest of the suite emulates multi-chip inside one process
+(``--xla_force_host_platform_device_count``); these tests are the
+multi-HOST layer on top — the part the reference gets from Docker
+networking (run_grpc_fcnn.py:83-155) and this framework gets from
+``jax.distributed`` + DCN. They catch the one bug virtual devices
+cannot: feeding process-local batches into a global-mesh step, which
+trains N silently-diverging models instead of one (each worker asserts
+identical losses across hosts, and the parent asserts parity with a
+single-process run on the same global data).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+WORKER = Path(__file__).with_name("multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_pair(scenario: str, timeout: float = 420.0) -> list[dict]:
+    """Launch the scenario in 2 fresh worker processes; return their RESULTs."""
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), scenario, str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    results = []
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line:\n{out[-3000:]}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+    return sorted(results, key=lambda r: r["pid"])
+
+
+def test_two_process_collectives():
+    r0, r1 = _run_pair("collectives")
+    assert r0["sum"] == r0["expect"] == r1["sum"]
+
+
+@pytest.mark.parametrize("scenario", ["train_pipelined", "train_pipelined_1f1b"])
+def test_two_process_pipelined_training_in_sync(scenario):
+    r0, r1 = _run_pair(scenario)
+    # Both hosts must be the SAME model at every step (the whole point:
+    # without the global-batch feed each host trains its own model and
+    # these diverge immediately)...
+    assert r0["losses"] == r1["losses"], (r0, r1)
+    assert r0["w_digest"] == pytest.approx(r1["w_digest"], rel=1e-6)
+    assert r0["eval_acc"] == r1["eval_acc"]
+    # ...training for real (finite, decreasing), and in the same quality
+    # band as single-process training on the same global data (exact
+    # step parity is checked by test_two_process_step_parity — the loop
+    # shuffles per-stripe, so batch compositions legitimately differ).
+    assert all(np.isfinite(r0["losses"])) and r0["losses"][-1] < r0["losses"][0]
+    ref = _single_process_reference(schedule="1f1b" if "1f1b" in scenario else "gpipe")
+    assert abs(r0["losses"][-1] - ref["losses"][-1]) < 0.25, (r0, ref)
+
+
+def test_two_process_step_parity():
+    """One fixed-batch step across 2 hosts == the single-process step
+    (loss and grads are row-partition-invariant)."""
+    r0, r1 = _run_pair("step_parity")
+    assert r0["loss"] == r1["loss"]
+    ref = _single_process_step_reference()
+    np.testing.assert_allclose(r0["loss"], ref["loss"], rtol=1e-5)
+    np.testing.assert_allclose(r0["w_digest"], ref["w_digest"], rtol=1e-5)
+
+
+def test_two_process_lm_pipeline_in_sync():
+    r0, r1 = _run_pair("train_lm_pipelined")
+    assert r0["losses"] == r1["losses"], (r0, r1)
+    assert r0["tok_digest"] == pytest.approx(r1["tok_digest"], rel=1e-6)
+    # Losses must be finite and decreasing-ish (training, not noise).
+    assert all(np.isfinite(r0["losses"]))
+    assert r0["losses"][-1] < r0["losses"][0]
+
+
+def _single_process_step_reference() -> dict:
+    import optax
+
+    from tests.multihost_worker import _global_dataset
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.train.pipeline_trainer import (
+        make_pipeline_train_step,
+        prepare_pipeline_batch,
+    )
+    import jax.numpy as jnp
+
+    mesh = build_mesh(MeshSpec(stage=2, data=4))
+    model = random_model([12, 10, 6], seed=0)
+    params = build_pipeline_params(partition_model(model, [1, 1]))
+    full = _global_dataset()
+    xs, labels, mask = prepare_pipeline_batch(
+        params.meta, full.x[:32], full.y[:32], 4, 4
+    )
+    opt = optax.adam(1e-2)
+    step = make_pipeline_train_step(mesh, params.meta, 4, opt)
+    w, _, loss = step(
+        params.weights, opt.init(params.weights),
+        jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask),
+    )
+    return {"loss": float(loss), "w_digest": float(np.abs(np.asarray(w.w)).sum())}
+
+
+def _single_process_reference(schedule: str) -> dict:
+    """The same training run on this process's 8 virtual devices."""
+    from tests.multihost_worker import _global_dataset
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.train.pipeline_trainer import TrainConfig, train_pipelined
+
+    mesh = build_mesh(MeshSpec(stage=2, data=4))
+    model = random_model([12, 10, 6], seed=0)
+    params = build_pipeline_params(partition_model(model, [1, 1]))
+    full = _global_dataset()
+    cfg = TrainConfig(epochs=2, batch_size=32, learning_rate=1e-2, seed=0)
+    params, history = train_pipelined(
+        params, mesh, full, cfg, num_microbatches=4, schedule=schedule
+    )
+    w = np.asarray(params.weights.w)
+    return {
+        "losses": [round(h["loss"], 6) for h in history],
+        "w_digest": float(np.abs(w).sum()),
+    }

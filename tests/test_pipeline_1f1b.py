@@ -32,8 +32,9 @@ def _gpipe_loss_and_grad(mesh, params, num_microbatches, xs, labels, mask):
     def loss_fn(w):
         logits = apply(w, xs)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
-        return -(ll * mask).sum() / mask.sum()
+        flat = labels.reshape(-1)
+        ll = jnp.take_along_axis(logp, flat[:, None], axis=-1)[:, 0]
+        return -(ll * mask.reshape(-1)).sum() / mask.sum()
 
     return jax.value_and_grad(loss_fn)(weights)
 

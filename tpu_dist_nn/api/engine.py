@@ -286,10 +286,12 @@ class Engine:
             mb = max(1, len(x) // self.num_microbatches)
             return self._hp.forward(x, microbatch_size=mb)
         if self.pipelined:
+            from tpu_dist_nn.parallel.multihost import to_host_numpy
+
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
-            return np.asarray(out)
+            return to_host_numpy(out)
         if self._q is not None:
             from tpu_dist_nn.kernels.quantized import fcnn_quantized_forward
 
@@ -305,13 +307,35 @@ class Engine:
             else jitted_network_forward(self._plan)
         )
         if self.data_sharded:
+            from tpu_dist_nn.parallel.multihost import to_host_numpy
+
             n = len(x)
             shards = self.mesh_spec.data
             xb = np.pad(x, ((0, -n % shards), (0, 0))).astype(self.dtype)
-            xb = jax.device_put(xb, batch_sharding(self.mesh))
+            if jax.process_count() > 1:
+                # Every host computed the same padded batch; contribute
+                # this host's slice of one globally-sharded array.
+                from jax.sharding import PartitionSpec as P
+
+                from tpu_dist_nn.data.feed import global_batch
+                from tpu_dist_nn.parallel.mesh import AXIS_DATA
+
+                nproc = jax.process_count()
+                if shards % nproc:
+                    raise ValueError(
+                        f"data_parallel={shards} must be a multiple of the "
+                        f"process count ({nproc}) for multi-host inference"
+                    )
+                per = len(xb) // nproc
+                pidx = jax.process_index()
+                xb = global_batch(
+                    self.mesh, P(AXIS_DATA), xb[pidx * per:(pidx + 1) * per]
+                )
+            else:
+                xb = jax.device_put(xb, batch_sharding(self.mesh))
             out = apply(self._params, xb)[:n]
-        else:
-            out = apply(self._params, jnp.asarray(x, self.dtype))
+            return to_host_numpy(out)
+        out = apply(self._params, jnp.asarray(x, self.dtype))
         return np.asarray(out)
 
     def infer_single(self, x) -> tuple[np.ndarray, float]:

@@ -50,8 +50,19 @@ def _atomic_write_bytes(path: Path, data: bytes) -> None:
 
 
 def save_pytree(state: Any, path: str | Path) -> None:
-    """Serialize one pytree to a msgpack file (host-side copy included)."""
-    state = jax.device_get(state)
+    """Serialize one pytree to a msgpack file (host-side copy included).
+
+    Multi-host: leaves sharded across processes are all-gathered first
+    (a collective — EVERY process must reach the save point together),
+    then only process 0 touches the filesystem: co-located processes
+    writing the same path/manifest would race (torn manifests, TOCTOU
+    prune crashes).
+    """
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+
+    state = to_host_numpy(state)
+    if jax.process_index() != 0:
+        return
     _atomic_write_bytes(Path(path), serialization.to_bytes(state))
 
 
@@ -115,7 +126,9 @@ class CheckpointManager:
                 f"(keep={self.keep}, existing steps {manifest['steps']})"
             )
         path = self._path(step)
-        save_pytree(state, path)
+        save_pytree(state, path)  # collective gather inside; all procs call
+        if jax.process_index() != 0:
+            return path  # file/manifest writes are process 0's alone
         if metadata:
             manifest.setdefault("metadata", {})[str(step)] = metadata
         while len(steps) > self.keep:

@@ -295,14 +295,6 @@ def cmd_train(args) -> int:
         )
         data, eval_data = full.split(0.9, seed=args.seed)
 
-    from tpu_dist_nn.data.datasets import Dataset
-    from tpu_dist_nn.data.feed import shard_for_host
-
-    # Multi-host: each process trains on its own stripe (eval stays
-    # global so every host reports the same metrics).
-    sx, sy = shard_for_host(data.x, data.y)
-    data = Dataset(sx, sy, data.num_classes)
-
     from tpu_dist_nn.api.engine import Engine
 
     engine = Engine.up(
@@ -311,6 +303,30 @@ def cmd_train(args) -> int:
         data_parallel=args.data_parallel,
         num_microbatches=args.microbatches,
     )
+
+    import jax as _jax
+
+    from tpu_dist_nn.data.datasets import Dataset
+    from tpu_dist_nn.data.feed import shard_for_host
+
+    if _jax.process_count() > 1:
+        if engine.pipelined and engine._hp is None:
+            # Multi-host data parallelism: each process trains on its
+            # stripe; the pipelined trainer assembles the stripes into
+            # one globally-sharded batch per step (eval stays global so
+            # every host reports the same metrics).
+            sx, sy = shard_for_host(data.x, data.y)
+            data = Dataset(sx, sy, data.num_classes)
+        else:
+            # No global-mesh trainer for this placement: striping would
+            # silently train N divergent models. Train replicated on the
+            # full (identical) dataset instead — correct, just without
+            # cross-host speedup.
+            log.warning(
+                "multi-host job with a non-pipelined placement: training "
+                "replicated per host on the full dataset (use a "
+                "multi-stage --distribution for cross-host parallelism)"
+            )
     cfg = TrainConfig(
         learning_rate=args.lr, epochs=args.epochs,
         batch_size=args.batch_size, seed=args.seed,
@@ -417,6 +433,9 @@ def cmd_lm(args) -> int:
     mesh = None
     step_fn = None
     unshard_fn = None
+    global_mesh = None  # the mesh cross-host batches assemble over, if any
+    global_span = 1     # how many ways that mesh shards the batch axis
+    global_axes = "_data_"
     if moe:
         # One dispatch site for the whole MoE family: config, init,
         # train-step factory, eval, and the EP shard/unshard pair.
@@ -447,6 +466,8 @@ def cmd_lm(args) -> int:
                     f"by expert_parallel*data_parallel={ep * dp}"
                 )
             ep_mesh = build_mesh(MeshSpec(expert=ep, data=dp))
+            global_mesh, global_span = ep_mesh, ep * dp
+            global_axes = "_data_expert_"  # EP shards the batch over both
             step_fn = lambda opt: make_moe_lm_train_step(cfg, opt, ep_mesh)  # noqa: E731
             # The EP executor always expects the ep_shard_blocks layout,
             # including the degenerate ep=1 case (leading shard dim of 1).
@@ -473,6 +494,8 @@ def cmd_lm(args) -> int:
             mesh = build_mesh(
                 MeshSpec(stage=args.stages, data=args.data_parallel)
             )
+            global_mesh, global_span = mesh, args.data_parallel
+            global_axes = "_data_"
         elif args.seq_parallel > 1:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
             from tpu_dist_nn.train.lm_trainer import (
@@ -499,6 +522,8 @@ def cmd_lm(args) -> int:
             sp_mesh = build_mesh(
                 MeshSpec(seq=args.seq_parallel, data=args.data_parallel)
             )
+            global_mesh, global_span = sp_mesh, args.data_parallel
+            global_axes = "_data_"
             step_fn = lambda opt: make_seq_parallel_lm_train_step(  # noqa: E731
                 sp_mesh, cfg, opt
             )
@@ -521,6 +546,8 @@ def cmd_lm(args) -> int:
                     f"--data-parallel {args.data_parallel}"
                 )
             zero_mesh = build_mesh(MeshSpec(data=args.data_parallel))
+            global_mesh, global_span = zero_mesh, args.data_parallel
+            global_axes = "_data_"
             make = make_fsdp_lm_train_step if args.fsdp else make_zero_lm_train_step
             # `params` is assigned below, before train_lm invokes this.
             step_fn = lambda opt: make(zero_mesh, cfg, opt, params)  # noqa: E731
@@ -538,10 +565,50 @@ def cmd_lm(args) -> int:
     rows = lm_sequences(tokens, args.seq_len)
     split = max(1, int(len(rows) * 0.95))
     train_rows, eval_rows = rows[:split], rows[split:]
-    from tpu_dist_nn.data.feed import shard_for_host
+    import jax as _jax
 
-    # Multi-host: per-process training stripe; eval stays global.
-    train_rows = shard_for_host(train_rows)
+    from tpu_dist_nn.data.feed import global_batch, shard_for_host
+
+    nproc = _jax.process_count()
+    globalize = None
+    local_batch_size = args.batch_size
+    if nproc > 1 and global_mesh is not None:
+        from jax.sharding import PartitionSpec as _P
+
+        from tpu_dist_nn.parallel.mesh import AXIS_DATA as _AD, AXIS_EXPERT as _AE
+
+        _spec = (
+            _P((_AD, _AE), None) if global_axes == "_data_expert_"
+            else _P(_AD, None)
+        )
+        _gm = global_mesh
+        if global_span % nproc == 0:
+            # Multi-host data parallelism: per-process training stripe,
+            # assembled into one globally-sharded batch per step;
+            # --batch-size is GLOBAL.
+            if args.batch_size % nproc:
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible by "
+                    f"{nproc} hosts"
+                )
+            local_batch_size = args.batch_size // nproc
+            globalize = lambda b: global_batch(_gm, _spec, b)  # noqa: E731
+            train_rows = shard_for_host(train_rows)
+        else:
+            # The batch axis does not span the hosts (e.g. --seq-parallel
+            # across hosts with --data-parallel 1): every host feeds the
+            # IDENTICAL full batch and cross-host parallelism comes from
+            # the other mesh axes.
+            log.info(
+                "multi-host: batch axis spans %d-way (< %d hosts); feeding "
+                "identical batches on every host, cross-host parallelism "
+                "rides the other mesh axes", global_span, nproc,
+            )
+            globalize = lambda b: global_batch(  # noqa: E731
+                _gm, _spec, b, assume_replicated=True
+            )
+    # (nproc > 1 with no global mesh: train_lm logs the replicated-
+    # training warning — the single funnel for that condition.)
     params = init_fn(jax.random.key(args.seed), cfg)
     if unshard_fn is not None:  # EP mesh path: apply the shard layout
         params = dict(
@@ -561,7 +628,7 @@ def cmd_lm(args) -> int:
         grad_accum=args.grad_accum,
     )
     batches = lm_batches(
-        train_rows, args.batch_size, seed=args.seed, epochs=None
+        train_rows, local_batch_size, seed=args.seed, epochs=None
     )
     checkpoints = None
     if args.checkpoint_dir:
@@ -571,7 +638,7 @@ def cmd_lm(args) -> int:
         params, cfg, batches, train_cfg, mesh=mesh,
         num_stages=args.stages, num_microbatches=args.microbatches,
         checkpoints=checkpoints, step_fn=step_fn,
-        schedule=args.schedule,
+        schedule=args.schedule, globalize=globalize,
     )
     train_seconds = time.monotonic() - t0
     if unshard_fn is not None:

@@ -108,3 +108,70 @@ def shard_for_host(*arrays):
     start = jax.process_index() * per
     out = tuple(a[start : start + per] for a in arrays)
     return out[0] if len(out) == 1 else out
+
+
+def global_batch(mesh, specs, *arrays, assume_replicated: bool = False):
+    """Assemble per-process host stripes into global jax.Arrays.
+
+    Multi-host SPMD: each process holds only its stripe of the batch
+    (``shard_for_host``); the jitted step needs ONE global array whose
+    data-axis shards live on each process's devices. Single-process this
+    is just ``jnp.asarray``; multi-process it places each host's rows
+    onto its addressable shards of a global array
+    (``jax.make_array_from_process_local_data``), with the global
+    leading extent = sum over processes. ``specs`` is one PartitionSpec
+    applied to every array, or a tuple with one spec per array.
+
+    This (not plain ``jnp.asarray``) is what makes cross-host data
+    parallelism real: feeding process-local arrays into a jitted step
+    silently trains each host independently on its own stripe — N
+    diverging models instead of one (caught by
+    tests/test_multihost_real.py).
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if isinstance(specs, PartitionSpec):
+        specs = (specs,) * len(arrays)
+    if len(specs) != len(arrays):
+        raise ValueError(f"{len(specs)} specs for {len(arrays)} arrays")
+    nproc = jax.process_count()
+    if nproc == 1:
+        out = tuple(jnp.asarray(a) for a in arrays)
+    else:
+        procs_spanned = len({d.process_index for d in mesh.devices.flat})
+        if procs_spanned != nproc:
+            raise ValueError(
+                f"mesh spans {procs_spanned} of {nproc} processes; multi-host "
+                "meshes must cover every process (size the axes to use all "
+                "global devices)"
+            )
+        for spec in specs:
+            axes = []
+            for entry in spec:
+                if entry is None:
+                    continue
+                axes.extend((entry,) if isinstance(entry, str) else tuple(entry))
+            span = 1
+            for ax in axes:
+                span *= mesh.shape[ax]
+            if span % nproc and not assume_replicated:
+                # A batch axis replicated (or partially sharded) across
+                # processes with per-process stripes would make JAX treat
+                # DIFFERENT values as one replicated array — the silent
+                # cross-host divergence this helper exists to prevent.
+                raise ValueError(
+                    f"spec {spec} shards the batch over {span} way(s), not "
+                    f"divisible by {nproc} processes: per-process stripes "
+                    "would silently diverge. Either make the batch-sharding "
+                    "axes a multiple of the process count, or pass "
+                    "assume_replicated=True and feed IDENTICAL data on "
+                    "every process."
+                )
+        out = tuple(
+            jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.ascontiguousarray(a)
+            )
+            for spec, a in zip(specs, arrays)
+        )
+    return out[0] if len(out) == 1 else out

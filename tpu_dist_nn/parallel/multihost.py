@@ -14,6 +14,7 @@ layout (``mesh.py``) keeps DCN-tolerant axes (data) outermost.
 from __future__ import annotations
 
 import dataclasses
+import functools as _functools
 import os
 
 import jax
@@ -57,6 +58,17 @@ def initialize_multihost(
     # JAX computations" on every multi-host launch).
     if explicit or auto_env:
         try:
+            # Cross-process collectives on the CPU backend need a real
+            # transport (the default deadlocks); gloo ships with jaxlib.
+            # A no-op for TPU jobs (the flag only affects XLA:CPU) but
+            # makes "N processes on one box" — the moral equivalent of
+            # the reference's N containers on one bridge network — work
+            # out of the box, which is also how the real-multi-process
+            # tests run (tests/test_multihost_real.py).
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jaxlib without the option
+        try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
@@ -88,3 +100,39 @@ def assert_same_across_hosts_note() -> str:
         "per-host differences belong in data loading (process_id-sharded "
         "input files), never in model or mesh construction."
     )
+
+
+def to_host_numpy(tree):
+    """Materialize a pytree of jax.Arrays as host numpy on EVERY process.
+
+    Single-process (or fully-addressable / fully-replicated leaves) this
+    is plain ``np.asarray``. In a multi-process job, arrays sharded over
+    a mesh that spans processes are not fully addressable, so reading
+    them host-side (export, checkpoint save, eval metrics) first
+    all-gathers them to a replicated layout — a collective, so EVERY
+    process must call this at the same point even if only process 0
+    consumes the result (the reference's analogue: every container
+    participates in the reply chain even though only the client reads
+    it, grpc_node.py:120-147).
+    """
+    import numpy as np
+
+    def fetch(a):
+        if not isinstance(a, jax.Array):
+            return np.asarray(a)
+        if a.is_fully_replicated or a.is_fully_addressable:
+            return np.asarray(a)
+        return np.asarray(_replicating_identity(a.sharding.mesh)(a))
+
+    return jax.tree.map(fetch, tree)
+
+
+@_functools.lru_cache(maxsize=16)
+def _replicating_identity(mesh):
+    """One jitted all-gather-to-replicated per mesh — a fresh
+    ``jax.jit(lambda x: x)`` per call would retrace and recompile the
+    gather every time (per eval batch, per checkpoint leaf)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(lambda x: x, out_shardings=rep)

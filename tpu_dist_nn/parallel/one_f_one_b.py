@@ -307,13 +307,11 @@ def compiled_1f1b_grad(mesh, meta: PipelineMeta, num_microbatches: int, dtype):
 
     @jax.jit
     def run(weights: PipelineWeights, xs, labels, mask):
-        # labels/mask arrive flat (M*B,) in microbatch-major order (the
-        # layout prepare_pipeline_batch produces); fold back to (M, B).
-        m, bsz = xs.shape[0], xs.shape[1]
-        labels = labels.reshape(m, bsz)
-        # Fold the global mean-normalizer into the mask so tail_fn needs
-        # no cross-microbatch state.
-        mask = mask.reshape(m, bsz).astype(dtype)
+        # labels/mask arrive (M, B) microbatch-major (the layout
+        # prepare_pipeline_batch produces). Fold the global
+        # mean-normalizer into the mask so tail_fn needs no
+        # cross-microbatch state.
+        mask = mask.astype(dtype)
         mask = mask / mask.sum()
         sp = {"w": weights.w, "b": weights.b}
         st = {"act": act, "width": width}

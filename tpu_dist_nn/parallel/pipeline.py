@@ -277,6 +277,27 @@ def pipeline_forward(
     xs, n = pad_batch(
         meta, x, num_microbatches, mesh.shape[AXIS_DATA], weights.w.dtype
     )
+    import jax as _jax
+
+    nproc = _jax.process_count()
+    if nproc > 1:
+        # Multi-host: every process computed the same padded global xs
+        # (inference/eval inputs are replicated host-side); each feeds
+        # its slice of the batch axis into one globally-sharded array.
+        from jax.sharding import PartitionSpec as _P
+
+        from tpu_dist_nn.data.feed import global_batch
+
+        bsz = xs.shape[1]
+        if bsz % nproc:
+            raise ValueError(
+                f"padded microbatch rows ({bsz}) not divisible by "
+                f"{nproc} processes; pick num_microbatches/batch so "
+                f"rows split evenly across hosts"
+            )
+        p = _jax.process_index()
+        local = xs[:, p * (bsz // nproc):(p + 1) * (bsz // nproc), :]
+        xs = global_batch(mesh, _P(None, AXIS_DATA, None), local)
     run = compiled_pipeline(mesh, meta, num_microbatches, logits, weights.w.dtype)
     out = run(weights, xs)
     return out[:n]
@@ -314,8 +335,10 @@ def extract_model(params: PipelineParams, template, distribution) -> "ModelSpec"
                     f"built as ({meta.in_width[si][li]}, {meta.width[si][li]})"
                 )
             layer_idx0 += 1
-    w = np.asarray(weights.w, np.float64)
-    b = np.asarray(weights.b, np.float64)
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+
+    w = np.asarray(to_host_numpy(weights.w), np.float64)
+    b = np.asarray(to_host_numpy(weights.b), np.float64)
     new_layers = []
     layer_idx = 0
     for si, count in enumerate(int(d) for d in distribution):

@@ -149,7 +149,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
              train_cfg: LMTrainConfig, *, mesh=None, num_stages: int = 1,
              num_microbatches: int = 1, checkpoints=None,
              checkpoint_every: int | None = None, step_fn=None,
-             schedule: str = "gpipe"):
+             schedule: str = "gpipe", globalize=None):
     """Run the training loop; pipelined when ``mesh``+``num_stages>1``.
 
     ``checkpoints`` (a CheckpointManager) enables step-level save +
@@ -163,6 +163,13 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     ``step_fn``: ``optimizer -> step`` factory overriding the built-in
     step (used by the MoE family via :func:`make_moe_lm_train_step`);
     the caller then owns any param-layout shard/unshard.
+
+    ``globalize``: ``host_batch -> jax.Array`` assembling each process's
+    stripe into one globally-sharded batch (multi-host;
+    ``data/feed.global_batch``). Without it in a multi-process job the
+    batches stay process-local and every host trains its own divergent
+    model — so that case warns and requires the caller to feed IDENTICAL
+    data on every host (replicated training).
     """
     from tpu_dist_nn.checkpoint.store import resume_or_init
 
@@ -185,6 +192,14 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         raise ValueError(
             "schedule='1f1b' requires the pipelined dense LM path "
             "(mesh + num_stages > 1, no custom step_fn)"
+        )
+    if jax.process_count() > 1 and globalize is None:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "multi-host job without a batch globalizer: training runs "
+            "replicated per host (identical data required on every host); "
+            "no cross-host parallelism"
         )
     if step_fn is not None:
         step = step_fn(optimizer)
@@ -213,7 +228,8 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
                 break
             if i < start_step:
                 continue  # replay-skip: keeps a seeded stream aligned
-            params, opt_state, loss = step(params, opt_state, jnp.asarray(batch))
+            gb = globalize(batch) if globalize is not None else jnp.asarray(batch)
+            params, opt_state, loss = step(params, opt_state, gb)
             if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
                 history.append(
                     {"step": i + 1, "loss": float(loss),

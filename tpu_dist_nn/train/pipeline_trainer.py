@@ -44,13 +44,16 @@ def prepare_pipeline_batch(
     """Pad a host batch for the pipeline (same geometry as inference via
     :func:`tpu_dist_nn.parallel.pipeline.pad_batch`).
 
-    Returns ``(xs, labels, label_mask)`` where padded rows carry label 0
-    and mask 0 so they contribute nothing to the loss.
+    Returns ``(xs, labels, label_mask)`` with ``xs: (M, B, D)`` and
+    ``labels``/``label_mask``: ``(M, B)`` — microbatch-major so every
+    operand shards the same way over the data axis (required for the
+    multi-host global-batch layout). Padded rows carry label 0 and mask
+    0 so they contribute nothing to the loss.
     """
     xs, n = pad_batch(meta, x, num_microbatches, data_size, dtype)
-    n_total = xs.shape[0] * xs.shape[1]
-    labels = np.pad(np.asarray(y, dtype=np.int32), (0, n_total - n))
-    mask = np.pad(np.ones(n, np.float32), (0, n_total - n))
+    m, bsz = xs.shape[0], xs.shape[1]
+    labels = np.pad(np.asarray(y, dtype=np.int32), (0, m * bsz - n)).reshape(m, bsz)
+    mask = np.pad(np.ones(n, np.float32), (0, m * bsz - n)).reshape(m, bsz)
     return xs, labels, mask
 
 
@@ -94,8 +97,10 @@ def make_pipeline_train_step(
         def loss_fn(weights: PipelineWeights, xs, labels, label_mask):
             logits = apply(weights, xs)  # (M*B, final_dim)
             logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
-            return -(ll * label_mask).sum() / label_mask.sum()
+            flat_labels = labels.reshape(-1)
+            flat_mask = label_mask.reshape(-1)
+            ll = jnp.take_along_axis(logp, flat_labels[:, None], axis=-1)[:, 0]
+            return -(ll * flat_mask).sum() / flat_mask.sum()
 
         def grad_fn(weights, xs, labels, label_mask):
             return jax.value_and_grad(loss_fn)(weights, xs, labels, label_mask)
@@ -136,9 +141,40 @@ def train_pipelined(
     """
     weights, meta = params
     data_size = mesh.shape[AXIS_DATA]
+    nproc = jax.process_count()
+    if nproc > 1:
+        # Multi-host: config.batch_size is the GLOBAL batch; this
+        # process's train_data is its stripe (data/feed.shard_for_host)
+        # and contributes batch_size/nproc rows per step, assembled into
+        # one globally-sharded batch below. Divisibility up front so no
+        # step ever needs row padding (per-host padding would desync the
+        # global layout).
+        if config.batch_size % (num_microbatches * data_size):
+            raise ValueError(
+                f"multi-host training needs batch_size ({config.batch_size}) "
+                f"divisible by microbatches*data ({num_microbatches}*{data_size})"
+            )
+        if config.batch_size % nproc:
+            raise ValueError(
+                f"batch_size {config.batch_size} not divisible by "
+                f"{nproc} processes"
+            )
+        if data_size % nproc:
+            raise ValueError(
+                f"the mesh data axis ({data_size}) must be a multiple of "
+                f"the process count ({nproc}) for cross-host data "
+                "parallelism (e.g. --data-parallel "
+                f"{nproc * max(1, data_size // nproc or 1)})"
+            )
+    local_bs = config.batch_size // nproc
+    import dataclasses as _dc
+
     from tpu_dist_nn.train.trainer import optimizer_for
 
-    optimizer = optimizer_for(config, train_data)
+    # Schedule horizons count steps over THIS host's stripe at the local
+    # per-step row count — the same quotient as global rows / global
+    # batch, so every host builds the identical optimizer.
+    optimizer = optimizer_for(_dc.replace(config, batch_size=local_bs), train_data)
     opt_state = optimizer.init(weights)
     step = make_pipeline_train_step(
         mesh, meta, num_microbatches, optimizer, weights.w.dtype, schedule=schedule
@@ -148,7 +184,7 @@ def train_pipelined(
 
     from tpu_dist_nn.utils.errors import check_full_batch
 
-    check_full_batch(len(train_data), config.batch_size)
+    check_full_batch(len(train_data), local_bs)
 
     history = []
     start_epoch, state = resume_or_init(
@@ -162,18 +198,27 @@ def train_pipelined(
             batches = batch_iterator(
                 train_data.x,
                 train_data.y,
-                config.batch_size,
+                local_bs,
                 shuffle=True,
                 seed=config.seed + epoch,
                 drop_remainder=True,
             )
             for bx, by in batches:
                 xs, labels, mask = prepare_pipeline_batch(
-                    meta, bx, by, num_microbatches, data_size, weights.w.dtype
+                    meta, bx, by, num_microbatches,
+                    data_size // nproc if nproc > 1 else data_size,
+                    weights.w.dtype,
                 )
-                weights, opt_state, loss = step(
-                    weights, opt_state, jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask)
+                from jax.sharding import PartitionSpec as P
+
+                from tpu_dist_nn.data.feed import global_batch
+
+                xs, labels, mask = global_batch(
+                    mesh,
+                    (P(None, AXIS_DATA, None), P(None, AXIS_DATA), P(None, AXIS_DATA)),
+                    xs, labels, mask,
                 )
+                weights, opt_state, loss = step(weights, opt_state, xs, labels, mask)
                 losses.append(loss)
             record = {
                 "epoch": epoch,
@@ -207,8 +252,13 @@ def evaluate_pipelined(
     num_microbatches: int = 1,
     batch_size: int = 1024,
 ) -> dict:
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+
     preds = []
     for bx in batch_iterator(data.x, batch_size=batch_size):
+        # Every host evaluates the SAME full set (pipeline_forward
+        # splits each batch across hosts and the gather below restores
+        # it), so metrics come out identical everywhere.
         out = pipeline_forward(mesh, params, bx, num_microbatches=num_microbatches)
-        preds.append(np.asarray(out).argmax(-1))
+        preds.append(to_host_numpy(out).argmax(-1))
     return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
