@@ -170,6 +170,44 @@ def scenario_step_parity() -> dict:
     return {"loss": float(loss), "w_digest": float(np.abs(wn).sum())}
 
 
+def scenario_checkpoint_resume() -> dict:
+    """Multi-host checkpoint round trip with NON-shared filesystems:
+    only process 0's directory receives files (save_pytree gathers on
+    every process, writes on 0), and resume_or_init must broadcast the
+    restored state so a host with an empty directory resumes in sync
+    instead of silently restarting from scratch."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist_nn.checkpoint.store import (
+        AsyncCheckpointManager,
+        resume_or_init,
+    )
+
+    pid = jax.process_index()
+    # DIFFERENT directory per process = no shared FS.
+    d = tempfile.mkdtemp(prefix=f"tdn_mh_ck_p{pid}_")
+    mgr = AsyncCheckpointManager(d, keep=2)
+    state = {"w": jnp.arange(8.0) * (1.0 + pid * 0.0), "step_marker": jnp.ones(())}
+    # Both processes call save in lockstep (the collective contract).
+    saved = {"w": state["w"] * 3.0, "step_marker": state["step_marker"] * 7.0}
+    mgr.save(5, saved, metadata={"note": "mh"})
+    mgr.wait()
+    n_files = len(list(__import__("pathlib").Path(d).glob("ckpt_*")))
+    # Fresh manager on the same per-process dir: process 1's is empty.
+    mgr2 = AsyncCheckpointManager(d, keep=2)
+    step, restored = resume_or_init(mgr2, state)
+    return {
+        "n_files": n_files,
+        "step": step,
+        "w_digest": float(np.abs(np.asarray(restored["w"])).sum()),
+        "marker": float(np.asarray(restored["step_marker"])),
+    }
+
+
 def _global_dataset():
     from tpu_dist_nn.data.datasets import Dataset
     import numpy as np

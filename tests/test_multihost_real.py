@@ -107,6 +107,15 @@ def test_two_process_lm_pipeline_in_sync():
     assert r0["losses"][-1] < r0["losses"][0]
 
 
+def test_two_process_checkpoint_resume_without_shared_fs():
+    r0, r1 = _run_pair("checkpoint_resume")
+    assert r0["n_files"] == 1 and r1["n_files"] == 0  # process 0 writes alone
+    assert r0["step"] == r1["step"] == 5
+    # Host 1 resumed from the BROADCAST state, not its (empty) disk.
+    assert r0["w_digest"] == r1["w_digest"] == pytest.approx(3.0 * 28.0)
+    assert r0["marker"] == r1["marker"] == 7.0
+
+
 def _single_process_step_reference() -> dict:
     import optax
 

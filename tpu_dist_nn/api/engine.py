@@ -427,6 +427,11 @@ class Engine:
         from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
         validate_schedule(schedule)
+        if schedule == "interleaved":
+            raise ValueError(
+                "schedule='interleaved' applies to the transformer LM "
+                "pipeline (tdn lm); dense engines support 'gpipe' and '1f1b'"
+            )
         # The heterogeneous executor sets pipelined=True but trains via
         # the single-program trainer, so it must reject 1f1b too.
         if schedule != "gpipe" and (not self.pipelined or self._hp is not None):

@@ -230,6 +230,16 @@ class AsyncCheckpointManager(CheckpointManager):
             # Enqueueing with no consumer would deadlock a later wait().
             raise RuntimeError("AsyncCheckpointManager is closed")
         self._raise_pending()
+        if jax.process_count() > 1:
+            # The cross-process all-gather MUST happen here on the
+            # caller thread, where every process reaches save() at the
+            # same step — a free-running daemon thread would issue the
+            # collective at arbitrary points relative to the training
+            # step's collectives on other hosts (ordering mismatch =
+            # deadlock). The worker then only serializes host numpy.
+            from tpu_dist_nn.parallel.multihost import to_host_numpy
+
+            state = to_host_numpy(state)
         self._queue.put((int(step), state, metadata))
         return self._path(int(step))
 
@@ -271,13 +281,33 @@ def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
     """
     if checkpoints is None:
         return 0, state
-    restored = checkpoints.restore_or_none(state)
-    if restored is None:
-        return 0, state
-    step, restored_state = restored
 
     import jax
     import numpy as np
+
+    if jax.process_count() > 1:
+        # Only process 0 writes checkpoints (save_pytree), so only it
+        # can read them — hosts without a shared filesystem would find
+        # nothing and silently restart from scratch, diverging from
+        # host 0 inside the very first collective. Process 0 restores
+        # and BROADCASTS (step, state); everyone else receives.
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            local = checkpoints.restore_or_none(state)
+        else:
+            local = None
+        step_arr = np.int64(local[0] if local is not None else -1)
+        step = int(multihost_utils.broadcast_one_to_all(step_arr))
+        if step < 0:
+            return 0, state
+        payload = local[1] if local is not None else state
+        restored_state = multihost_utils.broadcast_one_to_all(payload)
+    else:
+        restored = checkpoints.restore_or_none(state)
+        if restored is None:
+            return 0, state
+        step, restored_state = restored
 
     def _check(t, r):
         ts = np.shape(t)

@@ -556,8 +556,9 @@ def cmd_lm(args) -> int:
     # load, param init, or checkpoint-dir creation do any work.
     if args.schedule != "gpipe" and (args.stages <= 1 or step_fn is not None):
         raise ValueError(
-            "--schedule 1f1b applies to the pipelined dense LM only "
-            "(--stages > 1, without --experts/--seq-parallel/--zero1/--fsdp)"
+            f"--schedule {args.schedule} applies to the pipelined dense LM "
+            "only (--stages > 1, without --experts/--seq-parallel/"
+            "--zero1/--fsdp)"
         )
 
     text, source = load_corpus(args.corpus)
@@ -639,6 +640,7 @@ def cmd_lm(args) -> int:
         num_stages=args.stages, num_microbatches=args.microbatches,
         checkpoints=checkpoints, step_fn=step_fn,
         schedule=args.schedule, globalize=globalize,
+        num_virtual=getattr(args, "virtual_stages", 1),
     )
     train_seconds = time.monotonic() - t0
     if unshard_fn is not None:
@@ -895,8 +897,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
-    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
-                   help="pipeline training schedule when --stages > 1")
+    p.add_argument("--schedule", choices=["gpipe", "1f1b", "interleaved"],
+                   default="gpipe",
+                   help="pipeline training schedule when --stages > 1 "
+                        "(interleaved = Megatron virtual stages, see "
+                        "--virtual-stages)")
+    p.add_argument("--virtual-stages", type=int, default=2,
+                   help="model chunks per device for --schedule "
+                        "interleaved (bubble shrinks ~v-fold)")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="shard the sequence axis over N devices "

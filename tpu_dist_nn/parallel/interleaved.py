@@ -1,0 +1,272 @@
+"""Table-driven interleaved (virtual-stage) 1F1B pipeline executor.
+
+Runs the schedules compiled by
+:mod:`tpu_dist_nn.parallel.schedule_table`: device ``s`` holds ``v``
+model chunks (global chunk ``c`` at local slot ``c // S``, ``c % S ==
+s``), and each scan tick plays back one table entry — idle, one chunk's
+forward, or one chunk's backward (with activation recompute, as in
+:mod:`tpu_dist_nn.parallel.one_f_one_b`). Forward activations ride a
+``ppermute`` ring ``s -> s+1 (mod S)`` — the wrap link carries chunk
+``kS-1 -> kS`` hand-offs — and cotangents ride the reverse ring;
+receive buffers (slot-allocated by the host scheduler, verified
+clobber-free) decouple arrival from consumption, which is what lets the
+Megatron-interleaved order cut the pipeline bubble to ``2(S-1)``
+chunk-ticks, ``v``x less than contiguous-chunk 1F1B.
+
+The executor is schedule-agnostic: any
+:class:`~tpu_dist_nn.parallel.schedule_table.ScheduleTables` with the
+same wire model plays back unchanged (a zero-bubble variant would only
+add a table builder).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
+from tpu_dist_nn.parallel.schedule_table import ScheduleTables, build_interleaved_1f1b
+
+
+def make_interleaved_1f1b(
+    mesh,
+    stage_fn,
+    tail_fn,
+    num_virtual: int,
+    num_microbatches: int,
+    *,
+    microbatch_spec=None,
+    chunk_params_spec=None,
+    aux_spec=None,
+    want_dx0: bool = True,
+    tables: ScheduleTables | None = None,
+):
+    """Interleaved counterpart of
+    :func:`tpu_dist_nn.parallel.one_f_one_b.make_1f1b`.
+
+    * ``stage_fn(chunk_params, chunk_static, x) -> y`` — ONE chunk's
+      compute; ``chunk_params``/``chunk_static`` pytrees arrive with
+      leaves ``(v, ...)`` per device (global layout ``(S, v, ...)``,
+      spec ``P(stage)``) and this wrapper indexes out the scheduled
+      chunk's slice per tick.
+    * ``tail_fn(tail_params, y, *aux_f)`` — per-microbatch loss on the
+      LAST chunk's output (pre-scaled), exactly as in ``make_1f1b``.
+
+    Returns ``f(xs, chunk_params, chunk_static, tail_params, aux) ->
+    (loss, chunk_grads, tail_grads, dx0)`` with ``chunk_grads`` in the
+    ``(S, v, ...)`` layout of the params.
+    """
+    S = mesh.shape[AXIS_STAGE]
+    v, M = num_virtual, num_microbatches
+    if tables is None:
+        tables = build_interleaved_1f1b(S, v, M)
+    if (tables.num_devices, tables.num_chunks, tables.num_microbatches) != (S, S * v, M):
+        raise ValueError("tables do not match (S, v, M)")
+    T, A, G, K = tables.ticks, tables.abuf_slots, tables.gbuf_slots, tables.stash_slots
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    vary = (AXIS_STAGE, AXIS_DATA)
+    if microbatch_spec is None:
+        microbatch_spec = P(AXIS_DATA)
+    if chunk_params_spec is None:
+        chunk_params_spec = P(AXIS_STAGE)
+    if aux_spec is None:
+        aux_spec = P(None, *microbatch_spec)
+    xs_spec = P(None, *microbatch_spec)
+    tb = {
+        name: jnp.asarray(getattr(tables, name))
+        for name in (
+            "op", "chunk", "mb", "stash",
+            "abuf_read", "gbuf_read", "abuf_write", "gbuf_write", "is_c0",
+        )
+    }
+
+    def device_fn(xs, chunk_params, chunk_static, tail_params, aux):
+        # Strip the length-1 stage-shard axis -> (v, ...) leaves; mark
+        # params data-varying so jax.vjp stays collective-free (see
+        # one_f_one_b's note), tail params (stage, data)-varying.
+        sp = jax.tree.map(
+            lambda a: lax.pcast(a[0], (AXIS_DATA,), to="varying"), chunk_params
+        )
+        st = jax.tree.map(lambda a: a[0], chunk_static)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        mb_shape = xs.shape[1:]
+        dt = xs.dtype
+
+        def vcast(z):
+            have = getattr(jax.typeof(z), "vma", frozenset())
+            need = tuple(a for a in vary if a not in have)
+            return lax.pcast(z, need, to="varying") if need else z
+
+        tp = jax.tree.map(lambda a: vcast(jnp.asarray(a)), tail_params)
+
+        # This device's schedule rows: (T,) each.
+        row = {
+            k: lax.dynamic_index_in_dim(val, s_idx, 0, keepdims=False)
+            for k, val in tb.items()
+        }
+
+        def chunk_fwd(pc, x):
+            return stage_fn(pc, st, x)
+
+        zeros_wire = vcast(jnp.zeros(mb_shape, dt))
+        carry0 = (
+            zeros_wire,                                  # fwd ring payload
+            zeros_wire,                                  # bwd ring payload
+            vcast(jnp.zeros((A, *mb_shape), dt)),        # activation recv buf
+            vcast(jnp.zeros((G, *mb_shape), dt)),        # cotangent recv buf
+            vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
+            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), sp),
+            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), tp),
+            vcast(jnp.zeros((M if want_dx0 else 1, *mb_shape), dt)),
+            vcast(jnp.zeros((), jnp.float32)),           # loss accumulator
+        )
+
+        def tick(carry, t):
+            fwd_wire, bwd_wire, abuf, gbuf, stash, g_sp, g_tp, dx0, loss_acc = carry
+            # Receive phase: store last tick's ring payloads into their
+            # scheduled slots (-1 = not for us / discard).
+            aw = row["abuf_write"][t]
+            abuf = jnp.where(
+                aw >= 0,
+                lax.dynamic_update_index_in_dim(
+                    abuf, fwd_wire, jnp.clip(aw, 0, A - 1), 0
+                ),
+                abuf,
+            )
+            gw = row["gbuf_write"][t]
+            gbuf = jnp.where(
+                gw >= 0,
+                lax.dynamic_update_index_in_dim(
+                    gbuf, bwd_wire, jnp.clip(gw, 0, G - 1), 0
+                ),
+                gbuf,
+            )
+            g_slot = row["chunk"][t]
+            f = row["mb"][t]
+            k_slot = row["stash"][t]
+            pc = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, g_slot, 0, keepdims=False),
+                sp,
+            )
+            stc = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, g_slot, 0, keepdims=False),
+                st,
+            )
+
+            def chunk_fwd_g(p, x):
+                return stage_fn(p, stc, x)
+
+            def idle(_):
+                return zeros_wire, zeros_wire, stash, g_sp, g_tp, dx0, loss_acc
+
+            def fwd(_):
+                ar = row["abuf_read"][t]
+                feed = lax.dynamic_index_in_dim(xs, f, 0, keepdims=False)
+                buf = lax.dynamic_index_in_dim(
+                    abuf, jnp.clip(ar, 0, A - 1), 0, keepdims=False
+                )
+                x_in = jnp.where(ar < 0, feed, buf)
+                new_stash = lax.dynamic_update_index_in_dim(stash, x_in, k_slot, 0)
+                y = chunk_fwd_g(pc, x_in)
+                return y, zeros_wire, new_stash, g_sp, g_tp, dx0, loss_acc
+
+            def bwd(_):
+                x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
+                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                gr = row["gbuf_read"][t]
+                aux_f = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, f, 0, keepdims=False),
+                    aux,
+                )
+
+                def tail_live(_):
+                    loss_f, tvjp = jax.vjp(
+                        lambda tpar, yy: tail_fn(tpar, yy, *aux_f), tp, y
+                    )
+                    d_tp, dy = tvjp(vcast(jnp.ones((), loss_f.dtype)))
+                    return loss_f.astype(jnp.float32), dy, d_tp
+
+                def tail_skip(_):
+                    return (
+                        vcast(jnp.zeros((), jnp.float32)),
+                        zeros_wire,
+                        jax.tree.map(lambda a: vcast(jnp.zeros_like(a)), tp),
+                    )
+
+                loss_f, dy_tail, d_tp = lax.cond(gr < 0, tail_live, tail_skip, 0)
+                grad_in = lax.dynamic_index_in_dim(
+                    gbuf, jnp.clip(gr, 0, G - 1), 0, keepdims=False
+                )
+                dy = jnp.where(gr < 0, dy_tail, grad_in)
+                d_pc, dx = svjp(dy)
+                new_g_sp = jax.tree.map(
+                    lambda acc, d: lax.dynamic_update_index_in_dim(
+                        acc,
+                        lax.dynamic_index_in_dim(acc, g_slot, 0, keepdims=False) + d,
+                        g_slot,
+                        0,
+                    ),
+                    g_sp,
+                    d_pc,
+                )
+                if want_dx0:
+                    new_dx0 = jnp.where(
+                        row["is_c0"][t] > 0,
+                        lax.dynamic_update_index_in_dim(dx0, dx, f, 0),
+                        dx0,
+                    )
+                else:
+                    new_dx0 = dx0
+                return (
+                    zeros_wire,
+                    dx,
+                    stash,
+                    new_g_sp,
+                    jax.tree.map(jnp.add, g_tp, d_tp),
+                    new_dx0,
+                    loss_acc + loss_f,
+                )
+
+            send_y, send_dx, stash, g_sp, g_tp, dx0, loss_acc = lax.switch(
+                row["op"][t], [idle, fwd, bwd], 0
+            )
+            with jax.named_scope("interleaved_ring_hop"):
+                nxt_fwd = (
+                    lax.ppermute(send_y, AXIS_STAGE, fwd_perm) if S > 1 else send_y
+                )
+                nxt_bwd = (
+                    lax.ppermute(send_dx, AXIS_STAGE, bwd_perm) if S > 1 else send_dx
+                )
+            return (
+                nxt_fwd, nxt_bwd, abuf, gbuf, stash, g_sp, g_tp, dx0, loss_acc
+            ), None
+
+        (_f, _b, _a, _g, _s, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        g_sp = jax.tree.map(lambda a: lax.psum(a, AXIS_DATA)[None], g_sp)
+        g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
+        if want_dx0:
+            dx0 = lax.psum(dx0, AXIS_STAGE)
+        else:
+            dx0 = jnp.zeros((), jnp.float32)
+        loss = lax.psum(loss_acc, vary)
+        return loss, g_sp, g_tp, dx0
+
+    return jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(
+            xs_spec,
+            chunk_params_spec,
+            chunk_params_spec,
+            P(),
+            aux_spec,
+        ),
+        out_specs=(P(), chunk_params_spec, P(), xs_spec if want_dx0 else P()),
+    )

@@ -41,7 +41,9 @@ from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
 from tpu_dist_nn.parallel.pipeline import PipelineMeta, PipelineWeights, _stage_apply
 
 #: The pipeline training schedules the framework implements.
-SCHEDULES = ("gpipe", "1f1b")
+#: "interleaved" = virtual-stage (Megatron) 1F1B — see
+#: parallel/interleaved.py; LM family only for now.
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 
 def validate_schedule(schedule: str) -> str:

@@ -277,9 +277,7 @@ def pipeline_forward(
     xs, n = pad_batch(
         meta, x, num_microbatches, mesh.shape[AXIS_DATA], weights.w.dtype
     )
-    import jax as _jax
-
-    nproc = _jax.process_count()
+    nproc = jax.process_count()
     if nproc > 1:
         # Multi-host: every process computed the same padded global xs
         # (inference/eval inputs are replicated host-side); each feeds
@@ -295,7 +293,7 @@ def pipeline_forward(
                 f"{nproc} processes; pick num_microbatches/batch so "
                 f"rows split evenly across hosts"
             )
-        p = _jax.process_index()
+        p = jax.process_index()
         local = xs[:, p * (bsz // nproc):(p + 1) * (bsz // nproc), :]
         xs = global_batch(mesh, _P(None, AXIS_DATA, None), local)
     run = compiled_pipeline(mesh, meta, num_microbatches, logits, weights.w.dtype)

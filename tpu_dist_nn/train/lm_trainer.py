@@ -84,19 +84,32 @@ def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
 
 def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
                                 num_microbatches: int, optimizer,
-                                attn_fn=None, schedule: str = "gpipe"):
-    """Pipelined train step; ``params["blocks"]`` must be stage-grouped
-    (:func:`tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`).
+                                attn_fn=None, schedule: str = "gpipe",
+                                num_virtual: int = 1):
+    """Pipelined train step.
 
-    ``schedule``: "gpipe" (AD through the forward schedule) or "1f1b"
-    (hand-rolled one-forward-one-backward with activation recompute,
-    O(num_stages) live activations — see
-    :mod:`tpu_dist_nn.parallel.one_f_one_b`).
+    ``schedule``: "gpipe" (AD through the forward schedule; blocks in
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`
+    layout), "1f1b" (hand-rolled one-forward-one-backward with
+    activation recompute, O(num_stages) live activations; same layout),
+    or "interleaved" (virtual-stage Megatron 1F1B, ``num_virtual``
+    chunks per device, blocks in
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks_interleaved`
+    layout — bubble cut to 2(S-1) chunk-ticks).
     """
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
     attn = _resolve_attn_fn(attn_fn)
+    if schedule == "interleaved":
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            make_pipeline_lm_interleaved_grad,
+        )
+
+        vag = make_pipeline_lm_interleaved_grad(
+            mesh, cfg, num_virtual, num_microbatches, attn
+        )
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule == "1f1b":
         from tpu_dist_nn.parallel.transformer_pipeline import (
             make_pipeline_lm_1f1b_grad,
@@ -149,7 +162,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
              train_cfg: LMTrainConfig, *, mesh=None, num_stages: int = 1,
              num_microbatches: int = 1, checkpoints=None,
              checkpoint_every: int | None = None, step_fn=None,
-             schedule: str = "gpipe", globalize=None):
+             schedule: str = "gpipe", globalize=None, num_virtual: int = 1):
     """Run the training loop; pipelined when ``mesh``+``num_stages>1``.
 
     ``checkpoints`` (a CheckpointManager) enables step-level save +
@@ -190,7 +203,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     pipelined = step_fn is None and mesh is not None and num_stages > 1
     if schedule != "gpipe" and not pipelined:
         raise ValueError(
-            "schedule='1f1b' requires the pipelined dense LM path "
+            f"schedule={schedule!r} requires the pipelined dense LM path "
             "(mesh + num_stages > 1, no custom step_fn)"
         )
     if jax.process_count() > 1 and globalize is None:
@@ -203,6 +216,21 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         )
     if step_fn is not None:
         step = step_fn(optimizer)
+    elif pipelined and schedule == "interleaved":
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            shard_blocks_interleaved,
+        )
+
+        params = dict(
+            params,
+            blocks=shard_blocks_interleaved(
+                params["blocks"], num_stages, num_virtual
+            ),
+        )
+        step = make_pipeline_lm_train_step(
+            mesh, cfg, num_stages, num_microbatches, optimizer,
+            schedule=schedule, num_virtual=num_virtual,
+        )
     elif pipelined:
         params = dict(params, blocks=shard_blocks(params["blocks"], num_stages))
         step = make_pipeline_lm_train_step(
@@ -247,7 +275,16 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         # raises — the crash-resume guarantee is the point.
         flush(checkpoints)
     if pipelined:
-        params = dict(params, blocks=unshard_blocks(params["blocks"]))
+        if schedule == "interleaved":
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                unshard_blocks_interleaved,
+            )
+
+            params = dict(
+                params, blocks=unshard_blocks_interleaved(params["blocks"])
+            )
+        else:
+            params = dict(params, blocks=unshard_blocks(params["blocks"]))
     return params, history
 
 

@@ -83,6 +83,12 @@ def make_pipeline_train_step(
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if schedule == "interleaved":
+        raise ValueError(
+            "schedule='interleaved' (virtual stages) is implemented for the "
+            "transformer LM pipeline (tdn lm --schedule interleaved); the "
+            "dense chain supports 'gpipe' and '1f1b'"
+        )
     w_mask_np, b_mask_np = meta.grad_masks()
     w_mask = jnp.asarray(w_mask_np, dtype)
     b_mask = jnp.asarray(b_mask_np, dtype)
