@@ -268,6 +268,25 @@ def flush(checkpoints) -> None:
         wait()
 
 
+def _shape_check_leaf(t, r):
+    """Template-vs-restored leaf shape gate (see resume_or_init docstring)."""
+    import numpy as np
+
+    ts = np.shape(t)
+    rs = np.shape(r)
+    if ts != rs:
+        from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"checkpoint leaf shape {rs} does not match this run's "
+            f"template shape {ts} — the checkpoint was written under "
+            "a different placement (e.g. a different --stages or "
+            "model size); use a matching configuration or a fresh "
+            "checkpoint directory"
+        )
+    return r
+
+
 def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
     """Shared trainer resume step: restore the newest checkpoint into
     ``state``'s structure, or keep ``state`` as-is when none exists.
@@ -293,12 +312,31 @@ def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
         # and BROADCASTS (step, state); everyone else receives.
         from jax.experimental import multihost_utils
 
+        local = None
+        fail = None
         if jax.process_index() == 0:
-            local = checkpoints.restore_or_none(state)
-        else:
-            local = None
-        step_arr = np.int64(local[0] if local is not None else -1)
+            # Restore AND shape-validate before any collective: a
+            # mismatched payload entering broadcast_one_to_all (whose
+            # contract is same-shape-on-all-processes) would crash or
+            # hang the job instead of raising the friendly error; a
+            # proc-0 exception with no broadcast would hang everyone
+            # else — so failures are broadcast as a sentinel first.
+            try:
+                local = checkpoints.restore_or_none(state)
+                if local is not None:
+                    jax.tree.map(_shape_check_leaf, state, local[1])
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                fail = e
+        step_arr = np.int64(
+            -2 if fail is not None else (local[0] if local is not None else -1)
+        )
         step = int(multihost_utils.broadcast_one_to_all(step_arr))
+        if step == -2:
+            if fail is not None:
+                raise fail
+            raise RuntimeError(
+                "process 0 failed to restore the checkpoint (see its log)"
+            )
         if step < 0:
             return 0, state
         payload = local[1] if local is not None else state
@@ -309,20 +347,5 @@ def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
             return 0, state
         step, restored_state = restored
 
-    def _check(t, r):
-        ts = np.shape(t)
-        rs = np.shape(r)
-        if ts != rs:
-            from tpu_dist_nn.utils.errors import InvalidArgumentError
-
-            raise InvalidArgumentError(
-                f"checkpoint leaf shape {rs} does not match this run's "
-                f"template shape {ts} — the checkpoint was written under "
-                "a different placement (e.g. a different --stages or "
-                "model size); use a matching configuration or a fresh "
-                "checkpoint directory"
-            )
-        return r
-
-    restored_state = jax.tree.map(_check, state, restored_state)
+    restored_state = jax.tree.map(_shape_check_leaf, state, restored_state)
     return step, restored_state

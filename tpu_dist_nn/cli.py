@@ -153,8 +153,8 @@ def _parse_distribution(text):
     return [int(t) for t in text.replace(",", " ").split()]
 
 
-def _add_up_args(p):
-    p.add_argument("--config", required=True, help="model JSON file")
+def _add_up_args(p, config_required=True):
+    p.add_argument("--config", required=config_required, help="model JSON file")
     p.add_argument("--inputs", help="example inputs JSON file")
     p.add_argument("--distribution", help="layer distribution, e.g. 1,1,1")
     p.add_argument("--data-parallel", type=int, default=1)
@@ -177,10 +177,13 @@ def _engine_from_args(args, warmup=True):
     )
 
 
-def _serve_loop(engine, max_seconds: float | None = None) -> None:
+def _serve_loop(engine, max_seconds: float | None = None, teardown=None) -> None:
     """Supervisor loop: stay up until SIGINT, then tear down cleanly —
     the reference orchestrator's main loop (run_grpc_fcnn.py:326-344).
-    ``max_seconds`` bounds the loop for tests."""
+    ``max_seconds`` bounds the loop for tests. ``teardown`` overrides
+    the default ``engine.down()`` (the gRPC path must drain the server
+    BEFORE downing the engine, or grace-period requests hit a dead
+    engine)."""
     t0 = time.monotonic()
     try:
         while max_seconds is None or time.monotonic() - t0 < max_seconds:
@@ -188,7 +191,10 @@ def _serve_loop(engine, max_seconds: float | None = None) -> None:
     except KeyboardInterrupt:
         log.info("interrupt received; tearing down")
     finally:
-        engine.down()
+        if teardown is not None:
+            teardown()
+        else:
+            engine.down()
         log.info("engine down; relaunch with `tdn up` (stateless restart)")
 
 
@@ -204,6 +210,19 @@ def cmd_up(args) -> int:
         print(json.dumps({"smoke_inference": result.outputs[0].tolist()}))
     if args.probe_latency:
         print(json.dumps({"step_latency": engine.step_latency()}))
+    if args.grpc_port is not None:
+        from tpu_dist_nn.serving import serve_engine
+
+        server, bound = serve_engine(engine, args.grpc_port)
+        print(json.dumps({"grpc_port": bound}), flush=True)
+
+        def teardown():
+            # Drain in-flight RPCs before the engine goes away.
+            server.stop(grace=1.0).wait()
+            engine.down()
+
+        _serve_loop(engine, teardown=teardown)
+        return 0
     if args.serve:
         _serve_loop(engine)
     return 0
@@ -214,6 +233,16 @@ def cmd_infer(args) -> int:
 
     if not args.inputs:
         raise ValueError("tdn infer requires --inputs (an examples JSON file)")
+    if not getattr(args, "target", None) and args.port is not None and not args.config:
+        # A bare --port with no local model means "talk to the server on
+        # localhost" — the reference client's default addressing
+        # (run_grpc_inference.py:27: 127.0.0.1:5101).
+        args.target = f"127.0.0.1:{args.port}"
+    if getattr(args, "target", None):
+        return _infer_over_grpc(args)
+    if not args.config:
+        raise ValueError("tdn infer requires --config (or --target for "
+                         "client-only mode against a running server)")
     engine = _engine_from_args(args)
     x, y = load_examples(args.inputs)
     if args.input_index is not None:
@@ -247,6 +276,55 @@ def cmd_infer(args) -> int:
     print(f"Total inference time: {result.seconds:.4f} seconds "
           f"({n / result.seconds:.1f} samples/sec)")
     return 0
+
+
+def _infer_over_grpc(args) -> int:
+    """Client-only inference against a running ``tdn serve`` endpoint —
+    the reference client's role (run_grpc_inference.py): no model file
+    needed, batches over one persistent channel, accuracy + latency
+    reported the same way."""
+    import math
+
+    import numpy as np
+
+    from tpu_dist_nn.core.schema import load_examples
+    from tpu_dist_nn.serving import GrpcClient
+    from tpu_dist_nn.train.metrics import classification_metrics
+
+    x, y = load_examples(args.inputs)
+    client = GrpcClient(args.target, timeout=args.timeout or 30.0)
+    try:
+        if args.input_index is not None:
+            t0 = time.monotonic()
+            out = client.process(np.asarray(x[args.input_index])[None, :])[0]
+            seconds = time.monotonic() - t0
+            print(f"Output: {out.tolist()}")
+            print(f"Inference time: {seconds:.4f} seconds")
+            if y[args.input_index] >= 0:
+                print(f"Label: {y[args.input_index]}  predicted: {int(out.argmax())}")
+            return 0
+        bs = args.batch_size or len(x)
+        outs = []
+        t0 = time.monotonic()
+        for i in range(math.ceil(len(x) / bs)):
+            tb = time.monotonic()
+            outs.append(client.process(x[i * bs:(i + 1) * bs]))
+            log.info("batch %d took %.4f seconds", i, time.monotonic() - tb)
+        seconds = time.monotonic() - t0
+        out = np.vstack(outs)
+        n = len(x)
+        if (y >= 0).all():
+            preds = out.argmax(-1)
+            metrics = classification_metrics(preds, y, out.shape[1])
+            correct = int((preds == y).sum())
+            print(f"Correct predictions: {correct}/{n} "
+                  f"(accuracy {metrics['accuracy']:.4f})")
+            print(f"Metrics: {json.dumps(metrics)}")
+        print(f"Total inference time: {seconds:.4f} seconds "
+              f"({n / seconds:.1f} samples/sec)")
+        return 0
+    finally:
+        client.close()
 
 
 def cmd_train(args) -> int:
@@ -804,16 +882,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve", action="store_true",
                    help="stay up until Ctrl-C, then tear down "
                         "(the reference orchestrator's supervisor loop)")
+    p.add_argument("--grpc-port", type=int, default=None,
+                   help="also expose the reference's LayerService gRPC "
+                        "endpoint on this port (wire-compatible with "
+                        "run_grpc_inference.py; its stage-0 port is 5101) "
+                        "and stay up until Ctrl-C")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
     p.add_argument("input_index", nargs="?", type=int, default=None)
-    _add_up_args(p)
+    _add_up_args(p, config_required=False)
     _add_multihost_args(p)
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--target",
+                   help="host:port of a running `tdn up --grpc-port` "
+                        "server: act as a pure gRPC client (the "
+                        "reference client's role; no --config needed)")
     p.add_argument("--port", type=int, default=None,
-                   help="compat no-op (no sockets in the data path)")
-    p.add_argument("--timeout", type=float, default=None, help="compat no-op")
+                   help="with no --target: compat no-op (no sockets in "
+                        "the local data path); shorthand for "
+                        "--target 127.0.0.1:PORT otherwise")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="per-RPC timeout for --target (default 30s); "
+                        "compat no-op locally")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace here")
     p.set_defaults(fn=cmd_infer)

@@ -21,8 +21,6 @@ add a table builder).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -109,9 +107,6 @@ def make_interleaved_1f1b(
             k: lax.dynamic_index_in_dim(val, s_idx, 0, keepdims=False)
             for k, val in tb.items()
         }
-
-        def chunk_fwd(pc, x):
-            return stage_fn(pc, st, x)
 
         zeros_wire = vcast(jnp.zeros(mb_shape, dt))
         carry0 = (

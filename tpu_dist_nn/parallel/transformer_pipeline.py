@@ -230,7 +230,6 @@ def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
     layout; grads come back in the same layout.
     """
     from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
-    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
 
     apply = maybe_remat(cfg)
     M = num_microbatches

@@ -1,0 +1,8 @@
+"""Wire-compatible gRPC serving (the reference's LayerService protocol)."""
+
+from tpu_dist_nn.serving.server import GrpcClient, serve_engine  # noqa: F401
+from tpu_dist_nn.serving.wire import (  # noqa: F401
+    PROCESS_METHOD,
+    decode_matrix,
+    encode_matrix,
+)

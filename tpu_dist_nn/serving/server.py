@@ -1,0 +1,131 @@
+"""gRPC serving endpoint, wire-compatible with the reference's client.
+
+Runs the reference's one RPC — ``LayerService.Process(Matrix) ->
+Matrix`` (``src/proto/dist_nn.proto:13-15``) — in front of an
+:class:`~tpu_dist_nn.api.engine.Engine`, so a user of docker-dist-nn
+can point their EXISTING client (``run_grpc_inference.py``) at
+``tdn serve`` unchanged. The difference is behind the socket: the
+reference answers by chaining nested gRPC hops through one container
+per stage (``grpc_node.py:120-147``); here the whole pipeline is one
+SPMD program on the mesh, so the request crosses exactly one
+serialization boundary instead of ``2 x num_stages``.
+
+Error parity (``grpc_node.py:149-158``): a wrong input width returns
+``INVALID_ARGUMENT`` with the dim message; unexpected failures return
+``INTERNAL``. gRPC concurrency mirrors the reference's 10-thread server
+(``grpc_node.py:169``); compute itself serializes through the engine
+(one mesh, one program — concurrent REQUESTS queue, exactly like the
+reference's per-stage GIL-bound numpy).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+
+import grpc
+import numpy as np
+
+from tpu_dist_nn.serving.wire import (
+    PROCESS_METHOD,
+    SERVICE_NAME,
+    decode_matrix,
+    encode_matrix,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _make_handler(engine):
+    import threading
+
+    lock = threading.Lock()
+
+    def process(request_bytes: bytes, context) -> bytes:
+        try:
+            x = decode_matrix(request_bytes)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad Matrix: {e}")
+        try:
+            with lock:
+                out = engine.infer(x)
+        except Exception as e:  # noqa: BLE001 — map to status codes
+            from tpu_dist_nn.utils.errors import InvalidArgumentError, UnavailableError
+
+            if isinstance(e, InvalidArgumentError):
+                # The reference's dim-check path (grpc_node.py:149-153).
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            if isinstance(e, UnavailableError):
+                # Engine torn down mid-flight: the reference's
+                # dead-channel semantics (clients may retry elsewhere).
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            log.exception("inference failed")
+            context.abort(grpc.StatusCode.INTERNAL, f"inference failed: {e}")
+        return encode_matrix(np.asarray(out, np.float64))
+
+    rpc = grpc.unary_unary_rpc_method_handler(
+        process,
+        request_deserializer=bytes,   # raw bytes in, our codec decodes
+        response_serializer=bytes,
+    )
+    service = grpc.method_handlers_generic_handler(
+        SERVICE_NAME, {"Process": rpc}
+    )
+    return service
+
+
+def serve_engine(engine, port: int, *, max_workers: int = 10):
+    """Start a gRPC server bound to ``0.0.0.0:port``; returns
+    ``(server, bound_port)`` (``port=0`` picks an ephemeral port —
+    used by tests).
+
+    ``max_workers=10`` is the reference's thread-pool size
+    (``grpc_node.py:169``); unlimited message sizes match its client
+    channel options (``run_grpc_inference.py:124-127``).
+    """
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+        ],
+    )
+    server.add_generic_rpc_handlers((_make_handler(engine),))
+    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    if bound == 0:
+        raise OSError(f"could not bind gRPC server to port {port}")
+    server.start()
+    log.info("gRPC LayerService serving on :%d (wire-compatible with "
+             "run_grpc_inference.py)", bound)
+    return server, bound
+
+
+class GrpcClient:
+    """Minimal client for the Process RPC — the ``tdn infer --target``
+    transport (the reference client's ``run_batch_inference`` analogue,
+    ``run_grpc_inference.py:112-158``: one persistent channel, unlimited
+    message sizes, float64 rows)."""
+
+    def __init__(self, target: str, timeout: float = 30.0):
+        self.target = target
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            target,
+            options=[
+                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1),
+            ],
+        )
+        self._call = self._channel.unary_unary(
+            PROCESS_METHOD,
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
+
+    def process(self, x: np.ndarray) -> np.ndarray:
+        reply = self._call(encode_matrix(np.asarray(x, np.float64)),
+                           timeout=self.timeout)
+        return decode_matrix(reply)
+
+    def close(self) -> None:
+        self._channel.close()
