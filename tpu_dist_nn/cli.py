@@ -603,7 +603,7 @@ def cmd_lm(args) -> int:
             global_mesh, global_span = sp_mesh, args.data_parallel
             global_axes = "_data_"
             step_fn = lambda opt: make_seq_parallel_lm_train_step(  # noqa: E731
-                sp_mesh, cfg, opt
+                sp_mesh, cfg, opt, mode=args.sp_mode
             )
         elif args.zero1 or args.fsdp:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
@@ -637,6 +637,11 @@ def cmd_lm(args) -> int:
             f"--schedule {args.schedule} applies to the pipelined dense LM "
             "only (--stages > 1, without --experts/--seq-parallel/"
             "--zero1/--fsdp)"
+        )
+    if args.sp_mode != "ring" and args.seq_parallel <= 1:
+        raise ValueError(
+            "--sp-mode requires --seq-parallel > 1 (it picks the "
+            "sequence-parallel decomposition)"
         )
 
     text, source = load_corpus(args.corpus)
@@ -999,7 +1004,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="shard the sequence axis over N devices "
-                        "(ring attention) for long-context training")
+                        "for long-context training (see --sp-mode)")
+    p.add_argument("--sp-mode", choices=["ring", "ulysses"], default="ring",
+                   help="sequence-parallel decomposition: ring attention "
+                        "(K/V rotation, O(T/N) memory) or ulysses "
+                        "(all-to-all head scatter; needs heads %% N == 0)")
     p.add_argument("--microbatches", type=int, default=4)
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (f32 master params + CE)")

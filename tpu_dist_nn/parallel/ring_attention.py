@@ -100,7 +100,53 @@ def ring_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ):
     return out.astype(out_dtype)
 
 
-def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig):
+def ulysses_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ):
+    """DeepSpeed-Ulysses-style all-to-all sequence parallelism.
+
+    The alternative long-context decomposition to the ring: instead of
+    rotating K/V blocks, redistribute with two ``all_to_all``s so each
+    device holds the FULL sequence for ``H/N`` heads, runs ordinary
+    attention locally (heads are embarrassingly parallel), and scatters
+    back. Communication is 2 all-to-alls of the activations per call
+    (vs N-1 K/V hops for the ring); memory is O(T * H/N) — full
+    sequence but a head slice — vs the ring's O(T/N * H). Prefer it
+    when heads are plentiful and T_local is the bottleneck; the ring
+    when T is extreme and heads are few.
+
+    Same signature/semantics as
+    :func:`~tpu_dist_nn.models.transformer.dot_product_attention` on the
+    gathered sequence; requires ``n_heads % seq_axis == 0``.
+    """
+    from tpu_dist_nn.models.transformer import dot_product_attention
+
+    N = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % N:
+        raise ValueError(
+            f"ulysses needs n_heads ({H}) divisible by the seq axis ({N})"
+        )
+    # (B, T/N, H, Dh) -> (B, T, H/N, Dh): gather sequence, scatter heads.
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    o = dot_product_attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    return to_seq(o)
+
+
+SP_MODES = ("ring", "ulysses")
+
+
+def _sp_attn_fn(mode: str):
+    if mode not in SP_MODES:
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}: use {SP_MODES}")
+    fn = ring_attention if mode == "ring" else ulysses_attention
+    return functools.partial(fn, axis_name=AXIS_SEQ)
+
+
+def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig, mode: str = "ring"):
     """-> ``fn(params, tokens) -> logits`` with the sequence axis sharded.
 
     Embedding, LayerNorm, and the MLP are position-local, so they run
@@ -110,7 +156,12 @@ def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig):
     the ``data`` mesh axis simultaneously.
     """
     seq_devices = mesh.shape[AXIS_SEQ]
-    attn_fn = functools.partial(ring_attention, axis_name=AXIS_SEQ)
+    attn_fn = _sp_attn_fn(mode)
+    if mode == "ulysses" and cfg.n_heads % seq_devices:
+        raise ValueError(
+            f"--sp-mode ulysses needs n_heads ({cfg.n_heads}) divisible "
+            f"by the seq axis ({seq_devices}); use ring or adjust heads"
+        )
 
     def device_fn(params, tokens):
         # tokens: (B_local, T_local) — this device's shard.
@@ -155,14 +206,14 @@ def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig):
     return forward
 
 
-def make_seq_parallel_lm_loss(mesh, cfg: TransformerConfig):
+def make_seq_parallel_lm_loss(mesh, cfg: TransformerConfig, mode: str = "ring"):
     """Next-token CE through the sequence-parallel forward.
 
     The shifted slice ``tokens[:, :-1]`` breaks seq-divisibility, so the
     loss masks position 0 instead: feed the full sequence, score
     predictions at positions ``0..T-2`` against targets ``1..T-1``.
     """
-    fwd = make_seq_parallel_lm_forward(mesh, cfg)
+    fwd = make_seq_parallel_lm_forward(mesh, cfg, mode)
 
     def loss_fn(params, tokens):
         logits = fwd(params, tokens)  # (B, T, V)

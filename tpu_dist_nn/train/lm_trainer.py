@@ -121,13 +121,18 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
-def make_seq_parallel_lm_train_step(mesh, cfg: TransformerConfig, optimizer):
-    """Sequence-parallel (ring attention) train step over the mesh's
-    ``seq`` axis; tokens arrive as full (inputs+target) rows — the sp
-    loss masks position 0 instead of slicing (ring_attention.py)."""
+def make_seq_parallel_lm_train_step(mesh, cfg: TransformerConfig, optimizer,
+                                    mode: str = "ring"):
+    """Sequence-parallel train step over the mesh's ``seq`` axis —
+    ``mode="ring"`` (K/V rotation, O(T/N) memory) or ``"ulysses"``
+    (head-scatter all_to_all, full local attention per head slice);
+    tokens arrive as full (inputs+target) rows — the sp loss masks
+    position 0 instead of slicing (ring_attention.py)."""
     from tpu_dist_nn.parallel.ring_attention import make_seq_parallel_lm_loss
 
-    return jax.jit(make_step_body(make_seq_parallel_lm_loss(mesh, cfg), optimizer))
+    return jax.jit(
+        make_step_body(make_seq_parallel_lm_loss(mesh, cfg, mode), optimizer)
+    )
 
 
 def make_moe_lm_train_step(cfg, optimizer, mesh=None, attn_fn=None):
