@@ -482,6 +482,18 @@ def cmd_lm(args) -> int:
                 f"{prompt_len}-byte prompt leaves {args.seq_len - prompt_len} "
                 f"positions within --seq-len {args.seq_len}"
             )
+        stp = args.sample_tensor_parallel
+        if stp > 1:
+            if stp > len(jax.devices()):
+                raise ValueError(
+                    f"--sample-tensor-parallel {stp} needs {stp} devices; "
+                    f"{len(jax.devices())} available"
+                )
+            if args.heads % stp or (4 * args.d_model) % stp:
+                raise ValueError(
+                    f"--sample-tensor-parallel {stp} must divide --heads "
+                    f"({args.heads}) and d_ff (4*--d-model = {4 * args.d_model})"
+                )
 
     _validate_checkpoint_flags(args)
     _validate_metrics_out(args)
@@ -759,17 +771,37 @@ def cmd_lm(args) -> int:
 
         prompt = encode(args.prompt)[None, :]
         n = args.sample_bytes  # validated to fit before training
-        # One compiled program for the whole prefill+decode loop —
-        # eager dispatch would pay a host->device round trip per op.
-        sample_fn = jax.jit(
-            lambda p, t, k: generate(
-                p, cfg, t, n, temperature=args.temperature,
-                top_k=args.top_k, top_p=args.top_p, key=k
+        if args.sample_tensor_parallel > 1:
+            # Megatron-sharded decode: heads + KV cache split over the
+            # model axis (the trained params shard on the fly).
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.parallel.tensor_parallel import tp_shard_blocks
+            from tpu_dist_nn.parallel.tp_generate import tp_generate
+
+            tp_mesh = build_mesh(MeshSpec(model=args.sample_tensor_parallel))
+            params_tp = dict(
+                params,
+                blocks=tp_shard_blocks(
+                    params["blocks"], cfg, args.sample_tensor_parallel
+                ),
             )
-        )
-        out = sample_fn(
-            params, jnp.asarray(prompt), jax.random.key(args.seed)
-        )
+            out = tp_generate(
+                tp_mesh, params_tp, cfg, jnp.asarray(prompt), n,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, key=jax.random.key(args.seed),
+            )
+        else:
+            # One compiled program for the whole prefill+decode loop —
+            # eager dispatch would pay a host->device round trip per op.
+            sample_fn = jax.jit(
+                lambda p, t, k: generate(
+                    p, cfg, t, n, temperature=args.temperature,
+                    top_k=args.top_k, top_p=args.top_p, key=k
+                )
+            )
+            out = sample_fn(
+                params, jnp.asarray(prompt), jax.random.key(args.seed)
+            )
         # Raw bytes decode UTF-8 with replacement, so the string may be
         # shorter than n bytes when multi-byte sequences collapse.
         report["sample"] = decode_text(np.asarray(out[0]))
@@ -1005,6 +1037,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="shard the sequence axis over N devices "
                         "for long-context training (see --sp-mode)")
+    p.add_argument("--sample-tensor-parallel", type=int, default=1,
+                   help="decode --sample-bytes with heads + KV cache "
+                        "Megatron-sharded over N devices")
     p.add_argument("--sp-mode", choices=["ring", "ulysses"], default="ring",
                    help="sequence-parallel decomposition: ring attention "
                         "(K/V rotation, O(T/N) memory) or ulysses "

@@ -126,22 +126,14 @@ def _truncate_logits(logits: jnp.ndarray, top_k: int | None,
     return logits
 
 
-def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
-             max_new_tokens: int, *, temperature: float = 0.0,
-             top_k: int | None = None, top_p: float | None = None,
-             key: jax.Array | None = None):
-    """Generate ``(B, max_new_tokens)`` continuations of ``prompt (B, T)``.
-
-    Greedy when ``temperature == 0`` (no key needed), else samples from
-    ``softmax(logits / temperature)`` using ``key``, optionally
-    restricted to the ``top_k`` highest-probability tokens and/or the
-    ``top_p`` nucleus. Total length ``T + max_new_tokens`` must fit
-    ``cfg.max_seq_len`` (positional table). jit-compatible: static
-    ``max_new_tokens``/``temperature``/``top_k``/``top_p``.
-    """
-    prompt = jnp.asarray(prompt, jnp.int32)
-    B, T = prompt.shape
-    total = T + max_new_tokens
+def validate_generate_args(cfg: TransformerConfig, prompt_len: int,
+                           max_new_tokens: int, temperature: float,
+                           top_k: int | None, top_p: float | None,
+                           key: jax.Array | None) -> jax.Array:
+    """The generation argument contract, shared by the single-chip and
+    tensor-parallel decode paths (so they cannot drift). Returns the key
+    to use (a dummy on the greedy path)."""
+    total = prompt_len + max_new_tokens
     if not cfg.causal:
         raise ValueError(
             "generation requires a causal model (decode_step always "
@@ -152,7 +144,7 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if total > cfg.max_seq_len:
         raise ValueError(
-            f"prompt {T} + new {max_new_tokens} exceeds max_seq_len "
+            f"prompt {prompt_len} + new {max_new_tokens} exceeds max_seq_len "
             f"{cfg.max_seq_len}"
         )
     if temperature < 0:
@@ -170,8 +162,28 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
             "top_k/top_p shape the sampling distribution; greedy "
             "decoding (temperature == 0) would silently ignore them"
         )
-    if key is None:
-        key = jax.random.key(0)  # unused on the greedy path
+    return key if key is not None else jax.random.key(0)
+
+
+def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: int | None = None, top_p: float | None = None,
+             key: jax.Array | None = None):
+    """Generate ``(B, max_new_tokens)`` continuations of ``prompt (B, T)``.
+
+    Greedy when ``temperature == 0`` (no key needed), else samples from
+    ``softmax(logits / temperature)`` using ``key``, optionally
+    restricted to the ``top_k`` highest-probability tokens and/or the
+    ``top_p`` nucleus. Total length ``T + max_new_tokens`` must fit
+    ``cfg.max_seq_len`` (positional table). jit-compatible: static
+    ``max_new_tokens``/``temperature``/``top_k``/``top_p``.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    total = T + max_new_tokens
+    key = validate_generate_args(
+        cfg, T, max_new_tokens, temperature, top_k, top_p, key
+    )
 
     # The last decode writes position T + N - 2; size the cache exactly.
     logits, cache = prefill(params, prompt, cfg, max_len=total - 1)

@@ -1,0 +1,162 @@
+"""Tensor-parallel autoregressive generation (Megatron-sharded decode).
+
+Single-chip generation (:mod:`tpu_dist_nn.models.generate`) holds the
+whole KV cache and every head on one device. Here decode runs over the
+``model`` mesh axis: each device owns ``H/N`` attention heads of every
+block — the same Megatron layout as training
+(:func:`tpu_dist_nn.parallel.tensor_parallel.tp_shard_blocks`), so a
+tensor-parallel-trained model decodes WITHOUT resharding — and its
+slice of the KV cache (``(L, B, max_len, H/N, Dh)``), which is the
+point: cache memory per chip drops by N, the usual decode bottleneck.
+Per block, per token, the two Megatron psums (attention output, MLP
+down) ride ICI; logits come out replicated, so every device samples the
+same next token from the same PRNG key with no extra broadcast.
+
+Batch shards over ``data`` simultaneously. The whole prefill + decode
+loop is ONE ``shard_map``-ed program (one compile, static shapes, scan
+over steps) — the decode loop never leaves the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.models.generate import _truncate_logits
+from tpu_dist_nn.models.transformer import TransformerConfig, layer_norm
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_MODEL
+from tpu_dist_nn.parallel.tensor_parallel import BLOCK_KEYS, TP_REPLICATED
+
+
+def tp_generate(mesh, params_tp: dict, cfg: TransformerConfig,
+                prompt, max_new_tokens: int, *, temperature: float = 0.0,
+                top_k: int | None = None, top_p: float | None = None,
+                key: jax.Array | None = None):
+    """Tensor-parallel :func:`tpu_dist_nn.models.generate.generate`.
+
+    ``params_tp["blocks"]`` in :func:`tp_shard_blocks` layout;
+    ``prompt (B, T)`` with ``B`` divisible by the data axis. Greedy
+    decode is bit-identical to the single-chip path (tested); sampling
+    uses the replicated logits + key, so all devices agree.
+    """
+    n = mesh.shape[AXIS_MODEL]
+    if cfg.n_heads % n:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by model axis {n}")
+    Hl, Dh = cfg.n_heads // n, cfg.head_dim
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    total = T + max_new_tokens
+    # Same argument contract as the single-chip generate — the one
+    # validator so the two paths cannot drift.
+    from tpu_dist_nn.models.generate import validate_generate_args
+
+    key = validate_generate_args(
+        cfg, T, max_new_tokens, temperature, top_k, top_p, key
+    )
+
+    max_len = total - 1  # last decode writes position T + N - 2
+    params_c = cfg.cast_params(params_tp)
+    embed_params = {k: v for k, v in params_c.items() if k != "blocks"}
+
+    def unembed_rep(ep, x):
+        x = layer_norm(x, ep["lnf_g"], ep["lnf_b"])
+        return x @ ep["tok_embed"].T
+
+    def sample(logits, k):
+        if temperature == 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _truncate_logits(logits, top_k, top_p)
+        return jax.random.categorical(k, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    def device_fn(ep, blocks_tp, prompt, key):
+        blocks = {
+            k: (v if k in TP_REPLICATED else v[0]) for k, v in blocks_tp.items()
+        }
+        # Each data shard holds DIFFERENT batch rows: fold the shard
+        # index into the key or every shard would draw identical noise
+        # (duplicated continuations at matching local indices). Model
+        # shards keep the same key — they must sample the same token.
+        key = jax.random.fold_in(key, lax.axis_index(AXIS_DATA))
+        Bl = prompt.shape[0]
+        x = ep["tok_embed"][prompt] + ep["pos_embed"][:T]
+
+        def pre_body(carry, block):
+            h = layer_norm(carry, block["ln1_g"], block["ln1_b"])
+            qkv = h @ block["w_qkv"] + block["b_qkv"]
+            q, k_, v_ = jnp.split(qkv.reshape(Bl, T, 3 * Hl, Dh), 3, axis=2)
+            from tpu_dist_nn.models.transformer import dot_product_attention
+
+            o = dot_product_attention(q, k_, v_, causal=True)
+            attn = lax.psum(
+                o.reshape(Bl, T, Hl * Dh) @ block["w_o"], AXIS_MODEL
+            ) + block["b_o"]
+            y = carry + attn
+            h2 = layer_norm(y, block["ln2_g"], block["ln2_b"])
+            up = jax.nn.gelu(h2 @ block["w_up"] + block["b_up"])
+            y = y + lax.psum(up @ block["w_down"], AXIS_MODEL) + block["b_down"]
+            return y, (k_, v_)
+
+        x, (ks, vs) = lax.scan(pre_body, x, blocks)
+        pad = [(0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0)]
+        cache_k, cache_v = jnp.pad(ks, pad), jnp.pad(vs, pad)
+        logits_last = unembed_rep(ep, x[:, T - 1:T])[:, 0]
+
+        first = sample(logits_last, key)
+        if max_new_tokens == 1:
+            return first[:, None]
+
+        def dec_body(carry, step_key):
+            cache_k, cache_v, token, pos = carry
+            xt = ep["tok_embed"][token][:, None, :] + ep["pos_embed"][pos][None, None, :]
+
+            def blk(carry2, inputs):
+                xx = carry2
+                block, kc, vc = inputs
+                h = layer_norm(xx, block["ln1_g"], block["ln1_b"])
+                qkv = h @ block["w_qkv"] + block["b_qkv"]
+                q, k_, v_ = jnp.split(qkv.reshape(Bl, 1, 3 * Hl, Dh), 3, axis=2)
+                kc = lax.dynamic_update_slice(kc, k_, (0, pos, 0, 0))
+                vc = lax.dynamic_update_slice(vc, v_, (0, pos, 0, 0))
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    kc.astype(jnp.float32),
+                ) / np.sqrt(Dh)
+                live = jnp.arange(max_len) <= pos
+                scores = jnp.where(live[None, None, None, :], scores, -jnp.inf)
+                probs = jax.nn.softmax(scores, axis=-1).astype(xx.dtype)
+                o = jnp.einsum("bhqk,bkhd->bqhd", probs, vc).reshape(Bl, 1, Hl * Dh)
+                attn = lax.psum(o @ block["w_o"], AXIS_MODEL) + block["b_o"]
+                xx = xx + attn
+                h2 = layer_norm(xx, block["ln2_g"], block["ln2_b"])
+                up = jax.nn.gelu(h2 @ block["w_up"] + block["b_up"])
+                xx = xx + lax.psum(up @ block["w_down"], AXIS_MODEL) + block["b_down"]
+                return xx, (kc, vc)
+
+            xt, (cache_k, cache_v) = lax.scan(
+                blk, xt, (blocks, cache_k, cache_v)
+            )
+            logits = unembed_rep(ep, xt)[:, 0]
+            nxt = sample(logits, step_key)
+            return (cache_k, cache_v, nxt, pos + 1), nxt
+
+        keys = jax.random.split(jax.random.fold_in(key, 1), max_new_tokens - 1)
+        (_, _, _, _), rest = lax.scan(
+            dec_body, (cache_k, cache_v, first, jnp.int32(T)), keys
+        )
+        return jnp.concatenate([first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1)
+
+    blocks_specs = {
+        k: (P() if k in TP_REPLICATED else P(AXIS_MODEL)) for k in BLOCK_KEYS
+    }
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), blocks_specs, P(AXIS_DATA), P()),
+        out_specs=P(AXIS_DATA),
+    )
+    return fn(embed_params, params_c["blocks"], prompt, key)
