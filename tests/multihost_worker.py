@@ -170,6 +170,44 @@ def scenario_step_parity() -> dict:
     return {"loss": float(loss), "w_digest": float(np.abs(wn).sum())}
 
 
+def scenario_train_lm_zero1(make_name: str = "make_zero_lm_train_step") -> dict:
+    """ZeRO-1 / FSDP data-parallel LM training across processes with the
+    global-batch feed: identical loss streams on both hosts."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    import jax
+    import optax
+
+    from tpu_dist_nn.data.feed import global_batch, shard_for_host
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel.mesh import AXIS_DATA, MeshSpec, build_mesh
+    from tpu_dist_nn.parallel import zero
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+
+    mesh = build_mesh(MeshSpec(data=8))
+    cfg = TransformerConfig(
+        vocab_size=29, d_model=16, n_heads=2, n_layers=2, d_ff=32, max_seq_len=12
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(2)
+    rows = rng.integers(0, cfg.vocab_size, (64, 13)).astype(np.int32)
+    local = shard_for_host(rows)
+    optimizer = optax.adam(1e-3)
+    step = getattr(zero, make_name)(mesh, cfg, optimizer, params)
+    opt_state = step.init_opt_state(params)
+    losses = []
+    for i in range(3):
+        batch = global_batch(mesh, P(AXIS_DATA, None), local[i * 8:(i + 1) * 8])
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(round(float(loss), 6))
+    tok = to_host_numpy(params["tok_embed"])
+    return {"losses": losses, "tok_digest": float(np.abs(np.asarray(tok)).sum())}
+
+
+def scenario_train_lm_fsdp() -> dict:
+    return scenario_train_lm_zero1("make_fsdp_lm_train_step")
+
+
 def scenario_checkpoint_resume() -> dict:
     """Multi-host checkpoint round trip with NON-shared filesystems:
     only process 0's directory receives files (save_pytree gathers on

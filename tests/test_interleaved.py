@@ -88,3 +88,83 @@ def test_interleaved_lm_grads_match_single_chip(S, v, M, remat):
             np.asarray(leaf_i), np.asarray(leaf_r), rtol=5e-4, atol=1e-6,
             err_msg=str(path_r),
         )
+
+
+def test_interleaved_dense_chain_matches_gpipe():
+    """Dense padded-chain chunks on the table executor: loss/grads match
+    the GPipe-AD path run over the same V-chunk pipeline on V devices'
+    worth of stages collapsed to S devices x v virtual."""
+    import optax
+
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.parallel.one_f_one_b import compiled_interleaved_dense_grad
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params, compiled_pipeline
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.train.pipeline_trainer import (
+        make_pipeline_train_step,
+        prepare_pipeline_batch,
+    )
+
+    S, v, M, data = 2, 2, 4, 2
+    dims = [12, 10, 8, 6, 4]
+    model = random_model(dims, seed=2)
+    params = build_pipeline_params(partition_model(model, [1, 1, 1, 1]))  # V=4 chunks
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(32, dims[0])).astype(np.float32)
+    y = rng.integers(0, dims[-1], size=32)
+
+    # Reference: GPipe-AD over a 4-stage mesh (the same 4 chunks, one per device).
+    mesh_v = build_mesh(MeshSpec(stage=4, data=2))
+    xs, labels, mask = prepare_pipeline_batch(params.meta, x, y, M, 2)
+    apply = compiled_pipeline(mesh_v, params.meta, M, True, jnp.float32)
+
+    def loss_fn(w):
+        logits = apply(w, jnp.asarray(xs))
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels.reshape(-1)[:, None], axis=-1)[:, 0]
+        return -(ll * mask.reshape(-1)).sum() / mask.sum()
+
+    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(params.weights)
+
+    # Interleaved: same 4 chunks on 2 devices x 2 virtual.
+    mesh_s = build_mesh(MeshSpec(stage=S, data=data))
+    run = compiled_interleaved_dense_grad(mesh_s, params.meta, v, M, jnp.float32)
+    loss_il, grads_il = run(
+        params.weights, jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask)
+    )
+
+    np.testing.assert_allclose(float(loss_il), float(loss_ref), rtol=1e-5)
+    w_mask, b_mask = params.meta.grad_masks()
+    np.testing.assert_allclose(
+        np.asarray(grads_il.w) * w_mask, np.asarray(grads_ref.w) * w_mask,
+        rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(grads_il.b) * b_mask, np.asarray(grads_ref.b) * b_mask,
+        rtol=1e-4, atol=1e-6,
+    )
+
+    # Full optimizer step through make_pipeline_train_step.
+    opt = optax.adam(1e-3)
+    step = make_pipeline_train_step(
+        mesh_s, params.meta, M, opt, schedule="interleaved", num_virtual=v
+    )
+    w2, _, loss2 = step(
+        params.weights, opt.init(params.weights),
+        jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(float(loss2), float(loss_ref), rtol=1e-5)
+
+
+def test_interleaved_dense_chunk_count_mismatch():
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.parallel.one_f_one_b import compiled_interleaved_dense_grad
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params
+    from tpu_dist_nn.testing.factories import random_model
+
+    params = build_pipeline_params(
+        partition_model(random_model([8, 6, 4], seed=0), [1, 1])
+    )
+    mesh = build_mesh(MeshSpec(stage=2, data=2))
+    with pytest.raises(ValueError, match="distribution"):
+        compiled_interleaved_dense_grad(mesh, params.meta, 2, 4, jnp.float32)

@@ -107,6 +107,14 @@ def test_two_process_lm_pipeline_in_sync():
     assert r0["losses"][-1] < r0["losses"][0]
 
 
+@pytest.mark.parametrize("scenario", ["train_lm_zero1", "train_lm_fsdp"])
+def test_two_process_zero_fsdp_in_sync(scenario):
+    r0, r1 = _run_pair(scenario)
+    assert r0["losses"] == r1["losses"], (r0, r1)
+    assert r0["tok_digest"] == pytest.approx(r1["tok_digest"], rel=1e-6)
+    assert all(np.isfinite(r0["losses"])) and r0["losses"][-1] < r0["losses"][0]
+
+
 def test_two_process_checkpoint_resume_without_shared_fs():
     r0, r1 = _run_pair("checkpoint_resume")
     assert r0["n_files"] == 1 and r1["n_files"] == 0  # process 0 writes alone

@@ -429,8 +429,12 @@ class Engine:
         validate_schedule(schedule)
         if schedule == "interleaved":
             raise ValueError(
-                "schedule='interleaved' applies to the transformer LM "
-                "pipeline (tdn lm); dense engines support 'gpipe' and '1f1b'"
+                "schedule='interleaved' is not available through the engine: "
+                "its placement serves inference on a chunk-per-device mesh, "
+                "while virtual stages need a smaller device mesh. Use "
+                "tdn lm --schedule interleaved (LM family, end to end) or "
+                "make_pipeline_train_step(..., schedule='interleaved', "
+                "num_virtual=v) for dense chains at the trainer level."
             )
         # The heterogeneous executor sets pipelined=True but trains via
         # the single-program trainer, so it must reject 1f1b too.

@@ -147,6 +147,12 @@ def _write_metrics_jsonl(path, records) -> None:
     log.info("wrote %d metric records to %s", len(records), path)
 
 
+def _jax_process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
 def _parse_distribution(text):
     if text is None:
         return None
@@ -484,6 +490,13 @@ def cmd_lm(args) -> int:
             )
         stp = args.sample_tensor_parallel
         if stp > 1:
+            if _jax_process_count() > 1:
+                raise ValueError(
+                    "--sample-tensor-parallel is single-host only: its "
+                    "decode mesh takes the first N devices, which live on "
+                    "process 0 in a multi-host job; drop the flag (the "
+                    "single-chip decode runs replicated per host)"
+                )
             if stp > len(jax.devices()):
                 raise ValueError(
                     f"--sample-tensor-parallel {stp} needs {stp} devices; "

@@ -269,6 +269,29 @@ def make_1f1b(
     )
 
 
+def _dense_stage_fn(sp, st, x):
+    """The padded dense-chain chunk compute, shared by every hand-rolled
+    schedule (1F1B and interleaved) so the numerics cannot drift."""
+    return _stage_apply(sp["w"], sp["b"], st["act"], st["width"], x)
+
+
+def _dense_masked_ce_tail(final_dim: int):
+    """Masked softmax-CE over the first ``final_dim`` columns; padding
+    columns are excluded from the normalizer with -inf (matching
+    pipeline._masked_activation's softmax semantics). The mask must
+    arrive pre-scaled by the global normalizer."""
+
+    def tail_fn(_tail_params, logits, lbl, msk_scaled):
+        col = lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logp = jax.nn.log_softmax(
+            jnp.where(col < final_dim, logits, -jnp.inf), axis=-1
+        )
+        ll = jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
+        return -(ll * msk_scaled).sum()
+
+    return tail_fn
+
+
 @functools.lru_cache(maxsize=64)
 def compiled_1f1b_grad(mesh, meta: PipelineMeta, num_microbatches: int, dtype):
     """Build + jit the 1F1B loss-and-grad executor for the dense chain.
@@ -278,21 +301,8 @@ def compiled_1f1b_grad(mesh, meta: PipelineMeta, num_microbatches: int, dtype):
     ``loss_fn`` — masked mean CE over real rows — so the two schedules
     are drop-in interchangeable (and tested for numerical parity).
     """
-    final_dim = meta.final_dim
-
-    def stage_fn(sp, st, x):
-        return _stage_apply(sp["w"], sp["b"], st["act"], st["width"], x)
-
-    def tail_fn(_tail_params, logits, lbl, msk_scaled):
-        # Masked softmax-CE over the first final_dim columns; padding
-        # columns are excluded from the normalizer with -inf (matching
-        # pipeline._masked_activation's softmax semantics).
-        col = lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logp = jax.nn.log_softmax(
-            jnp.where(col < final_dim, logits, -jnp.inf), axis=-1
-        )
-        ll = jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
-        return -(ll * msk_scaled).sum()
+    stage_fn = _dense_stage_fn
+    tail_fn = _dense_masked_ce_tail(meta.final_dim)
 
     mapped = make_1f1b(
         mesh,
@@ -319,5 +329,59 @@ def compiled_1f1b_grad(mesh, meta: PipelineMeta, num_microbatches: int, dtype):
         st = {"act": act, "width": width}
         loss, g_sp, _g_tail, _dx0 = mapped(xs, sp, st, {}, (labels, mask))
         return loss, PipelineWeights(w=g_sp["w"], b=g_sp["b"])
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_interleaved_dense_grad(mesh, meta: PipelineMeta, num_virtual: int,
+                                    num_microbatches: int, dtype):
+    """Interleaved (virtual-stage) loss-and-grad for the dense chain.
+
+    ``meta`` must describe ``S * num_virtual`` pipeline chunks (build the
+    params with a distribution of that length); chunk ``c`` runs on
+    device ``c % S``, so the padded weight blocks regroup
+    ``(V, L, D, D) -> (S, v, L, D, D)``. Same numerical contract as the
+    other schedules (masked mean CE; parity-tested).
+    """
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
+
+    S = mesh.shape[AXIS_STAGE]
+    v = num_virtual
+    V = meta.num_stages
+    if V != S * v:
+        raise ValueError(
+            f"meta has {V} chunks but mesh stage axis {S} x virtual {v} "
+            f"= {S * v}; build the pipeline params with a {S * v}-entry "
+            "distribution"
+        )
+    stage_fn = _dense_stage_fn
+    tail_fn = _dense_masked_ce_tail(meta.final_dim)
+
+    mapped = make_interleaved_1f1b(
+        mesh, stage_fn, tail_fn, v, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None),
+        aux_spec=P(None, AXIS_DATA),
+        want_dx0=False,
+    )
+
+    def regroup(a):  # (V, ...) -> (S, v, ...): chunk c at [c % S, c // S]
+        return jnp.swapaxes(a.reshape(v, S, *a.shape[1:]), 0, 1)
+
+    def ungroup(a):  # inverse
+        return jnp.swapaxes(a, 0, 1).reshape(V, *a.shape[2:])
+
+    act = jnp.asarray(meta.act_array(logits=True))
+    width = jnp.asarray(meta.width_array())
+    st = {"act": regroup(act), "width": regroup(width)}
+
+    @jax.jit
+    def run(weights: PipelineWeights, xs, labels, mask):
+        mask = mask.astype(dtype)
+        mask = mask / mask.sum()
+        sp = {"w": regroup(weights.w), "b": regroup(weights.b)}
+        loss, g_sp, _g_tail, _dx0 = mapped(xs, sp, st, {}, (labels, mask))
+        return loss, PipelineWeights(w=ungroup(g_sp["w"]), b=ungroup(g_sp["b"]))
 
     return run

@@ -25,8 +25,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpu_dist_nn.models.generate import _truncate_logits
-from tpu_dist_nn.models.transformer import TransformerConfig, layer_norm
+from tpu_dist_nn.models.generate import _truncate_logits, validate_generate_args
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    dot_product_attention,
+    layer_norm,
+)
 from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_MODEL
 from tpu_dist_nn.parallel.tensor_parallel import BLOCK_KEYS, TP_REPLICATED
 
@@ -51,8 +55,6 @@ def tp_generate(mesh, params_tp: dict, cfg: TransformerConfig,
     total = T + max_new_tokens
     # Same argument contract as the single-chip generate — the one
     # validator so the two paths cannot drift.
-    from tpu_dist_nn.models.generate import validate_generate_args
-
     key = validate_generate_args(
         cfg, T, max_new_tokens, temperature, top_k, top_p, key
     )
@@ -89,8 +91,6 @@ def tp_generate(mesh, params_tp: dict, cfg: TransformerConfig,
             h = layer_norm(carry, block["ln1_g"], block["ln1_b"])
             qkv = h @ block["w_qkv"] + block["b_qkv"]
             q, k_, v_ = jnp.split(qkv.reshape(Bl, T, 3 * Hl, Dh), 3, axis=2)
-            from tpu_dist_nn.models.transformer import dot_product_attention
-
             o = dot_product_attention(q, k_, v_, causal=True)
             attn = lax.psum(
                 o.reshape(Bl, T, Hl * Dh) @ block["w_o"], AXIS_MODEL

@@ -64,6 +64,7 @@ def make_pipeline_train_step(
     optimizer,
     dtype=jnp.float32,
     schedule: str = "gpipe",
+    num_virtual: int = 1,
 ):
     """Build the jitted pipelined train step.
 
@@ -83,17 +84,24 @@ def make_pipeline_train_step(
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
-    if schedule == "interleaved":
+    if num_virtual > 1 and schedule != "interleaved":
         raise ValueError(
-            "schedule='interleaved' (virtual stages) is implemented for the "
-            "transformer LM pipeline (tdn lm --schedule interleaved); the "
-            "dense chain supports 'gpipe' and '1f1b'"
+            f"num_virtual={num_virtual} only applies to "
+            "schedule='interleaved' (it would be silently ignored)"
         )
     w_mask_np, b_mask_np = meta.grad_masks()
     w_mask = jnp.asarray(w_mask_np, dtype)
     b_mask = jnp.asarray(b_mask_np, dtype)
 
-    if schedule == "1f1b":
+    if schedule == "interleaved":
+        from tpu_dist_nn.parallel.one_f_one_b import (
+            compiled_interleaved_dense_grad,
+        )
+
+        grad_fn = compiled_interleaved_dense_grad(
+            mesh, meta, num_virtual, num_microbatches, dtype
+        )
+    elif schedule == "1f1b":
         from tpu_dist_nn.parallel.one_f_one_b import compiled_1f1b_grad
 
         grad_fn = compiled_1f1b_grad(mesh, meta, num_microbatches, dtype)
