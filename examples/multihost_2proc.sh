@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Real multi-host training on one box: two JAX processes, one model.
+#
+# The moral equivalent of the reference spawning N containers on a
+# bridge network (run_grpc_fcnn.py:83-155): each process owns half the
+# (virtual) devices, batches assemble across processes per step, and
+# both hosts stay bit-identical (same losses, same exported JSON —
+# compare the two output files to see it).
+#
+# On real TPU pods, drop JAX_PLATFORMS/XLA_FLAGS and give every host
+# the same --coordinator; everything else is unchanged.
+set -euo pipefail
+PORT=${PORT:-29900}
+COMMON=(--coordinator "localhost:$PORT" --num-hosts 2
+        --layers 20,16,6 --data synthetic --num-examples 1280 --epochs 2
+        --batch-size 128 --distribution 1,1 --data-parallel 4 --lr 1e-2)
+run() {
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+  python -m tpu_dist_nn.cli train "${COMMON[@]}" \
+    --host-id "$1" --out "/tmp/tdn_mh_model_$1.json"
+}
+run 0 & run 1 & wait
+cmp /tmp/tdn_mh_model_0.json /tmp/tdn_mh_model_1.json \
+  && echo "hosts exported identical models"

@@ -198,3 +198,16 @@ def test_server_survives_concurrent_clients(served_engine):
     with ThreadPoolExecutor(max_workers=8) as pool:
         for out in pool.map(one, range(16)):
             np.testing.assert_allclose(out, expect, rtol=1e-6, atol=1e-9)
+
+
+def test_codec_rejects_truncated_length_fields():
+    """A length-delimited field claiming more bytes than remain must
+    raise (real protobuf parsers reject truncated messages; silently
+    decoding a short row would compute on corrupt data)."""
+    x = np.arange(6.0).reshape(1, 6)
+    full = encode_matrix(x)
+    # Cut INSIDE the payload but on an 8-byte boundary: lengths still
+    # claim 6 doubles, only 4 remain.
+    cut = full[: len(full) - 16]
+    with pytest.raises(ValueError, match="truncated"):
+        decode_matrix(cut)

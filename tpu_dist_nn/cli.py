@@ -217,6 +217,12 @@ def cmd_up(args) -> int:
     if args.probe_latency:
         print(json.dumps({"step_latency": engine.step_latency()}))
     if args.grpc_port is not None:
+        if _jax_process_count() > 1:
+            raise ValueError(
+                "--grpc-port is single-host only: an RPC landing on one "
+                "host would dispatch collectives the other hosts never "
+                "join (deadlock); serve from a single-process engine"
+            )
         from tpu_dist_nn.serving import serve_engine
 
         server, bound = serve_engine(engine, args.grpc_port)
@@ -245,6 +251,21 @@ def cmd_infer(args) -> int:
         # (run_grpc_inference.py:27: 127.0.0.1:5101).
         args.target = f"127.0.0.1:{args.port}"
     if getattr(args, "target", None):
+        ignored = [
+            name for name, bad in (
+                ("--config", args.config is not None),
+                ("--quantize", args.quantize is not None),
+                ("--profile-dir", args.profile_dir is not None),
+                ("--distribution", args.distribution is not None),
+                ("--data-parallel", args.data_parallel != 1),
+            ) if bad
+        ]
+        if ignored:
+            raise ValueError(
+                f"{', '.join(ignored)} configure a LOCAL engine and have no "
+                "effect in --target client mode; start the server with them "
+                "instead (tdn up --grpc-port ...)"
+            )
         return _infer_over_grpc(args)
     if not args.config:
         raise ValueError("tdn infer requires --config (or --target for "
@@ -467,6 +488,12 @@ def cmd_lm(args) -> int:
                          "(MoE pipelines are not implemented)")
     if not moe and args.expert_parallel > 1:
         raise ValueError("--expert-parallel requires --experts > 0")
+    if args.sample_tensor_parallel > 1 and args.sample_bytes <= 0:
+        raise ValueError(
+            "--sample-tensor-parallel requires --sample-bytes > 0 "
+            "(it shards the decode; without sampling it would be "
+            "silently ignored)"
+        )
     if args.sample_bytes > 0:
         # Validate the whole sampling request BEFORE training so a bad
         # flag combination can't discard a long run.

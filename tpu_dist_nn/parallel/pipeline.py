@@ -280,22 +280,26 @@ def pipeline_forward(
     nproc = jax.process_count()
     if nproc > 1:
         # Multi-host: every process computed the same padded global xs
-        # (inference/eval inputs are replicated host-side); each feeds
-        # its slice of the batch axis into one globally-sharded array.
+        # (inference/eval inputs are replicated host-side). When the
+        # data axis spans the hosts, each feeds its slice of the batch
+        # into one globally-sharded array; otherwise (e.g. a pure
+        # cross-host pipeline with data=1) every host feeds the
+        # identical full batch — replicated rows, parallelism on the
+        # stage axis.
         from jax.sharding import PartitionSpec as _P
 
         from tpu_dist_nn.data.feed import global_batch
 
+        data_size = mesh.shape[AXIS_DATA]
         bsz = xs.shape[1]
-        if bsz % nproc:
-            raise ValueError(
-                f"padded microbatch rows ({bsz}) not divisible by "
-                f"{nproc} processes; pick num_microbatches/batch so "
-                f"rows split evenly across hosts"
+        if data_size % nproc == 0 and bsz % nproc == 0:
+            p = jax.process_index()
+            local = xs[:, p * (bsz // nproc):(p + 1) * (bsz // nproc), :]
+            xs = global_batch(mesh, _P(None, AXIS_DATA, None), local)
+        else:
+            xs = global_batch(
+                mesh, _P(None, AXIS_DATA, None), xs, assume_replicated=True
             )
-        p = jax.process_index()
-        local = xs[:, p * (bsz // nproc):(p + 1) * (bsz // nproc), :]
-        xs = global_batch(mesh, _P(None, AXIS_DATA, None), local)
     run = compiled_pipeline(mesh, meta, num_microbatches, logits, weights.w.dtype)
     out = run(weights, xs)
     return out[:n]

@@ -109,26 +109,11 @@ def make_pipeline_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
     return loss_fn
 
 
-def make_pipeline_lm_1f1b_grad(mesh, cfg: TransformerConfig, num_stages: int,
-                               num_microbatches: int,
-                               attn_fn=dot_product_attention):
-    """-> ``f(params, tokens) -> (loss, grads)`` via the 1F1B schedule.
-
-    Same semantics as ``jax.value_and_grad`` of
-    :func:`make_pipeline_lm_loss` (tested for parity), but scheduled
-    one-forward-one-backward with activation recompute
-    (:func:`tpu_dist_nn.parallel.one_f_one_b.make_1f1b`): per-stage live
-    activation memory is O(num_stages) microbatch inputs, independent of
-    the microbatch count. Embedding runs data-parallel before the
-    schedule and its backward is driven by the schedule's per-microbatch
-    input cotangents; the tied LM head + final LN ride the schedule's
-    tail on the last stage, so head grads for the shared ``tok_embed``
-    table are summed with the embed-side grads here.
-
-    ``params["blocks"]`` must be regrouped by :func:`shard_blocks`.
-    """
-    from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
-
+def _lm_sched_stage_and_tail(mesh, cfg: TransformerConfig,
+                             num_microbatches: int, attn_fn):
+    """Chunk compute + per-microbatch tail shared by the 1F1B and
+    interleaved LM executors — one definition so the schedules cannot
+    drift numerically."""
     apply = maybe_remat(cfg)
     M = num_microbatches
     data_size = mesh.shape[AXIS_DATA]
@@ -147,11 +132,16 @@ def make_pipeline_lm_1f1b_grad(mesh, cfg: TransformerConfig, num_stages: int,
         # per-shard mean divided by (M * data).
         return next_token_ce(unembed(tail_params, y), targets_f) / (M * data_size)
 
-    mapped = make_1f1b(
-        mesh, stage_fn, tail_fn, num_stages, num_microbatches,
-        microbatch_spec=P(AXIS_DATA, None, None),
-        aux_spec=P(None, AXIS_DATA, None),
-    )
+    return stage_fn, tail_fn
+
+
+def _lm_vag_from_mapped(mapped, cfg: TransformerConfig, num_microbatches: int):
+    """Wrap a scheduled executor (1F1B or interleaved) into the standard
+    ``(params, tokens) -> (loss, grads)``: embedding runs data-parallel
+    before the schedule and backprops from the executor's per-microbatch
+    input cotangents; the tied LM head + final LN ride the tail, so
+    head-side tok_embed grads are summed with the embed-side ones."""
+    M = num_microbatches
 
     def value_and_grad_fn(params, tokens):
         params_c = cfg.cast_params(params)
@@ -185,6 +175,37 @@ def make_pipeline_lm_1f1b_grad(mesh, cfg: TransformerConfig, num_stages: int,
         return loss, grads
 
     return value_and_grad_fn
+
+
+def make_pipeline_lm_1f1b_grad(mesh, cfg: TransformerConfig, num_stages: int,
+                               num_microbatches: int,
+                               attn_fn=dot_product_attention):
+    """-> ``f(params, tokens) -> (loss, grads)`` via the 1F1B schedule.
+
+    Same semantics as ``jax.value_and_grad`` of
+    :func:`make_pipeline_lm_loss` (tested for parity), but scheduled
+    one-forward-one-backward with activation recompute
+    (:func:`tpu_dist_nn.parallel.one_f_one_b.make_1f1b`): per-stage live
+    activation memory is O(num_stages) microbatch inputs, independent of
+    the microbatch count. Embedding runs data-parallel before the
+    schedule and its backward is driven by the schedule's per-microbatch
+    input cotangents; the tied LM head + final LN ride the schedule's
+    tail on the last stage, so head grads for the shared ``tok_embed``
+    table are summed with the embed-side grads here.
+
+    ``params["blocks"]`` must be regrouped by :func:`shard_blocks`.
+    """
+    from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
+
+    stage_fn, tail_fn = _lm_sched_stage_and_tail(
+        mesh, cfg, num_microbatches, attn_fn
+    )
+    mapped = make_1f1b(
+        mesh, stage_fn, tail_fn, num_stages, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None, None),
+        aux_spec=P(None, AXIS_DATA, None),
+    )
+    return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
 
 
 def shard_blocks_interleaved(blocks: dict, num_stages: int, num_virtual: int) -> dict:
@@ -231,56 +252,15 @@ def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
     """
     from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
 
-    apply = maybe_remat(cfg)
-    M = num_microbatches
-    data_size = mesh.shape[AXIS_DATA]
-
-    def stage_fn(chunk_blocks, _static, x):
-        def body(carry, block):
-            return apply(block, carry, cfg, attn_fn), None
-
-        y, _ = lax.scan(body, x, chunk_blocks)
-        return y
-
-    def tail_fn(tail_params, y, targets_f):
-        return next_token_ce(unembed(tail_params, y), targets_f) / (M * data_size)
-
+    stage_fn, tail_fn = _lm_sched_stage_and_tail(
+        mesh, cfg, num_microbatches, attn_fn
+    )
     mapped = make_interleaved_1f1b(
         mesh, stage_fn, tail_fn, num_virtual, num_microbatches,
         microbatch_spec=P(AXIS_DATA, None, None),
         aux_spec=P(None, AXIS_DATA, None),
     )
-
-    def value_and_grad_fn(params, tokens):
-        params_c = cfg.cast_params(params)
-        inp, tgt = tokens[:, :-1], tokens[:, 1:]
-        B, T = inp.shape
-        if B % M:
-            raise ValueError(f"batch {B} not divisible by microbatches {M}")
-        embed_params = {
-            "tok_embed": params_c["tok_embed"], "pos_embed": params_c["pos_embed"]
-        }
-        x, embed_vjp = jax.vjp(lambda p: embed(p, inp), embed_params)
-        xs = x.reshape(M, B // M, T, cfg.d_model)
-        targets = tgt.reshape(M, B // M, T)
-        tail_params = {
-            "tok_embed": params_c["tok_embed"],
-            "lnf_g": params_c["lnf_g"], "lnf_b": params_c["lnf_b"],
-        }
-        loss, g_blocks, g_tail, dx0 = mapped(
-            xs, params_c["blocks"], {}, tail_params, (targets,)
-        )
-        (d_embed,) = embed_vjp(dx0.reshape(B, T, cfg.d_model))
-        grads = {
-            "tok_embed": g_tail["tok_embed"] + d_embed["tok_embed"],
-            "pos_embed": d_embed["pos_embed"],
-            "blocks": g_blocks,
-            "lnf_g": g_tail["lnf_g"], "lnf_b": g_tail["lnf_b"],
-        }
-        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
-        return loss, grads
-
-    return value_and_grad_fn
+    return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
 
 
 # ---------------------------------------------------------------------------

@@ -76,17 +76,27 @@ def encode_matrix(x: np.ndarray) -> bytes:
     return b"".join(parts)
 
 
+def _bounded(buf: memoryview, pos: int, need: int) -> int:
+    """Advance past ``need`` bytes, rejecting overruns — a truncated
+    length-delimited field must raise like real protobuf parsers do,
+    not silently decode a short slice."""
+    end = pos + need
+    if end > len(buf):
+        raise ValueError("truncated message")
+    return end
+
+
 def _skip_field(buf: memoryview, pos: int, wire_type: int) -> int:
     if wire_type == _WT_VARINT:
         _, pos = _read_varint(buf, pos)
         return pos
     if wire_type == _WT_FIXED64:
-        return pos + 8
+        return _bounded(buf, pos, 8)
     if wire_type == _WT_LEN:
         ln, pos = _read_varint(buf, pos)
-        return pos + ln
+        return _bounded(buf, pos, ln)
     if wire_type == _WT_FIXED32:
-        return pos + 4
+        return _bounded(buf, pos, 4)
     raise ValueError(f"unsupported wire type {wire_type}")
 
 
@@ -98,13 +108,15 @@ def _decode_row(buf: memoryview) -> np.ndarray:
         field, wt = key >> 3, key & 7
         if field == 1 and wt == _WT_LEN:        # packed doubles
             ln, pos = _read_varint(buf, pos)
+            end = _bounded(buf, pos, ln)
             if ln % 8:
                 raise ValueError("packed double payload not a multiple of 8")
-            values.append(np.frombuffer(buf[pos:pos + ln], dtype="<f8"))
-            pos += ln
+            values.append(np.frombuffer(buf[pos:end], dtype="<f8"))
+            pos = end
         elif field == 1 and wt == _WT_FIXED64:  # unpacked double
-            values.append(np.frombuffer(buf[pos:pos + 8], dtype="<f8"))
-            pos += 8
+            end = _bounded(buf, pos, 8)
+            values.append(np.frombuffer(buf[pos:end], dtype="<f8"))
+            pos = end
         else:
             pos = _skip_field(buf, pos, wt)
     if not values:
@@ -124,8 +136,9 @@ def decode_matrix(data: bytes) -> np.ndarray:
         field, wt = key >> 3, key & 7
         if field == 1 and wt == _WT_LEN:
             ln, pos = _read_varint(buf, pos)
-            rows.append(_decode_row(buf[pos:pos + ln]))
-            pos += ln
+            end = _bounded(buf, pos, ln)
+            rows.append(_decode_row(buf[pos:end]))
+            pos = end
         else:
             pos = _skip_field(buf, pos, wt)
     if not rows:
