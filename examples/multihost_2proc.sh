@@ -19,6 +19,9 @@ run() {
   python -m tpu_dist_nn.cli train "${COMMON[@]}" \
     --host-id "$1" --out "/tmp/tdn_mh_model_$1.json"
 }
-run 0 & run 1 & wait
+rm -f /tmp/tdn_mh_model_0.json /tmp/tdn_mh_model_1.json
+run 0 & PID0=$!
+run 1 & PID1=$!
+wait "$PID0"; wait "$PID1"   # propagate either child's failure
 cmp /tmp/tdn_mh_model_0.json /tmp/tdn_mh_model_1.json \
   && echo "hosts exported identical models"

@@ -208,6 +208,31 @@ def scenario_train_lm_fsdp() -> dict:
     return scenario_train_lm_zero1("make_fsdp_lm_train_step")
 
 
+def scenario_pipeline_infer_crosshost() -> dict:
+    """Pure cross-host pipeline inference: 8 stages spanning 2 processes
+    (4 each), data axis 1 — batches replicate across hosts and the
+    hand-off rides the inter-process links; outputs must be identical
+    on every host."""
+    import numpy as np
+
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params, pipeline_forward
+    from tpu_dist_nn.testing.factories import random_model
+
+    mesh = build_mesh(MeshSpec(stage=8, data=1))
+    model = random_model([20, 18, 16, 14, 12, 10, 8, 7, 6], seed=11)
+    params = build_pipeline_params(partition_model(model, [1] * 8))
+    rng = np.random.default_rng(4)
+    x = rng.uniform(0, 1, (12, 20)).astype(np.float32)
+    out = to_host_numpy(pipeline_forward(mesh, params, x, num_microbatches=2))
+    return {
+        "digest": float(np.abs(out).sum()),
+        "row0": [round(float(v), 8) for v in out[0]],
+    }
+
+
 def scenario_checkpoint_resume() -> dict:
     """Multi-host checkpoint round trip with NON-shared filesystems:
     only process 0's directory receives files (save_pytree gathers on

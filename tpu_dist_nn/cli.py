@@ -205,6 +205,14 @@ def _serve_loop(engine, max_seconds: float | None = None, teardown=None) -> None
 
 
 def cmd_up(args) -> int:
+    if args.grpc_port is not None and _jax_process_count() > 1:
+        # Before engine bring-up: minutes of pod warmup for a flag
+        # combination knowable up front.
+        raise ValueError(
+            "--grpc-port is single-host only: an RPC landing on one "
+            "host would dispatch collectives the other hosts never "
+            "join (deadlock); serve from a single-process engine"
+        )
     engine = _engine_from_args(args)
     print(json.dumps({"ready": True, "setup_seconds": engine.setup_seconds,
                       "placement": engine.placement()}))
@@ -217,12 +225,6 @@ def cmd_up(args) -> int:
     if args.probe_latency:
         print(json.dumps({"step_latency": engine.step_latency()}))
     if args.grpc_port is not None:
-        if _jax_process_count() > 1:
-            raise ValueError(
-                "--grpc-port is single-host only: an RPC landing on one "
-                "host would dispatch collectives the other hosts never "
-                "join (deadlock); serve from a single-process engine"
-            )
         from tpu_dist_nn.serving import serve_engine
 
         server, bound = serve_engine(engine, args.grpc_port)
