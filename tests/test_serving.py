@@ -211,3 +211,32 @@ def test_codec_rejects_truncated_length_fields():
     cut = full[: len(full) - 16]
     with pytest.raises(ValueError, match="truncated"):
         decode_matrix(cut)
+
+
+def test_doctor_serving_round_trip(capsys):
+    import json as _json
+
+    from tpu_dist_nn.cli import main as cli_main
+
+    rc = cli_main(["doctor", "--serving"])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["healthy"]
+    assert out["serving"]["round_trip"] is True
+
+
+def test_doctor_serving_failure_is_unhealthy(capsys, monkeypatch):
+    """A broken serving stack must fail the health verdict, not default
+    to healthy through the error path."""
+    import json as _json
+
+    import tpu_dist_nn.serving as serving_pkg
+    from tpu_dist_nn.cli import main as cli_main
+
+    def boom(*a, **k):
+        raise RuntimeError("serving stack broken")
+
+    monkeypatch.setattr(serving_pkg, "serve_engine", boom)
+    rc = cli_main(["doctor", "--serving"])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["healthy"] is False
+    assert out["serving"]["round_trip"] is False

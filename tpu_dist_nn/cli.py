@@ -941,8 +941,45 @@ def cmd_doctor(args) -> int:
     except Exception as e:  # pragma: no cover - backend-specific
         report["pallas_kernels"] = f"unavailable: {type(e).__name__}"
 
+    if getattr(args, "serving", False):
+        # Loopback gRPC round trip: server + client through the real
+        # wire codec against a tiny engine, bound to 127.0.0.1 only (a
+        # self-check must not expose an unauthenticated endpoint on the
+        # network) on an ephemeral port.
+        eng = server = client = None
+        try:
+            import numpy as _np2
+
+            from tpu_dist_nn.api.engine import Engine
+            from tpu_dist_nn.serving import GrpcClient, serve_engine
+            from tpu_dist_nn.testing.factories import random_model
+
+            m = random_model([8, 6, 4], seed=0)
+            eng = Engine.up(m, [2])
+            server, port = serve_engine(eng, 0, host="127.0.0.1")
+            client = GrpcClient(f"127.0.0.1:{port}")
+            xs = _np2.random.default_rng(1).uniform(0, 1, (3, 8))
+            remote = client.process(xs)
+            local = eng.infer(xs)
+            ok = bool(_np2.allclose(remote, local, rtol=1e-6))
+            report["serving"] = {"port": port, "round_trip": ok}
+        except Exception as e:  # pragma: no cover - environment-specific
+            # round_trip=False so a broken serving stack fails the
+            # health verdict — that is the point of the flag.
+            report["serving"] = {
+                "round_trip": False, "error": f"{type(e).__name__}: {e}"
+            }
+        finally:
+            if client is not None:
+                client.close()
+            if server is not None:
+                server.stop(grace=0.2)
+            if eng is not None:
+                eng.down()
+
     report["healthy"] = bool(
         report["oracle_parity"] and report["devices"]
+        and report.get("serving", {}).get("round_trip", True)
     )
     print(json.dumps(report, indent=2))
     return 0 if report["healthy"] else 1
@@ -1134,6 +1171,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("doctor",
                        help="environment self-check (backend, devices, "
                             "native lib, kernels, oracle parity)")
+    p.add_argument("--serving", action="store_true",
+                   help="also run a loopback gRPC serving round trip "
+                        "(server + client through the real wire codec)")
     p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser("oracle", help="numpy float64 baseline (manual_nn)")

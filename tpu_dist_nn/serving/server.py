@@ -74,10 +74,11 @@ def _make_handler(engine):
     return service
 
 
-def serve_engine(engine, port: int, *, max_workers: int = 10):
-    """Start a gRPC server bound to ``0.0.0.0:port``; returns
-    ``(server, bound_port)`` (``port=0`` picks an ephemeral port —
-    used by tests).
+def serve_engine(engine, port: int, *, max_workers: int = 10,
+                 host: str = "0.0.0.0"):
+    """Start a gRPC server bound to ``host:port``; returns
+    ``(server, bound_port)`` (``port=0`` picks an ephemeral port;
+    ``host="127.0.0.1"`` keeps self-checks off the network).
 
     ``max_workers=10`` is the reference's thread-pool size
     (``grpc_node.py:169``); unlimited message sizes match its client
@@ -91,7 +92,7 @@ def serve_engine(engine, port: int, *, max_workers: int = 10):
         ],
     )
     server.add_generic_rpc_handlers((_make_handler(engine),))
-    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise OSError(f"could not bind gRPC server to port {port}")
     server.start()
