@@ -271,6 +271,76 @@ def scenario_checkpoint_resume() -> dict:
     }
 
 
+def scenario_checkpoint_resume_zero1() -> dict:
+    """Multi-host ZeRO-1 save AND resume with non-shared filesystems:
+    the opt state is jitted with sharded out_shardings, so its leaves
+    span the processes — on process 1 they are NON-addressable. Saving
+    gathers (fine); the regression under test is resume_or_init, whose
+    broadcast on non-source processes must build its payload from leaf
+    METADATA (np.zeros_like on a non-addressable array raises). Ends
+    with a retention-window violation that must raise the SAME
+    ValueError on BOTH processes (the validation verdict is broadcast
+    after the gather; a process-0-only raise would hang the peer in the
+    collective)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist_nn.checkpoint.store import AsyncCheckpointManager, resume_or_init
+    from tpu_dist_nn.data.feed import global_batch, shard_for_host
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel import zero
+    from tpu_dist_nn.parallel.mesh import AXIS_DATA, MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+
+    pid = jax.process_index()
+    mesh = build_mesh(MeshSpec(data=8))
+    cfg = TransformerConfig(
+        vocab_size=29, d_model=16, n_heads=2, n_layers=2, d_ff=32, max_seq_len=12
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, cfg.vocab_size, (16, 13)).astype(np.int32)
+    local = shard_for_host(rows)
+    step_fn = zero.make_zero_lm_train_step(mesh, cfg, optax.adam(1e-3), params)
+    opt_state = step_fn.init_opt_state(params)  # sharded across processes
+    batch = global_batch(mesh, P(AXIS_DATA, None), local)
+    params, opt_state, _ = step_fn(params, opt_state, batch)
+
+    d = tempfile.mkdtemp(prefix=f"tdn_mh_z1_p{pid}_")  # no shared FS
+    mgr = AsyncCheckpointManager(d, keep=2)
+    state = {"params": params, "opt_state": opt_state}
+    mgr.save(7, state)
+    mgr.wait()
+
+    # Fresh manager; the template is the LIVE sharded state — its
+    # opt-state leaves are non-addressable on process 1.
+    mgr2 = AsyncCheckpointManager(d, keep=2)
+    step, restored = resume_or_init(mgr2, state)
+    tok = np.abs(np.asarray(restored["params"]["tok_embed"])).sum()
+    saved_tok = np.abs(np.asarray(to_host_numpy(params["tok_embed"]))).sum()
+
+    # Retention violation: keep=2 with steps {7, 9} on disk makes step 1
+    # too old. Only process 0's manifest knows that; both must raise.
+    mgr2.save(9, state)
+    mgr2.wait()
+    retention_raised = False
+    try:
+        mgr2.save(1, state)
+    except ValueError:
+        retention_raised = True
+    mgr2.wait()
+    return {
+        "step": step,
+        "tok_digest": float(tok),
+        "saved_tok_digest": float(saved_tok),
+        "retention_raised": retention_raised,
+    }
+
+
 def _global_dataset():
     from tpu_dist_nn.data.datasets import Dataset
     import numpy as np

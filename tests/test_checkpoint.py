@@ -201,10 +201,21 @@ def test_async_manager_surfaces_worker_errors(tmp_path):
     mgr = AsyncCheckpointManager(tmp_path, keep=1)
     mgr.save(5, {"w": np.ones(2)})
     mgr.wait()
-    # Saving an out-of-retention step fails in the worker; the error
-    # must surface on wait(), not vanish.
-    mgr.save(1, {"w": np.ones(2)})
+    # An out-of-retention step now fails fast on the CALLER thread
+    # (validation runs before enqueue so multi-host jobs agree on the
+    # verdict instead of hanging; see store._agree_valid).
     with pytest.raises(ValueError, match="retention"):
+        mgr.save(1, {"w": np.ones(2)})
+    # A failure inside the WORKER (filesystem half) still surfaces on
+    # wait(), not silently vanishing.
+    boom = RuntimeError("disk on fire")
+
+    def exploding_save_local(step, state, metadata=None):
+        raise boom
+
+    mgr._save_local = exploding_save_local
+    mgr.save(6, {"w": np.ones(2)})
+    with pytest.raises(RuntimeError, match="disk on fire"):
         mgr.wait()
     mgr.close()
 

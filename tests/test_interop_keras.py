@@ -1,0 +1,123 @@
+"""Keras/TF interop: saved model ↔ ModelSpec parity (reference C9
+toolchain, generate_mnist_tensorflow.py:14-27 with the exporter at
+:41-78 — made real, closing SURVEY.md §2.1's one unmatched row)."""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from tpu_dist_nn.core.schema import load_model  # noqa: E402
+from tpu_dist_nn.interop import (  # noqa: E402
+    model_from_keras,
+    model_from_keras_file,
+    model_to_keras,
+)
+from tpu_dist_nn.testing.factories import random_model  # noqa: E402
+from tpu_dist_nn.testing.oracle import oracle_forward_batch  # noqa: E402
+
+
+def _keras_fcnn(sizes, activations=None):
+    """The reference's Keras recipe shape at test scale
+    (generate_mnist_tensorflow.py:14-19): Dense relu stack + softmax."""
+    n = len(sizes) - 1
+    if activations is None:
+        activations = ["relu"] * (n - 1) + ["softmax"]
+    return keras.Sequential(
+        [keras.layers.Input(shape=(sizes[0],))]
+        + [
+            keras.layers.Dense(out, activation=act)
+            for out, act in zip(sizes[1:], activations)
+        ]
+    )
+
+
+def test_keras_forward_parity():
+    net = _keras_fcnn([20, 12, 8, 5])
+    model = model_from_keras(net)
+    assert model.layer_sizes == [20, 12, 8, 5]
+    assert [l.activation for l in model.layers] == ["relu", "relu", "softmax"]
+    assert model.layers[-1].type_tag == "output"
+
+    x = np.random.default_rng(0).uniform(0, 1, (9, 20)).astype(np.float32)
+    want = np.asarray(net(x))
+    got = oracle_forward_batch(model, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_round_trip():
+    model = random_model([7, 6, 4], seed=5)
+    back = model_from_keras(model_to_keras(model))
+    for a, b in zip(model.layers, back.layers):
+        # float32 is Keras's storage dtype; exact once both sides cast.
+        np.testing.assert_allclose(
+            a.weights.astype(np.float32), b.weights, rtol=0, atol=0
+        )
+        np.testing.assert_allclose(
+            a.biases.astype(np.float32), b.biases, rtol=0, atol=0
+        )
+        assert a.activation == b.activation
+
+
+def test_keras_file_round_trip(tmp_path):
+    net = _keras_fcnn([10, 6, 4])
+    path = tmp_path / "net.keras"
+    net.save(path)
+    model = model_from_keras_file(str(path))
+    assert model.layer_sizes == [10, 6, 4]
+    x = np.random.default_rng(1).uniform(0, 1, (5, 10)).astype(np.float32)
+    np.testing.assert_allclose(
+        oracle_forward_batch(model, x), np.asarray(net(x)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_keras_flatten_and_dropout_skipped():
+    net = keras.Sequential([
+        keras.layers.Input(shape=(6,)),
+        keras.layers.Dense(5, activation="relu"),
+        keras.layers.Dropout(0.5),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    model = model_from_keras(net)
+    assert model.layer_sizes == [6, 5, 3]
+
+
+def test_keras_conv_rejected():
+    net = keras.Sequential([
+        keras.layers.Input(shape=(8, 8, 3)),
+        keras.layers.Conv2D(4, 3),
+    ])
+    with pytest.raises(ValueError, match="Dense"):
+        model_from_keras(net)
+
+
+def test_keras_unsupported_activation_rejected():
+    net = _keras_fcnn([4, 3, 2], activations=["tanh", "softmax"])
+    with pytest.raises(ValueError, match="tanh"):
+        model_from_keras(net)
+
+
+def test_keras_activation_override_validated():
+    net = _keras_fcnn([4, 3, 2])
+    with pytest.raises(ValueError, match="unknown activations"):
+        model_from_keras(net, ["relu", "softmx"])
+    model = model_from_keras(net, ["sigmoid", "linear"])
+    assert [l.activation for l in model.layers] == ["sigmoid", "linear"]
+
+
+def test_cli_import_keras(tmp_path):
+    from tpu_dist_nn.cli import main
+
+    net = _keras_fcnn([10, 6, 4])
+    path = tmp_path / "net.keras"
+    net.save(path)
+    out = tmp_path / "model.json"
+    assert main(["import-keras", "--model", str(path), "--out", str(out)]) == 0
+    model = load_model(out)
+    assert model.layer_sizes == [10, 6, 4]
+    x = np.random.default_rng(1).uniform(0, 1, (5, 10)).astype(np.float32)
+    np.testing.assert_allclose(
+        oracle_forward_batch(model, x), np.asarray(net(x)),
+        rtol=1e-5, atol=1e-6,
+    )

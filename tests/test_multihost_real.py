@@ -134,6 +134,20 @@ def test_two_process_checkpoint_resume_without_shared_fs():
     assert r0["marker"] == r1["marker"] == 7.0
 
 
+def test_two_process_zero1_checkpoint_resume_without_shared_fs():
+    """Sharded (ZeRO-1) training state round-trips across hosts: saving
+    gathers, resuming broadcasts — with the template's opt-state leaves
+    non-addressable on host 1 — and a retention violation raises on
+    BOTH processes instead of hanging one in the collective."""
+    r0, r1 = _run_pair("checkpoint_resume_zero1")
+    assert r0["step"] == r1["step"] == 7
+    # Host 1 resumed from the broadcast payload; digests match the
+    # state that was saved, identically on both hosts.
+    assert r0["tok_digest"] == pytest.approx(r0["saved_tok_digest"], rel=1e-6)
+    assert r1["tok_digest"] == pytest.approx(r0["tok_digest"], rel=1e-6)
+    assert r0["retention_raised"] and r1["retention_raised"]
+
+
 def _single_process_step_reference() -> dict:
     import optax
 

@@ -98,10 +98,11 @@ class Engine:
                 raise InvalidArgumentError(
                     f"unknown quantize mode {quantize!r}; supported: 'int8'"
                 )
-            if mesh_spec.stage > 1 or mesh_spec.data > 1 or not model.is_dense:
+            if not model.is_dense:
                 raise InvalidArgumentError(
-                    "quantize='int8' currently serves dense models on the "
-                    "single-chip executor (no pipeline/conv/data-parallel)"
+                    "quantize='int8' serves dense models only (conv/pool "
+                    "layers have no int8 path); it composes with pipeline "
+                    "and data-parallel placements"
                 )
         # Copy metadata so export()'s annotations never mutate a
         # ModelSpec the caller still holds.
@@ -138,14 +139,22 @@ class Engine:
                 self._plan, self._params = build_network(model, dtype)
             if self.data_sharded:
                 self._params = jax.device_put(self._params, replicated(self.mesh))
-        self._q = None  # int8 serving path (quantize="int8")
+        self._q = None  # int8 serving path, single-program placement
+        self._q_pp = None  # int8 serving path, pipelined placement
         # Static activation names: passed explicitly on the hot path so
         # infer() never reads act ids back from the device.
         self._act_names = tuple(l.activation for l in model.layers)
         if quantize is not None:
-            from tpu_dist_nn.kernels.quantized import quantize_fcnn
+            if self.pipelined:
+                from tpu_dist_nn.kernels.quantized import (
+                    quantize_pipeline_weights,
+                )
 
-            self._q = quantize_fcnn(self._params)
+                self._q_pp = quantize_pipeline_weights(self._pp.weights)
+            else:
+                from tpu_dist_nn.kernels.quantized import quantize_fcnn
+
+                self._q = quantize_fcnn(self._params)
         self.setup_seconds: float | None = None
 
     # ---------------------------------------------------------------- up
@@ -173,33 +182,10 @@ class Engine:
         t0 = time.monotonic()
         if not isinstance(model, ModelSpec):
             model = load_model(model)
-        explicit_distribution = distribution is not None
         if distribution is None:
             distribution = model.metadata.get("layer_distribution")
         if distribution is None:
             distribution = [len(model.layers)]
-        if quantize is not None and len(distribution) > 1 and not explicit_distribution:
-            # A metadata-carried multi-stage plan (written by a pipelined
-            # export) must not make `--quantize` fail only on hosts with
-            # enough devices to honor it — int8 serving is single-chip,
-            # so collapse and say so. An *explicit* pipeline request
-            # still conflicts and is rejected in __init__.
-            log.info(
-                "placement: ignoring metadata layer_distribution %s — "
-                "quantize='int8' serves single-chip", distribution,
-            )
-            distribution = [len(model.layers)]
-        if quantize is not None and (len(distribution) > 1 or data_parallel > 1):
-            # Reject the explicit conflict HERE, before the device-count
-            # collapse below could silently turn a multi-stage request
-            # into a single-chip one on small hosts — the outcome must
-            # not depend on how many devices happen to be visible.
-            from tpu_dist_nn.utils.errors import InvalidArgumentError
-
-            raise InvalidArgumentError(
-                "quantize='int8' currently serves dense models on the "
-                "single-chip executor (no pipeline/conv/data-parallel)"
-            )
         # Fail fast on an invalid plan (run_grpc_fcnn.py:182-183).
         partition_model(model, distribution)
 
@@ -288,11 +274,21 @@ class Engine:
         if self.pipelined:
             from tpu_dist_nn.parallel.multihost import to_host_numpy
 
+            if self._q_pp is not None:
+                from tpu_dist_nn.parallel.pipeline import (
+                    pipeline_forward_quantized,
+                )
+
+                out = pipeline_forward_quantized(
+                    self.mesh, self._q_pp, self._pp.meta, x,
+                    num_microbatches=self.num_microbatches,
+                )
+                return to_host_numpy(out)
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
             return to_host_numpy(out)
-        if self._q is not None:
+        if self._q is not None and not self.data_sharded:
             from tpu_dist_nn.kernels.quantized import fcnn_quantized_forward
 
             return np.asarray(
@@ -301,11 +297,17 @@ class Engine:
                     activations=self._act_names,
                 )
             )
-        apply = (
-            jitted_forward
-            if self._plan is None
-            else jitted_network_forward(self._plan)
-        )
+        if self._q is not None:
+            # Data-sharded int8: the jnp quantized chain under jit on the
+            # batch-sharded global array (weights replicated); XLA keeps
+            # the int8 matmuls sharded over the data axis.
+            apply = self._quantized_apply()
+        else:
+            apply = (
+                jitted_forward
+                if self._plan is None
+                else jitted_network_forward(self._plan)
+            )
         if self.data_sharded:
             from tpu_dist_nn.parallel.multihost import to_host_numpy
 
@@ -313,30 +315,35 @@ class Engine:
             shards = self.mesh_spec.data
             xb = np.pad(x, ((0, -n % shards), (0, 0))).astype(self.dtype)
             if jax.process_count() > 1:
-                # Every host computed the same padded batch; contribute
-                # this host's slice of one globally-sharded array.
+                # Every host computed the same padded batch; each device
+                # receives exactly the chunk the sharding assigns it.
+                # (Deriving rows from process_index arithmetic instead
+                # would silently permute outputs on meshes whose data
+                # axis is not process-contiguous.)
                 from jax.sharding import PartitionSpec as P
 
-                from tpu_dist_nn.data.feed import global_batch
+                from tpu_dist_nn.data.feed import global_from_replicated
                 from tpu_dist_nn.parallel.mesh import AXIS_DATA
 
-                nproc = jax.process_count()
-                if shards % nproc:
-                    raise ValueError(
-                        f"data_parallel={shards} must be a multiple of the "
-                        f"process count ({nproc}) for multi-host inference"
-                    )
-                per = len(xb) // nproc
-                pidx = jax.process_index()
-                xb = global_batch(
-                    self.mesh, P(AXIS_DATA), xb[pidx * per:(pidx + 1) * per]
-                )
+                xb = global_from_replicated(self.mesh, P(AXIS_DATA), xb)
             else:
                 xb = jax.device_put(xb, batch_sharding(self.mesh))
             out = apply(self._params, xb)[:n]
             return to_host_numpy(out)
         out = apply(self._params, jnp.asarray(x, self.dtype))
         return np.asarray(out)
+
+    def _quantized_apply(self):
+        """Cached jitted (params, xb) -> logits closure over the int8
+        blocks, signature-compatible with the data-sharded dispatch."""
+        if getattr(self, "_q_apply", None) is None:
+            from tpu_dist_nn.kernels.quantized import forward_quantized
+
+            q, acts = self._q, self._act_names
+            self._q_apply = jax.jit(
+                lambda _params, xb: forward_quantized(q, xb, acts)
+            )
+        return self._q_apply
 
     def infer_single(self, x) -> tuple[np.ndarray, float]:
         """One example, with its wall time (run_grpc_inference.py:54-99)."""
@@ -503,6 +510,11 @@ class Engine:
             from tpu_dist_nn.kernels.quantized import quantize_fcnn
 
             self._q = quantize_fcnn(self._params)
+            self._q_apply = None
+        if self._q_pp is not None:
+            from tpu_dist_nn.kernels.quantized import quantize_pipeline_weights
+
+            self._q_pp = quantize_pipeline_weights(self._pp.weights)
         return history
 
     # ------------------------------------------------------------ export
@@ -528,6 +540,8 @@ class Engine:
         self._pp = None
         self._params = None
         self._q = None
+        self._q_pp = None
+        self._q_apply = None
         self._hp = None
 
     # ------------------------------------------------------------ health

@@ -111,24 +111,55 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._read_manifest()["latest_step"]
 
-    def save(self, step: int, state: Any, metadata: dict | None = None) -> Path:
-        """Persist ``state`` under ``step``; prunes beyond ``keep``.
-
-        A ``step`` older than the oldest retained step would be pruned by
-        its own save — that is a caller bug, so it is rejected instead.
-        """
-        step = int(step)
+    def _retention_error(self, step: int, extra_steps=()) -> str | None:
+        """Reject a ``step`` older than the oldest retained step — it
+        would be pruned by its own save, a caller bug. Only meaningful on
+        the process that owns the manifest (process 0)."""
         manifest = self._read_manifest()
-        steps = sorted(set(manifest["steps"]) | {step})
+        steps = sorted(set(manifest["steps"]) | set(extra_steps) | {step})
         if len(steps) > self.keep and step in steps[: len(steps) - self.keep]:
-            raise ValueError(
+            return (
                 f"step {step} is older than the retention window "
                 f"(keep={self.keep}, existing steps {manifest['steps']})"
             )
+        return None
+
+    def _agree_valid(self, err: str | None) -> None:
+        """Raise the manifest-derived validation error on EVERY process.
+
+        In a multi-host job on non-shared filesystems only process 0's
+        manifest has steps, so a process-0-only raise before/inside the
+        save collective would leave the other processes entering the
+        gather alone — a hang, not a clean failure. Broadcast the
+        verdict first (the sentinel pattern resume_or_init uses) so all
+        processes exit the same way.
+        """
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            flag = np.int64(
+                1 if (err is not None and jax.process_index() == 0) else 0
+            )
+            failed = int(multihost_utils.broadcast_one_to_all(flag))
+            if failed:
+                raise ValueError(
+                    err or "process 0 rejected the save (see its log)"
+                )
+        elif err is not None:
+            raise ValueError(err)
+
+    def _save_local(
+        self, step: int, state: Any, metadata: dict | None = None
+    ) -> Path:
+        """Filesystem half of a save: write + prune + manifest. ``state``
+        must already be host numpy (gathered); process 0 only — no
+        collectives, so it is safe on the async writer thread."""
         path = self._path(step)
-        save_pytree(state, path)  # collective gather inside; all procs call
         if jax.process_index() != 0:
             return path  # file/manifest writes are process 0's alone
+        manifest = self._read_manifest()
+        steps = sorted(set(manifest["steps"]) | {step})
+        _atomic_write_bytes(path, serialization.to_bytes(state))
         if metadata:
             manifest.setdefault("metadata", {})[str(step)] = metadata
         while len(steps) > self.keep:
@@ -140,6 +171,21 @@ class CheckpointManager:
         manifest.update({"latest_step": max(steps), "steps": steps})
         self._write_manifest(manifest)
         return path
+
+    def save(self, step: int, state: Any, metadata: dict | None = None) -> Path:
+        """Persist ``state`` under ``step``; prunes beyond ``keep``.
+
+        Order matters multi-host: the gather is a collective every
+        process must reach, so it runs FIRST; manifest-derived
+        validation follows, with the verdict broadcast so every process
+        raises (or proceeds) together.
+        """
+        from tpu_dist_nn.parallel.multihost import to_host_numpy
+
+        step = int(step)
+        state = to_host_numpy(state)  # collective; all procs reach it
+        self._agree_valid(self._retention_error(step))
+        return self._save_local(step, state, metadata)
 
     def restore(self, template: Any, step: int | None = None) -> tuple[int, Any]:
         """Restore ``step`` (default: newest intact) into ``template``.
@@ -202,6 +248,10 @@ class AsyncCheckpointManager(CheckpointManager):
         self._queue: "queue.Queue" = queue.Queue(maxsize=2)
         self._error: BaseException | None = None
         self._closed = False
+        # Steps enqueued but not yet in the on-disk manifest; retention
+        # validation counts them so a stale manifest read on the caller
+        # thread can't wave through a step the drained queue will prune.
+        self._pending_steps: list[int] = []
         self._thread = threading.Thread(
             target=self._worker, name="tdn-ckpt-writer", daemon=True
         )
@@ -214,10 +264,21 @@ class AsyncCheckpointManager(CheckpointManager):
                 if item is None:
                     return
                 step, state, metadata = item
-                CheckpointManager.save(self, step, state, metadata)
+                # Filesystem half only: validation (which broadcasts in
+                # multi-host) already ran on the caller thread — a
+                # collective issued from this free-running thread would
+                # interleave arbitrarily with the training step's
+                # collectives on other hosts (ordering mismatch =
+                # deadlock).
+                self._save_local(step, state, metadata)
             except BaseException as e:  # surfaced on the caller's side
                 self._error = e
             finally:
+                if item is not None:
+                    try:  # now in the manifest; drop from pending
+                        self._pending_steps.remove(item[0])
+                    except ValueError:
+                        pass
                 self._queue.task_done()
 
     def _raise_pending(self) -> None:
@@ -230,18 +291,21 @@ class AsyncCheckpointManager(CheckpointManager):
             # Enqueueing with no consumer would deadlock a later wait().
             raise RuntimeError("AsyncCheckpointManager is closed")
         self._raise_pending()
-        if jax.process_count() > 1:
-            # The cross-process all-gather MUST happen here on the
-            # caller thread, where every process reaches save() at the
-            # same step — a free-running daemon thread would issue the
-            # collective at arbitrary points relative to the training
-            # step's collectives on other hosts (ordering mismatch =
-            # deadlock). The worker then only serializes host numpy.
-            from tpu_dist_nn.parallel.multihost import to_host_numpy
+        step = int(step)
+        # Both collectives happen HERE on the caller thread, where every
+        # process reaches save() at the same step: the cross-process
+        # all-gather, and the retention-validation broadcast. The
+        # manifest on disk lags behind queued-but-unwritten saves, so
+        # validation also counts the pending steps.
+        from tpu_dist_nn.parallel.multihost import to_host_numpy
 
-            state = to_host_numpy(state)
-        self._queue.put((int(step), state, metadata))
-        return self._path(int(step))
+        state = to_host_numpy(state)
+        self._agree_valid(
+            self._retention_error(step, extra_steps=tuple(self._pending_steps))
+        )
+        self._pending_steps.append(step)
+        self._queue.put((step, state, metadata))
+        return self._path(step)
 
     def wait(self) -> None:
         """Block until every enqueued checkpoint is on disk."""
@@ -266,6 +330,16 @@ def flush(checkpoints) -> None:
     wait = getattr(checkpoints, "wait", None)
     if wait is not None:
         wait()
+
+
+def _host_zeros_like(leaf):
+    """Same-shape/dtype HOST buffer without reading the leaf's value
+    (shape/dtype are metadata, available even for jax.Arrays with no
+    locally-addressable shards)."""
+    if isinstance(leaf, jax.Array):
+        return np.zeros(leaf.shape, leaf.dtype)
+    arr = np.asarray(leaf)
+    return np.zeros(arr.shape, arr.dtype)
 
 
 def _shape_check_leaf(t, r):
@@ -339,7 +413,17 @@ def resume_or_init(checkpoints, state: dict) -> tuple[int, dict]:
             )
         if step < 0:
             return 0, state
-        payload = local[1] if local is not None else state
+        # Non-source processes contribute a same-structure host buffer
+        # built from leaf METADATA only: with ZeRO-1/FSDP the live
+        # template's opt-state leaves are sharded across processes
+        # (non-addressable here), and broadcast_one_to_all's
+        # np.zeros_like would invoke __array__ on them and raise —
+        # crashing hosts != 0 while process 0 enters the collective.
+        payload = (
+            local[1]
+            if local is not None
+            else jax.tree.map(_host_zeros_like, state)
+        )
         restored_state = multihost_utils.broadcast_one_to_all(payload)
     else:
         restored = checkpoints.restore_or_none(state)

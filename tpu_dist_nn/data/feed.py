@@ -110,6 +110,51 @@ def shard_for_host(*arrays):
     return out[0] if len(out) == 1 else out
 
 
+def global_from_replicated(mesh, specs, *arrays):
+    """Build globally-sharded jax.Arrays from HOST-REPLICATED data.
+
+    Every process must hold the IDENTICAL full array (the inference /
+    eval feed pattern: engine.infer and pipeline_forward compute the
+    same padded batch on every host). Each addressable device receives
+    exactly the chunk the sharding assigns it — the chunk indices come
+    from the sharding itself (``addressable_devices_indices_map``), so
+    nothing assumes a process's rows are contiguous or ordered by
+    ``process_index``. ``jax.make_mesh``'s topology-optimized device
+    order does not guarantee process-contiguity along the data axis on
+    real pods; slicing ``x[p*per:(p+1)*per]`` there would silently
+    permute rows (and therefore outputs) relative to the caller's
+    order.
+
+    ``specs`` is one PartitionSpec for every array or a tuple with one
+    spec per array. Returns one array or a tuple matching the inputs.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if isinstance(specs, PartitionSpec):
+        specs = (specs,) * len(arrays)
+    if len(specs) != len(arrays):
+        raise ValueError(f"{len(specs)} specs for {len(arrays)} arrays")
+    if jax.process_count() == 1:
+        out = tuple(
+            jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec))
+            for spec, a in zip(specs, arrays)
+        )
+        return out[0] if len(out) == 1 else out
+    out = []
+    for spec, a in zip(specs, arrays):
+        a = np.asarray(a)
+        sharding = NamedSharding(mesh, spec)
+        shards = [
+            jax.device_put(np.ascontiguousarray(a[idx]), d)
+            for d, idx in sharding.addressable_devices_indices_map(a.shape).items()
+        ]
+        out.append(
+            jax.make_array_from_single_device_arrays(a.shape, sharding, shards)
+        )
+    return out[0] if len(out) == 1 else tuple(out)
+
+
 def global_batch(mesh, specs, *arrays, assume_replicated: bool = False):
     """Assemble per-process host stripes into global jax.Arrays.
 

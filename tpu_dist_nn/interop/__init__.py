@@ -2,18 +2,28 @@
 
 The reference's model-production layer (SURVEY.md §1 L5) trains
 centrally in PyTorch/TensorFlow and exports per-neuron JSON
-(``scripts/generate_mnist_pytorch.py:68-103``, notebook cell 10).
-This package subsumes that export path natively: torch modules /
-state dicts convert to the public :class:`~tpu_dist_nn.core.schema.ModelSpec`
-and back, so models trained anywhere drop into the TPU pipeline.
+(``scripts/generate_mnist_pytorch.py:68-103``,
+``scripts/generate_mnist_tensorflow.py:41-78``, notebook cell 10).
+This package subsumes that export path natively: torch state dicts and
+saved Keras models convert to the public
+:class:`~tpu_dist_nn.core.schema.ModelSpec` and back, so models trained
+anywhere drop into the TPU pipeline.
 """
 
+from tpu_dist_nn.interop.keras_import import (
+    model_from_keras,
+    model_from_keras_file,
+    model_to_keras,
+)
 from tpu_dist_nn.interop.torch_import import (
     model_from_torch_state_dict,
     model_to_torch_state_dict,
 )
 
 __all__ = [
+    "model_from_keras",
+    "model_from_keras_file",
     "model_from_torch_state_dict",
+    "model_to_keras",
     "model_to_torch_state_dict",
 ]

@@ -1,0 +1,192 @@
+"""Keras / TensorFlow ↔ JSON-schema model conversion (C9's twin).
+
+The reference trains the same MNIST FCNN in Keras
+(``scripts/generate_mnist_tensorflow.py:14-27``) with the exporter
+commented out (``:41-78``); the live exporter in the notebook (cell 10)
+iterates ``layer.get_weights()`` and tags hidden layers relu / the
+output softmax. This module is that exporter made real and
+bidirectional, mirroring :mod:`tpu_dist_nn.interop.torch_import`.
+
+Layout notes vs the torch twin: Keras ``Dense`` stores its kernel as
+``(in_dim, out_dim)`` — already the schema's layout (``grpc_node.py:51``
+transpose rule applies to torch's ``(out, in)``, not here) — and each
+layer carries its own activation, so the default tagging comes from the
+model itself rather than a positional convention.
+
+TensorFlow/Keras are imported lazily: they are heavyweight and only
+needed for loading ``.keras``/``.h5`` files or building live models,
+never for the conversion math.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from tpu_dist_nn.core.schema import LayerSpec, ModelSpec
+
+# Keras activation identifiers that map onto the schema's set
+# (core/activations.py; reference set grpc_node.py:62-73).
+_KERAS_ACTIVATIONS = {
+    "relu": "relu",
+    "sigmoid": "sigmoid",
+    "softmax": "softmax",
+    "linear": "linear",
+    None: "linear",
+}
+
+
+def _dense_triples(model) -> list[tuple[str, np.ndarray, np.ndarray, str]]:
+    """Extract ordered (name, kernel(in,out), bias(out,), activation)
+    from a live Keras model's Dense layers."""
+    triples = []
+    for layer in model.layers:
+        weights = layer.get_weights()
+        if len(weights) != 2 or np.ndim(weights[0]) != 2:
+            cls = type(layer).__name__
+            if cls in ("InputLayer", "Flatten", "Dropout"):
+                continue  # shape/regularization plumbing, no parameters
+            raise ValueError(
+                f"layer {layer.name} ({cls}) is not a Dense layer; only "
+                "dense stacks import from Keras — export conv models via "
+                "the JSON schema's conv2d layer type instead"
+            )
+        kernel, bias = weights
+        act_fn = getattr(layer, "activation", None)
+        act_name = getattr(act_fn, "__name__", None) if act_fn else None
+        if act_name not in _KERAS_ACTIVATIONS:
+            raise ValueError(
+                f"layer {layer.name}: activation {act_name!r} has no "
+                f"schema equivalent; known: "
+                f"{sorted(k for k in _KERAS_ACTIVATIONS if k)}"
+            )
+        triples.append(
+            (
+                layer.name,
+                np.asarray(kernel, dtype=np.float64),
+                np.asarray(bias, dtype=np.float64),
+                _KERAS_ACTIVATIONS[act_name],
+            )
+        )
+    if not triples:
+        raise ValueError("Keras model contains no Dense layers")
+    return triples
+
+
+def model_from_keras(
+    model,
+    activations: Sequence[str] | None = None,
+) -> ModelSpec:
+    """Convert a live Keras model (Sequential/Functional dense stack)
+    to a :class:`ModelSpec`.
+
+    ``activations`` optionally overrides the per-layer names; the
+    default reads each layer's own activation (the notebook cell 10
+    exporter read the architecture the same way).
+    """
+    from tpu_dist_nn.core.activations import ACTIVATION_IDS
+
+    triples = _dense_triples(model)
+    n = len(triples)
+    if activations is not None:
+        activations = [a.strip().lower() for a in activations]
+        unknown = [a for a in activations if a not in ACTIVATION_IDS]
+        if unknown:
+            raise ValueError(
+                f"unknown activations {unknown}; known: "
+                f"{sorted(ACTIVATION_IDS)}"
+            )
+        if len(activations) != n:
+            raise ValueError(
+                f"got {len(activations)} activations for {n} dense layers"
+            )
+    layers = []
+    for i, (name, kernel, bias, act) in enumerate(triples):
+        if i and kernel.shape[0] != layers[-1].out_dim:
+            raise ValueError(
+                f"{name}: input dim {kernel.shape[0]} does not chain from "
+                f"previous layer's out_dim {layers[-1].out_dim}"
+            )
+        layers.append(
+            LayerSpec(
+                weights=kernel.copy(),  # already (in, out)
+                biases=bias.copy(),
+                activation=activations[i] if activations else act,
+                type_tag="output" if i == n - 1 else "hidden",
+            )
+        )
+    model_spec = ModelSpec(layers=layers)
+    model_spec.validate_chain()
+    return model_spec
+
+
+def model_from_keras_file(
+    path: str,
+    activations: Sequence[str] | None = None,
+) -> ModelSpec:
+    """Load a saved Keras model (``.keras`` zip or legacy ``.h5``) and
+    convert it. ``compile=False`` skips optimizer/loss deserialization —
+    only the architecture and weights matter here."""
+    loaders = []
+    try:
+        import keras  # Keras 3
+
+        loaders.append(keras.models.load_model)
+    except Exception:  # pragma: no cover - environment-specific
+        pass
+    try:
+        import tf_keras  # legacy Keras 2 (reads old h5/SavedModel)
+
+        loaders.append(tf_keras.models.load_model)
+    except Exception:  # pragma: no cover - environment-specific
+        pass
+    if not loaders:
+        raise RuntimeError(
+            "neither keras nor tf_keras is importable; install one to "
+            "load saved Keras models"
+        )
+    errors = []
+    for load in loaders:
+        try:
+            km = load(path, compile=False)
+        except Exception as e:  # loader/format mismatch: try the next
+            # (Keras 3 raises ValueError for legacy formats tf_keras CAN
+            # read, so even ValueError must not abort the chain here.)
+            errors.append(f"{load.__module__}: {type(e).__name__}: {e}")
+            continue
+        # Loaded fine: conversion errors are real — propagate them.
+        return model_from_keras(km, activations=activations)
+    raise RuntimeError(
+        f"could not load {path} with any available Keras loader:\n"
+        + "\n".join(errors)
+    )
+
+
+def model_to_keras(model: ModelSpec):
+    """Inverse conversion: dense :class:`ModelSpec` → a built Keras
+    ``Sequential`` with the weights installed. Round-trips exactly
+    through :func:`model_from_keras`."""
+    import keras
+
+    if not model.is_dense:
+        raise ValueError("only all-dense models convert to Keras stacks")
+    valid = {v for k, v in _KERAS_ACTIVATIONS.items() if k}
+    for layer in model.layers:
+        if layer.activation not in valid:
+            raise ValueError(
+                f"activation {layer.activation!r} has no Keras equivalent"
+            )
+    km = keras.Sequential(
+        [keras.layers.Input(shape=(model.input_dim,))]
+        + [
+            keras.layers.Dense(layer.out_dim, activation=layer.activation)
+            for layer in model.layers
+        ]
+    )
+    for dense, layer in zip(km.layers, model.layers):
+        dense.set_weights([
+            layer.weights.astype(np.float32),
+            layer.biases.astype(np.float32),
+        ])
+    return km

@@ -155,3 +155,30 @@ def _quantized_chain_call(wb_shapes, activations, block_b, x, *wsbs):
         out_shape=jax.ShapeDtypeStruct((M, out_dim), jnp.float32),
         interpret=_interpret(),
     )(x, *wsbs)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline composition (per-stage quantized blocks)
+# ---------------------------------------------------------------------------
+
+def quantize_pipeline_weights(weights) -> dict:
+    """Padded :class:`~tpu_dist_nn.parallel.pipeline.PipelineWeights`
+    (S, L, D, D) → per-stage int8 blocks with per-output-channel scales.
+
+    Same symmetric scheme as :func:`quantize_fcnn`, applied to every
+    padded layer slot: real blocks quantize over their embedded
+    [in_dim, out_dim] region (rows beyond ``in_dim`` are zero and do not
+    move the column max); identity filler slots quantize to exactly
+    ±127·(1/127) — pass-through survives to ~1 ulp, and the executor's
+    width masks (``PipelineMeta.grad_masks`` geometry) keep padding
+    columns at exactly zero either way.
+    """
+    w = np.asarray(weights.w, np.float32)  # (S, L, D, D)
+    absmax = np.maximum(np.abs(w).max(axis=2), 1e-8)  # (S, L, D)
+    scale = (absmax / 127.0).astype(np.float32)
+    wq = np.clip(np.round(w / scale[:, :, None, :]), -127, 127).astype(np.int8)
+    return {
+        "wq": jnp.asarray(wq),
+        "scale": jnp.asarray(scale),
+        "b": jnp.asarray(np.asarray(weights.b, np.float32)),
+    }

@@ -1,0 +1,333 @@
+"""The multi-chip dry run: jit the full training step over an
+n-device virtual CPU mesh and run one step on tiny shapes.
+
+Shared by the driver hook (``__graft_entry__.dryrun_multichip``) and
+``tdn doctor --multichip`` (the budgeted local replica that catches
+dryrun regressions before the driver does). See the module docstring in
+``__graft_entry__.py`` for the tier contract.
+"""
+
+from __future__ import annotations
+
+
+def _factor_mesh(n: int):
+    """Split n devices into (stage, data): prefer 4 pipeline stages."""
+    for stage in (4, 2):
+        if n % stage == 0 and n >= stage:
+            return stage, n // stage
+    return n, 1
+
+
+def _force_virtual_cpu(n_devices: int) -> None:
+    """Force an ``n_devices``-device virtual CPU platform before any
+    computation.
+
+    The environment's sitecustomize can register an experimental live-TPU
+    platform at interpreter startup; an n-device mesh cannot come from the
+    single real chip, and round 1's driver capture showed exactly that
+    failure mode (MULTICHIP_r01: the 'axon' platform active, rc=124).
+    Same recipe as tests/conftest.py — flip the platform with
+    ``jax.config.update`` (env vars are too late once jax is imported)
+    and extend XLA_FLAGS, which is read at backend init. If a backend
+    already initialized with the wrong platform or device count, reset it
+    with ``clear_backends`` so the flags take effect.
+    """
+    import os
+    import re
+    import tempfile
+
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", opt, flags
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
+    jax.config.update("jax_platforms", "cpu")
+    # Persistent compile cache: the dryrun's cost is almost entirely XLA
+    # compiles of shard_map programs; retries within a round reuse them.
+    user = os.environ.get("USER") or os.environ.get("LOGNAME") or str(os.getuid())
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(), f"tdn_jax_cache_{user}"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # >= not ==: the mesh only needs n devices, and an already-running
+    # 8-device test process must not get its backend torn down for a
+    # dryrun_multichip(1) call (clear_backends invalidates live arrays).
+    if jax.default_backend() != "cpu" or jax.local_device_count() < n_devices:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert jax.local_device_count() >= n_devices, jax.devices()
+
+
+def _full_tier() -> bool:
+    """TDN_DRYRUN_FULL=1 compiles every schedule/sharding variant; the
+    default tier keeps one program per parallelism family (pp, dp, tp,
+    sp, ep, pp×tp×dp) so a cold run fits a few-minute driver budget
+    (measured cold on 8 virtual CPU devices: ~40 s default, ~70 s full)."""
+    import os
+
+    return os.environ.get("TDN_DRYRUN_FULL", "0") == "1"
+
+
+def dryrun_multichip(n_devices: int) -> None:
+    _force_virtual_cpu(n_devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pipeline import build_pipeline_params
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.train.pipeline_trainer import (
+        make_pipeline_train_step,
+        prepare_pipeline_batch,
+    )
+
+    stage, data = _factor_mesh(n_devices)
+    mesh = build_mesh(MeshSpec(stage=stage, data=data))
+
+    # Tiny model with one dense layer per pipeline stage.
+    sizes = [12] + [8] * (stage - 1) + [4]
+    model = random_model(sizes, seed=0)
+    params = build_pipeline_params(partition_model(model, [1] * stage))
+
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params.weights)
+    num_microbatches = 2
+    step = make_pipeline_train_step(mesh, params.meta, num_microbatches, optimizer)
+
+    rng = np.random.default_rng(0)
+    bx = rng.uniform(0, 1, (4 * data * num_microbatches, 12)).astype(np.float32)
+    by = rng.integers(0, 4, len(bx)).astype(np.int32)
+    xs, labels, mask = prepare_pipeline_batch(
+        params.meta, bx, by, num_microbatches, data
+    )
+    weights, opt_state, loss = step(
+        params.weights, opt_state,
+        jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask),
+    )
+    jax.block_until_ready(weights)
+    assert float(loss) > 0, "training step produced a non-positive CE loss"
+
+    # The 1F1B schedule variant (hand-rolled backward over the same mesh).
+    step_1f1b = make_pipeline_train_step(
+        mesh, params.meta, num_microbatches, optimizer, schedule="1f1b"
+    )
+    weights, opt_state, loss = step_1f1b(
+        params.weights, optimizer.init(params.weights),
+        jnp.asarray(xs), jnp.asarray(labels), jnp.asarray(mask),
+    )
+    jax.block_until_ready(weights)
+    assert float(loss) > 0, "1f1b training step produced a non-positive CE loss"
+
+    if n_devices % 2 == 0:
+        _dryrun_transformer_sp_tp(n_devices)
+        _dryrun_moe_ep(n_devices)
+        _dryrun_lm_1f1b(n_devices)
+        if _full_tier():
+            _dryrun_zero_fsdp(n_devices)
+    if n_devices % 4 == 0:
+        _dryrun_pp_tp_3d(n_devices)
+
+
+def _dryrun_lm_1f1b(n_devices: int) -> None:
+    """Pipelined transformer LM steps under the 1F1B and interleaved
+    (virtual-stage) schedules."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+    from tpu_dist_nn.train.lm_trainer import make_pipeline_lm_train_step
+
+    stage, data = 2, n_devices // 2
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    params = dict(params, blocks=shard_blocks(params["blocks"], stage))
+    mesh = build_mesh(MeshSpec(stage=stage, data=data))
+    optimizer = optax.adam(1e-3)
+    step = make_pipeline_lm_train_step(
+        mesh, cfg, stage, 2, optimizer, schedule="1f1b"
+    )
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2 * data, 17)).astype("int32")
+    new_params, _, loss = step(params, optimizer.init(params), tokens)
+    jax.block_until_ready(new_params)
+    assert float(loss) > 0
+
+    if not _full_tier():
+        return
+    # Interleaved (table-driven) schedule over the same mesh.
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        shard_blocks_interleaved,
+    )
+    from tpu_dist_nn.models.transformer import init_transformer as _init
+
+    params_v = _init(jax.random.key(1), cfg)
+    params_v = dict(
+        params_v, blocks=shard_blocks_interleaved(params_v["blocks"], stage, 1)
+    )
+    step_il = make_pipeline_lm_train_step(
+        mesh, cfg, stage, 2, optimizer, schedule="interleaved", num_virtual=1
+    )
+    new_params, _, loss = step_il(params_v, optimizer.init(params_v), tokens)
+    jax.block_until_ready(new_params)
+    assert float(loss) > 0
+
+
+def _dryrun_zero_fsdp(n_devices: int) -> None:
+    """ZeRO-1 and FSDP sharded-state steps (with per-block remat):
+    the optimizer/param sharding schedules over the data axis."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.zero import (
+        make_fsdp_lm_train_step,
+        make_zero_lm_train_step,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16, remat=True,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    mesh = build_mesh(MeshSpec(data=n_devices))
+    optimizer = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2 * n_devices, 16)).astype("int32")
+    for make in (make_zero_lm_train_step, make_fsdp_lm_train_step):
+        step = make(mesh, cfg, optimizer, params)
+        opt_state = step.init_opt_state(params)
+        new_params, opt_state, loss = step(params, opt_state, tokens)
+        jax.block_until_ready(new_params)
+        assert float(loss) > 0
+
+
+def _dryrun_transformer_sp_tp(n_devices: int) -> None:
+    """Sequence-parallel (ring attention) and tensor-parallel (Megatron)
+    transformer grad steps on tiny shapes: the sp/tp shardings."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.ring_attention import make_seq_parallel_lm_loss
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        make_tp_lm_forward,
+        tp_shard_blocks,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32, max_seq_len=16
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+
+    mesh_sp = build_mesh(MeshSpec(seq=2, data=n_devices // 2))
+    sp_modes = ("ring", "ulysses") if _full_tier() else ("ring",)
+    for sp_mode in sp_modes:
+        sp_loss = make_seq_parallel_lm_loss(mesh_sp, cfg, mode=sp_mode)
+        g = jax.jit(jax.grad(sp_loss))(params, tokens)
+        jax.block_until_ready(g)
+
+    mesh_tp = build_mesh(MeshSpec(model=2, data=n_devices // 2))
+    params_tp = dict(params, blocks=tp_shard_blocks(params["blocks"], cfg, 2))
+    tp_fwd = make_tp_lm_forward(mesh_tp, cfg)
+    g = jax.jit(jax.grad(lambda p, t: jnp.mean(tp_fwd(p, t) ** 2)))(
+        params_tp, tokens
+    )
+    jax.block_until_ready(g)
+
+    if not _full_tier():
+        return
+    # Tensor-parallel decode: Megatron-sharded heads + KV cache.
+    from tpu_dist_nn.parallel.tp_generate import tp_generate
+
+    out = tp_generate(mesh_tp, params_tp, cfg, tokens[:, :4], 3)
+    jax.block_until_ready(out)
+
+
+def _dryrun_moe_ep(n_devices: int) -> None:
+    """Expert-parallel (MoE all_to_all) grad step: the ep sharding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist_nn.parallel.expert_parallel import (
+        MoEConfig,
+        ep_shard_blocks,
+        init_moe_transformer,
+        make_ep_lm_forward,
+    )
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+
+    ep = 2
+    cfg = MoEConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16, n_experts=4, capacity_factor=1.5,
+    )
+    params = init_moe_transformer(jax.random.key(0), cfg)
+    params_ep = dict(params, blocks=ep_shard_blocks(params["blocks"], ep))
+    mesh = build_mesh(MeshSpec(expert=ep, data=n_devices // ep))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2 * n_devices, 17)), jnp.int32
+    )
+    loss_fn = make_ep_lm_forward(mesh, cfg, with_loss=True)
+    g = jax.jit(jax.grad(loss_fn))(params_ep, tokens)
+    jax.block_until_ready(g)
+
+
+def _dryrun_pp_tp_3d(n_devices: int) -> None:
+    """3D composition: pipeline x Megatron tensor x data grad step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist_nn.models.transformer import TransformerConfig, init_transformer
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        make_pipeline_tp_lm_loss,
+        shard_blocks_pp_tp,
+    )
+
+    stage, model = 2, 2
+    data = n_devices // (stage * model)
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=16,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    mesh = build_mesh(MeshSpec(stage=stage, model=model, data=data))
+    params_3d = dict(
+        params, blocks=shard_blocks_pp_tp(params["blocks"], cfg, stage, model)
+    )
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (4 * data, 17)), jnp.int32
+    )
+    loss_fn = make_pipeline_tp_lm_loss(mesh, cfg, stage, num_microbatches=2)
+    g = jax.jit(jax.grad(loss_fn))(params_3d, tokens)
+    jax.block_until_ready(g)

@@ -1,0 +1,66 @@
+"""Bounded backend bring-up.
+
+Round 1's driver artifacts showed two failure modes of the live TPU
+platform: a setup/compile error at first use (BENCH_r01.json, rc=1) and
+an init that simply hangs (the MULTICHIP_r01 timeout; reproduced
+locally with a >500 s hang). Anything operational — bench, doctor —
+must therefore treat "initialize the default backend" as an unreliable
+external call: probe it in a SUBPROCESS with a timeout and bounded
+retries, and fall back to the host CPU backend with a visible note
+instead of crashing or wedging. (The reference's analogue is the
+orchestrator's TCP readiness poll, run_grpc_fcnn.py:157-172 — never
+trust a stage is up until it answers.)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def probe_default_backend(
+    timeout: float = 90.0,
+    tries: int = 1,
+    expect: str | None = None,
+    log=None,
+) -> tuple[str, str] | None:
+    """Initialize the default backend in a subprocess and run one op.
+
+    Returns ``(backend_name, device_kind)`` on success, ``None`` if the
+    backend errors or hangs (each attempt bounded by ``timeout``).
+    ``expect`` additionally requires a specific backend (e.g. "tpu").
+    ``log`` is an optional ``callable(str)`` for progress diagnostics.
+    """
+    code = (
+        "import jax\n"
+        "b = jax.default_backend()\n"
+        + (f"assert b == {expect!r}, b\n" if expect else "")
+        + "import jax.numpy as jnp\n"
+        "assert float(jnp.ones(8).sum()) == 8.0\n"
+        "print('BACKEND=' + b + '|' + jax.devices()[0].device_kind)\n"
+    )
+    say = log or (lambda msg: None)
+    for attempt in range(tries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            if out.returncode == 0:
+                for line in out.stdout.splitlines():
+                    if line.startswith("BACKEND="):
+                        backend, _, kind = line[len("BACKEND="):].partition("|")
+                        return backend, kind
+            say(
+                f"backend probe attempt {attempt + 1}/{tries} failed "
+                f"(rc={out.returncode}): {out.stderr.strip()[-300:]}"
+            )
+        except subprocess.TimeoutExpired:
+            say(
+                f"backend probe attempt {attempt + 1}/{tries} timed out "
+                f"after {timeout:.0f}s (hung backend init)"
+            )
+        if attempt + 1 < tries:
+            time.sleep(5 * (attempt + 1))
+    return None
