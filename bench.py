@@ -226,6 +226,33 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    # The probe proved the backend came up ONCE in a subprocess; this
+    # process's own init is a second roll of the dice on a backend that
+    # hangs intermittently — bound it, emitting the JSON error record
+    # instead of wedging until the driver's timeout.
+    from tpu_dist_nn.utils.backend import init_watchdog
+
+    def _init_hung():
+        print(
+            json.dumps(
+                {
+                    "metric": "samples/sec/chip (MNIST FCNN batched inference)",
+                    "value": 0,
+                    "unit": "samples/sec",
+                    "vs_baseline": 0,
+                    "error": "backend init hung in-process after a "
+                             "successful subprocess probe",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(1)
+
+    with init_watchdog(
+        float(os.environ.get("TDN_BENCH_TPU_TIMEOUT", "90")), _init_hung
+    ):
+        jax.devices()  # force backend init under the watchdog
+
     on_accel = device_kind is not None
     samples_per_sec = throughput_bench(jax, jnp, on_accel)
     mfu = mfu_bench(jax, jnp, device_kind, on_accel)

@@ -443,8 +443,8 @@ class Engine:
                 "make_pipeline_train_step(..., schedule='interleaved', "
                 "num_virtual=v) for dense chains at the trainer level."
             )
-        # The heterogeneous executor sets pipelined=True but trains via
-        # the single-program trainer, so it must reject 1f1b too.
+        # The heterogeneous executor trains through its own hand-rolled
+        # GPipe schedule (train_hetero), which has no 1f1b variant.
         if schedule != "gpipe" and (not self.pipelined or self._hp is not None):
             raise ValueError(
                 "schedule='1f1b' applies to the dense pipelined placement "
@@ -454,22 +454,52 @@ class Engine:
                 "to use it"
             )
         if self._hp is not None:
-            # The heterogeneous executor serves inference only; train on
-            # the single-program executor and re-place the stages after
-            # (keeps train working for any placement — the outcome must
-            # not depend on how the engine happened to be placed).
-            from tpu_dist_nn.parallel.hetero_pipeline import HeteroPipeline
+            if config.clip_norm is not None:
+                # Global-norm clipping couples the stages; train on the
+                # single-program executor and re-place the stages after
+                # (the pre-round-2 behavior, kept for this one recipe).
+                from tpu_dist_nn.parallel.hetero_pipeline import HeteroPipeline
 
-            plan, params = build_network(self.model, self.dtype)
-            params, history = train_network(
-                plan, params, train_data, config,
+                log.info(
+                    "train: clip_norm set — conv pipeline trains on the "
+                    "single-program executor (global norm spans stages)"
+                )
+                plan, params = build_network(self.model, self.dtype)
+                params, history = train_network(
+                    plan, params, train_data, config,
+                    eval_data=eval_data, checkpoints=checkpoints,
+                )
+                self.model = network_model_from_params(self.model, params)
+                self._hp = HeteroPipeline(
+                    self.model, self.distribution,
+                    devices=list(self.mesh.devices.flat), dtype=self.dtype,
+                )
+                return history
+            # Train THROUGH the pipeline placement: per-stage jitted
+            # VJPs with device_put hand-offs mirroring the forward
+            # (parallel/hetero_pipeline.py training section).
+            import math
+
+            from tpu_dist_nn.parallel.hetero_pipeline import train_hetero
+
+            # num_microbatches is an inference knob set at up() time;
+            # training only needs SOME equal split of the batch, so take
+            # the largest divisor of batch_size not exceeding it (gcd) —
+            # any batch_size trains, as it did pre-pipelined-training.
+            mb = math.gcd(self.num_microbatches, config.batch_size)
+            if mb != self.num_microbatches:
+                log.info(
+                    "train: using %d microbatches (engine's %d does not "
+                    "divide batch_size %d)",
+                    mb, self.num_microbatches, config.batch_size,
+                )
+            params_list, history = train_hetero(
+                self._hp, train_data, config,
                 eval_data=eval_data, checkpoints=checkpoints,
+                num_microbatches=mb,
             )
-            self.model = network_model_from_params(self.model, params)
-            self._hp = HeteroPipeline(
-                self.model, self.distribution,
-                devices=list(self.mesh.devices.flat), dtype=self.dtype,
-            )
+            flat = [p for stage_params in params_list for p in stage_params]
+            self.model = network_model_from_params(self.model, flat)
             return history
         if self.pipelined:
             self._pp, history = train_pipelined(

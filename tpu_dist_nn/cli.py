@@ -942,6 +942,27 @@ def cmd_doctor(args) -> int:
                 "falling back to cpu"
             )
             jax.config.update("jax_platforms", "cpu")
+        else:
+            # The probe proved init works in a subprocess; bound THIS
+            # process's init too (intermittent hangs), emitting an
+            # unhealthy verdict instead of wedging the self-check.
+            import os as _os
+
+            from tpu_dist_nn.utils.backend import init_watchdog
+
+            def _init_hung():
+                print(json.dumps({
+                    "backend": "unresponsive (hung at in-process init "
+                               "after a successful probe)",
+                    "healthy": False,
+                }, indent=2), flush=True)
+                _os._exit(1)
+
+            with init_watchdog(
+                float(os.environ.get("TDN_DOCTOR_BACKEND_TIMEOUT", "90")),
+                _init_hung,
+            ):
+                jax.devices()
     report["backend"] = jax.default_backend()
     if probed is not None:
         report["device_kind"] = probed[1]

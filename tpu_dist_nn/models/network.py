@@ -161,6 +161,18 @@ def network_forward(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.nd
     return x
 
 
+def network_forward_lax(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.ndarray:
+    """Pure-lax forward (Pallas conv path bypassed) WITH every layer's
+    activation applied — the training-time stage forward: reverse-mode
+    autodiff needs lax ops (``pallas_call`` has no VJP), and the hetero
+    pipeline's backward recomputes activations with exactly this
+    function, so the forward must use it too or the VJP would be taken
+    around a slightly different function than the one that ran."""
+    for p, w in zip(plan, params):
+        x = _apply_layer(p, w, x)
+    return x
+
+
 def network_logits(plan: Sequence[LayerPlan], params, x: jnp.ndarray) -> jnp.ndarray:
     """Forward with the final layer's activation skipped (for CE loss).
 

@@ -16,18 +16,34 @@ Docker/gRPC substrate replaced by device placement + async dispatch:
   microbatch m+1 run stage i while microbatch m runs stage i+1 — the
   GPipe overlap without an SPMD schedule.
 
-Inference-only by design: the reference's pipeline is inference-only
-(SURVEY.md §2.3), and conv training runs on the single-program executor.
+Training (round 2; the reference's pipeline is inference-only,
+SURVEY.md §2.3): the same placement runs a hand-rolled GPipe
+forward/backward — each stage's VJP is a per-stage jitted program with
+activation recompute, so only the stage-BOUNDARY activations live
+across the schedule (O(M·S) boundary tensors — GPipe memory; the
+per-stage internals rematerialize inside the VJP), cotangents hand off
+device-to-device mirroring the forward, gradients accumulate per stage
+ON that stage's device, and each stage applies its own optax update
+locally. Adam & friends are elementwise, so per-stage updates on
+microbatch-mean gradients are numerically the single-program update —
+asserted to tolerance by tests/test_hetero_pipeline.py.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tpu_dist_nn.core.schema import ModelSpec, validate_distribution
-from tpu_dist_nn.models.network import build_network, jitted_network_forward
+from tpu_dist_nn.models.network import (
+    build_network,
+    jitted_network_forward,
+    network_forward_lax,
+    network_logits,
+)
 
 
 class HeteroPipeline:
@@ -103,3 +119,191 @@ class HeteroPipeline:
                 [p.kind for p in s["plan"]] for s in self.stages
             ],
         }
+
+    def set_stage_params(self, params_list) -> None:
+        """Install trained per-stage params (committed to each stage's
+        device) — the training loop's write-back."""
+        for stage, p in zip(self.stages, params_list):
+            stage["params"] = jax.device_put(p, stage["device"])
+
+
+# ---------------------------------------------------------------- training
+
+@functools.lru_cache(maxsize=32)
+def _stage_fwd(plan):
+    """Training-time stage forward: pure lax (see network_forward_lax)."""
+    return jax.jit(functools.partial(network_forward_lax, plan))
+
+
+@functools.lru_cache(maxsize=32)
+def _stage_bwd(plan):
+    """(params, x, g_out) -> (g_params, g_x) with activation recompute:
+    the VJP is rebuilt inside jit from the saved stage INPUT, so the
+    schedule only ever stores boundary activations."""
+
+    def bwd(params, x, g):
+        _, pull = jax.vjp(
+            lambda p, xx: network_forward_lax(plan, p, xx), params, x
+        )
+        return pull(g)
+
+    return jax.jit(bwd)
+
+
+@functools.lru_cache(maxsize=32)
+def _last_stage_loss_bwd(plan):
+    """(params, x, y) -> (loss, g_params, g_x): CE on the sub-chain's
+    logits (final activation skipped — train_network's convention)."""
+    from tpu_dist_nn.train.trainer import cross_entropy
+
+    def f(params, x, y):
+        def loss_f(p, xx):
+            return cross_entropy(network_logits(plan, p, xx), y)
+
+        loss, (gp, gx) = jax.value_and_grad(loss_f, argnums=(0, 1))(params, x)
+        return loss, gp, gx
+
+    return jax.jit(f)
+
+
+# One process-wide jit each (retraces per pytree structure); inputs are
+# committed arrays, so each call runs on its stage's device.
+_tree_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+_tree_scale = jax.jit(lambda t, s: jax.tree.map(lambda l: l * s, t))
+
+
+def make_hetero_train_step(hp: HeteroPipeline, optimizer, num_microbatches: int):
+    """Build ``step(params_list, opt_states, x, y)`` running the GPipe
+    schedule over the per-stage device placement.
+
+    The host drives the schedule; every per-stage program (forward, VJP,
+    gradient accumulate, optimizer update) is jitted and committed to
+    its stage's device, and JAX's async dispatch overlaps microbatch
+    ``m+1``'s stage ``i`` with microbatch ``m``'s stage ``i+1`` exactly
+    as in :meth:`HeteroPipeline.forward`. Microbatches are equal-sized
+    (mean-of-means == full-batch mean for the CE loss), so the update
+    equals the single-program one for elementwise optimizers.
+    """
+    stages = hp.stages
+    S = len(stages)
+
+    @jax.jit  # one wrapper; jit retraces per pytree structure + device
+    def _apply_update(params, opt_state, grads):
+        import optax
+
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def step(params_list, opt_states, x, y):
+        if len(x) % num_microbatches:
+            raise ValueError(
+                f"batch of {len(x)} rows does not split into "
+                f"{num_microbatches} equal microbatches"
+            )
+        mb = len(x) // num_microbatches
+        xs = [x[m * mb:(m + 1) * mb] for m in range(num_microbatches)]
+        ys = [y[m * mb:(m + 1) * mb] for m in range(num_microbatches)]
+
+        # Forward wave: stage inputs (boundary activations) are the only
+        # saved state; dispatch everything before awaiting anything.
+        inputs = [[None] * S for _ in range(num_microbatches)]
+        for m, xm in enumerate(xs):
+            h = jax.device_put(jnp.asarray(xm), stages[0]["device"])
+            for i, stage in enumerate(stages):
+                h = jax.device_put(h, stage["device"])
+                inputs[m][i] = h
+                if i + 1 < S:
+                    h = _stage_fwd(stage["plan"])(params_list[i], h)
+
+        # Backward wave: per-microbatch cotangent flows tail -> head,
+        # gradients accumulate on each stage's device.
+        grads = [None] * S
+        losses = []
+        for m in range(num_microbatches):
+            loss, gp, gx = _last_stage_loss_bwd(stages[-1]["plan"])(
+                params_list[-1], inputs[m][-1], jnp.asarray(ys[m])
+            )
+            losses.append(loss)
+            grads[-1] = gp if grads[-1] is None else _tree_add(grads[-1], gp)
+            for i in reversed(range(S - 1)):
+                gx = jax.device_put(gx, stages[i]["device"])
+                gp, gx = _stage_bwd(stages[i]["plan"])(
+                    params_list[i], inputs[m][i], gx
+                )
+                grads[i] = gp if grads[i] is None else _tree_add(grads[i], gp)
+
+        # Per-stage update on microbatch-mean gradients, local to the
+        # stage's device.
+        inv = 1.0 / num_microbatches
+        new_params, new_opt = [], []
+        for i in range(S):
+            g = _tree_scale(grads[i], inv)
+            p, o = _apply_update(params_list[i], opt_states[i], g)
+            new_params.append(p)
+            new_opt.append(o)
+        loss = jnp.stack(losses).mean()
+        return new_params, new_opt, loss
+
+    return step
+
+
+def train_hetero(
+    hp: HeteroPipeline,
+    train_data,
+    config=None,
+    eval_data=None,
+    checkpoints=None,
+    num_microbatches: int = 2,
+):
+    """Train a heterogeneous (conv/pool/dense) model THROUGH the
+    pipeline placement; returns ``(params_list, history)`` and installs
+    the trained params back into ``hp``.
+
+    Matches :func:`tpu_dist_nn.train.trainer.train_network` numerically
+    (same loop, loss, optimizer recipe) — the difference is WHERE the
+    compute runs: one jitted program per stage on that stage's device
+    instead of one whole-model program.
+    """
+    from tpu_dist_nn.train.trainer import (
+        TrainConfig,
+        optimizer_for,
+        run_training_loop,
+    )
+
+    config = config or TrainConfig()
+    if config.clip_norm is not None:
+        raise ValueError(
+            "clip_norm is a GLOBAL-norm operation; per-stage optimizers "
+            "cannot apply it independently without changing the result. "
+            "Train with the single-program executor for clipped runs."
+        )
+    if config.batch_size % num_microbatches:
+        raise ValueError(
+            f"batch_size {config.batch_size} must be a multiple of "
+            f"num_microbatches {num_microbatches}"
+        )
+    optimizer = optimizer_for(config, train_data)
+    params_list = [s["params"] for s in hp.stages]
+    opt_states = [
+        jax.device_put(optimizer.init(p), s["device"])
+        for p, s in zip(params_list, hp.stages)
+    ]
+    step = make_hetero_train_step(hp, optimizer, num_microbatches)
+
+    eval_fn = None
+    if eval_data is not None:
+        def eval_fn(params_list_):
+            hp.set_stage_params(params_list_)
+            from tpu_dist_nn.train.metrics import classification_metrics
+
+            preds = hp.forward(eval_data.x).argmax(-1)
+            return classification_metrics(
+                preds, eval_data.y, eval_data.num_classes
+            )
+
+    params_list, history = run_training_loop(
+        step, params_list, opt_states, train_data, config, eval_fn,
+        checkpoints=checkpoints,
+    )
+    hp.set_stage_params(params_list)
+    return params_list, history

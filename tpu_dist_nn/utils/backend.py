@@ -14,8 +14,10 @@ trust a stage is up until it answers.)
 
 from __future__ import annotations
 
+import contextlib
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -64,3 +66,23 @@ def probe_default_backend(
         if attempt + 1 < tries:
             time.sleep(5 * (attempt + 1))
     return None
+
+
+@contextlib.contextmanager
+def init_watchdog(seconds: float, on_timeout):
+    """Bound an IN-PROCESS backend init that might hang.
+
+    The subprocess probe only proves the backend came up once; the
+    parent's own init afterwards is a second roll of the dice on a
+    backend known to hang intermittently. If the with-block does not
+    finish within ``seconds``, ``on_timeout`` runs on a daemon timer
+    thread — it should emit its diagnostic record and ``os._exit``
+    (a hung init cannot be unwound by an exception).
+    """
+    timer = threading.Timer(seconds, on_timeout)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
