@@ -97,10 +97,12 @@ def test_swaps_into_transformer_forward_and_loss():
         np.asarray(ref_logits), np.asarray(out_logits), rtol=2e-4, atol=2e-4
     )
 
-    g_ref = jax.grad(lm_loss)(params, tokens, cfg)
-    g_out = jax.grad(lambda p, t, c: lm_loss(p, t, c, attn_fn=flash_fn))(
-        params, tokens, cfg
-    )
+    # jitted: eager grad-through-interpret-mode-pallas is the suite's
+    # slowest single test otherwise (and never hits the compile cache).
+    g_ref = jax.jit(jax.grad(lm_loss), static_argnums=2)(params, tokens, cfg)
+    g_out = jax.jit(
+        jax.grad(lambda p, t: lm_loss(p, t, cfg, attn_fn=flash_fn))
+    )(params, tokens)
     for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_out)):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4

@@ -124,7 +124,7 @@ def test_interleaved_dense_chain_matches_gpipe():
         ll = jnp.take_along_axis(logp, labels.reshape(-1)[:, None], axis=-1)[:, 0]
         return -(ll * mask.reshape(-1)).sum() / mask.sum()
 
-    loss_ref, grads_ref = jax.value_and_grad(loss_fn)(params.weights)
+    loss_ref, grads_ref = jax.jit(jax.value_and_grad(loss_fn))(params.weights)
 
     # Interleaved: same 4 chunks on 2 devices x 2 virtual.
     mesh_s = build_mesh(MeshSpec(stage=S, data=data))

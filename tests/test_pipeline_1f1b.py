@@ -36,7 +36,8 @@ def _gpipe_loss_and_grad(mesh, params, num_microbatches, xs, labels, mask):
         ll = jnp.take_along_axis(logp, flat[:, None], axis=-1)[:, 0]
         return -(ll * mask.reshape(-1)).sum() / mask.sum()
 
-    return jax.value_and_grad(loss_fn)(weights)
+    # jitted: eager grad never hits the persistent compile cache
+    return jax.jit(jax.value_and_grad(loss_fn))(weights)
 
 
 def _setup(dims, distribution, stage, data, n_rows, num_microbatches, seed=0):

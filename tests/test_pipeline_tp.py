@@ -80,7 +80,7 @@ def test_pp_tp_loss_and_grads_match_single_chip():
 
     # Gradients: unshard the 3D block grads and compare to single-chip.
     g3d = jax.jit(jax.grad(loss_fn))(params_3d, tokens)
-    gref = jax.grad(lm_loss)(params, tokens, CFG)
+    gref = jax.jit(jax.grad(lm_loss), static_argnums=2)(params, tokens, CFG)
     g_blocks = unshard_blocks_pp_tp(g3d["blocks"], CFG)
     for k in gref["blocks"]:
         np.testing.assert_allclose(

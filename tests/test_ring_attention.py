@@ -86,8 +86,8 @@ class TestRingAttention:
         def ring_loss(q, k, v):
             return jnp.sum(ring(q, k, v) ** 2)
 
-        g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
-        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        g_full = jax.jit(jax.grad(full_loss, argnums=(0, 1, 2)))(q, k, v)
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
         for gf, gr in zip(g_full, g_ring):
             np.testing.assert_allclose(
                 np.asarray(gr), np.asarray(gf), atol=5e-5, rtol=1e-3

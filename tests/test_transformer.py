@@ -115,13 +115,15 @@ class TestPipeline:
         params = _params()
         tokens = _tokens(batch=8, t=17)
 
-        g_single = jax.grad(lm_loss)(params, tokens, CFG)
+        # jit the grads: eager op-by-op execution never hits the
+        # persistent compile cache and dominated suite wall-clock.
+        g_single = jax.jit(jax.grad(lm_loss), static_argnums=2)(params, tokens, CFG)
 
         from tpu_dist_nn.parallel.transformer_pipeline import make_pipeline_lm_loss
 
         loss_fn = make_pipeline_lm_loss(mesh, CFG, 4, num_microbatches=2)
         staged = dict(params, blocks=shard_blocks(params["blocks"], 4))
-        g_pipe = jax.grad(loss_fn)(staged, tokens)
+        g_pipe = jax.jit(jax.grad(loss_fn))(staged, tokens)
         g_pipe = dict(g_pipe, blocks=unshard_blocks(g_pipe["blocks"]))
 
         flat_s, _ = jax.tree.flatten(g_single)
@@ -226,7 +228,7 @@ class TestMixedPrecision:
         l16 = float(lm_loss(params, tokens, cfg16))
         # bf16 has ~3 decimal digits; losses agree loosely.
         assert abs(l32 - l16) / l32 < 0.05
-        g = jax.grad(lm_loss)(params, tokens, cfg16)
+        g = jax.jit(jax.grad(lm_loss), static_argnums=2)(params, tokens, cfg16)
         for leaf in jax.tree.leaves(g):
             assert leaf.dtype == jnp.float32  # masters stay f32
             assert bool(jnp.all(jnp.isfinite(leaf)))

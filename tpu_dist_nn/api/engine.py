@@ -454,39 +454,20 @@ class Engine:
                 "to use it"
             )
         if self._hp is not None:
-            if config.clip_norm is not None:
-                # Global-norm clipping couples the stages; train on the
-                # single-program executor and re-place the stages after
-                # (the pre-round-2 behavior, kept for this one recipe).
-                from tpu_dist_nn.parallel.hetero_pipeline import HeteroPipeline
-
-                log.info(
-                    "train: clip_norm set — conv pipeline trains on the "
-                    "single-program executor (global norm spans stages)"
-                )
-                plan, params = build_network(self.model, self.dtype)
-                params, history = train_network(
-                    plan, params, train_data, config,
-                    eval_data=eval_data, checkpoints=checkpoints,
-                )
-                self.model = network_model_from_params(self.model, params)
-                self._hp = HeteroPipeline(
-                    self.model, self.distribution,
-                    devices=list(self.mesh.devices.flat), dtype=self.dtype,
-                )
-                return history
             # Train THROUGH the pipeline placement: per-stage jitted
             # VJPs with device_put hand-offs mirroring the forward
-            # (parallel/hetero_pipeline.py training section).
-            import math
-
+            # (parallel/hetero_pipeline.py training section; global-norm
+            # clipping is applied across the stages by the step).
             from tpu_dist_nn.parallel.hetero_pipeline import train_hetero
 
             # num_microbatches is an inference knob set at up() time;
             # training only needs SOME equal split of the batch, so take
-            # the largest divisor of batch_size not exceeding it (gcd) —
-            # any batch_size trains, as it did pre-pipelined-training.
-            mb = math.gcd(self.num_microbatches, config.batch_size)
+            # the largest batch_size divisor not exceeding it — any
+            # batch_size trains, as it did pre-pipelined-training.
+            mb = max(
+                d for d in range(1, self.num_microbatches + 1)
+                if config.batch_size % d == 0
+            )
             if mb != self.num_microbatches:
                 log.info(
                     "train: using %d microbatches (engine's %d does not "
@@ -523,6 +504,9 @@ class Engine:
             self._params, history = train_fcnn(
                 self._params, train_data, config,
                 eval_data=eval_data, checkpoints=checkpoints,
+                # Data-sharded placement: train over the data axis too
+                # (batch sharded, params replicated, grads all-reduced).
+                mesh=self.mesh if self.data_sharded else None,
             )
             trained = [
                 {"weights": np.asarray(p["w"], np.float64),

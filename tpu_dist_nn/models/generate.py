@@ -19,6 +19,8 @@ family adds next-token generation, built TPU-first:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -180,13 +182,26 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
-    total = T + max_new_tokens
     key = validate_generate_args(
         cfg, T, max_new_tokens, temperature, top_k, top_p, key
     )
+    # Sampling knobs become lru-cache keys: coerce to python scalars so
+    # concrete jax/numpy values (unhashable) keep working.
+    temperature = float(temperature)
+    top_k = None if top_k is None else int(top_k)
+    top_p = None if top_p is None else float(top_p)
+    run = _compiled_generate(cfg, T, max_new_tokens, temperature, top_k, top_p)
+    return run(params, prompt, key)
 
-    # The last decode writes position T + N - 2; size the cache exactly.
-    logits, cache = prefill(params, prompt, cfg, max_len=total - 1)
+
+@functools.lru_cache(maxsize=64)
+def _compiled_generate(cfg: TransformerConfig, T: int, max_new_tokens: int,
+                       temperature, top_k, top_p):
+    """One jitted prefill+decode program per (cfg, lengths, sampling)
+    configuration — rebuilding the scan per generate() call would pay
+    the trace (and, without the persistent cache, the compile) every
+    time."""
+    total = T + max_new_tokens
 
     def sample(logits, k):
         if temperature == 0:
@@ -196,18 +211,27 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
             k, logits / temperature, axis=-1
         ).astype(jnp.int32)
 
-    first = sample(logits[:, T - 1], key)
-    if max_new_tokens == 1:
-        return first[:, None]
+    @jax.jit
+    def run(params, prompt, key):
+        # The last decode writes position T + N - 2; size the cache
+        # exactly.
+        logits, cache = prefill(params, prompt, cfg, max_len=total - 1)
+        first = sample(logits[:, T - 1], key)
+        if max_new_tokens == 1:
+            return first[:, None]
 
-    def body(carry, step_key):
-        cache, token, pos = carry
-        logits, cache = decode_step(params, cache, pos, token, cfg)
-        nxt = sample(logits, step_key)
-        return (cache, nxt, pos + 1), nxt
+        def body(carry, step_key):
+            cache, token, pos = carry
+            logits, cache = decode_step(params, cache, pos, token, cfg)
+            nxt = sample(logits, step_key)
+            return (cache, nxt, pos + 1), nxt
 
-    keys = jax.random.split(jax.random.fold_in(key, 1), max_new_tokens - 1)
-    (_, _, _), rest = lax.scan(body, (cache, first, jnp.int32(T)), keys)
-    return jnp.concatenate(
-        [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
-    )  # (B, max_new_tokens)
+        keys = jax.random.split(
+            jax.random.fold_in(key, 1), max_new_tokens - 1
+        )
+        (_, _, _), rest = lax.scan(body, (cache, first, jnp.int32(T)), keys)
+        return jnp.concatenate(
+            [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+        )  # (B, max_new_tokens)
+
+    return run

@@ -170,9 +170,13 @@ def _last_stage_loss_bwd(plan):
 # committed arrays, so each call runs on its stage's device.
 _tree_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
 _tree_scale = jax.jit(lambda t, s: jax.tree.map(lambda l: l * s, t))
+_tree_sqsum = jax.jit(
+    lambda t: sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(t))
+)
 
 
-def make_hetero_train_step(hp: HeteroPipeline, optimizer, num_microbatches: int):
+def make_hetero_train_step(hp: HeteroPipeline, optimizer, num_microbatches: int,
+                           clip_norm: float | None = None):
     """Build ``step(params_list, opt_states, x, y)`` running the GPipe
     schedule over the per-stage device placement.
 
@@ -235,10 +239,23 @@ def make_hetero_train_step(hp: HeteroPipeline, optimizer, num_microbatches: int)
         # Per-stage update on microbatch-mean gradients, local to the
         # stage's device.
         inv = 1.0 / num_microbatches
+        mean_grads = [_tree_scale(g, inv) for g in grads]
+        if clip_norm is not None:
+            # GLOBAL-norm clipping spans the stages: per-stage squared
+            # sums (each on its device) combine on the host into the
+            # full-model norm — optax.clip_by_global_norm's exact
+            # semantics, which `optimizer` therefore must NOT also
+            # apply (train_hetero builds it clip-free).
+            gnorm = float(
+                np.sqrt(sum(float(_tree_sqsum(g)) for g in mean_grads))
+            )
+            if gnorm > clip_norm:
+                mean_grads = [
+                    _tree_scale(g, clip_norm / gnorm) for g in mean_grads
+                ]
         new_params, new_opt = [], []
         for i in range(S):
-            g = _tree_scale(grads[i], inv)
-            p, o = _apply_update(params_list[i], opt_states[i], g)
+            p, o = _apply_update(params_list[i], opt_states[i], mean_grads[i])
             new_params.append(p)
             new_opt.append(o)
         loss = jnp.stack(losses).mean()
@@ -270,25 +287,31 @@ def train_hetero(
         run_training_loop,
     )
 
+    import dataclasses as _dc
+
     config = config or TrainConfig()
-    if config.clip_norm is not None:
-        raise ValueError(
-            "clip_norm is a GLOBAL-norm operation; per-stage optimizers "
-            "cannot apply it independently without changing the result. "
-            "Train with the single-program executor for clipped runs."
-        )
     if config.batch_size % num_microbatches:
         raise ValueError(
             f"batch_size {config.batch_size} must be a multiple of "
             f"num_microbatches {num_microbatches}"
         )
-    optimizer = optimizer_for(config, train_data)
+    # Global-norm clipping is applied ACROSS stages by the step itself
+    # (see make_hetero_train_step); the per-stage optimizers must be
+    # built clip-free or clipping would apply twice with per-stage
+    # norms.
+    opt_config = (
+        _dc.replace(config, clip_norm=None)
+        if config.clip_norm is not None else config
+    )
+    optimizer = optimizer_for(opt_config, train_data)
     params_list = [s["params"] for s in hp.stages]
     opt_states = [
         jax.device_put(optimizer.init(p), s["device"])
         for p, s in zip(params_list, hp.stages)
     ]
-    step = make_hetero_train_step(hp, optimizer, num_microbatches)
+    step = make_hetero_train_step(
+        hp, optimizer, num_microbatches, clip_norm=config.clip_norm
+    )
 
     eval_fn = None
     if eval_data is not None:

@@ -19,6 +19,8 @@ over steps) — the decode loop never leaves the device.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,19 +51,37 @@ def tp_generate(mesh, params_tp: dict, cfg: TransformerConfig,
     n = mesh.shape[AXIS_MODEL]
     if cfg.n_heads % n:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by model axis {n}")
-    Hl, Dh = cfg.n_heads // n, cfg.head_dim
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
-    total = T + max_new_tokens
     # Same argument contract as the single-chip generate — the one
     # validator so the two paths cannot drift.
     key = validate_generate_args(
         cfg, T, max_new_tokens, temperature, top_k, top_p, key
     )
+    # Sampling knobs become lru-cache keys: coerce to python scalars so
+    # concrete jax/numpy values (unhashable) keep working.
+    temperature = float(temperature)
+    top_k = None if top_k is None else int(top_k)
+    top_p = None if top_p is None else float(top_p)
 
-    max_len = total - 1  # last decode writes position T + N - 2
     params_c = cfg.cast_params(params_tp)
     embed_params = {k: v for k, v in params_c.items() if k != "blocks"}
+    fn = _compiled_tp_generate(
+        mesh, cfg, T, max_new_tokens, temperature, top_k, top_p
+    )
+    return fn(embed_params, params_c["blocks"], prompt, key)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_tp_generate(mesh, cfg, T, max_new_tokens, temperature,
+                          top_k, top_p):
+    """One jitted decode program per (mesh, cfg, lengths, sampling)
+    configuration: building the shard_map closure per call would
+    recompile the whole prefill+decode scan on EVERY generate call."""
+    n = mesh.shape[AXIS_MODEL]
+    Hl, Dh = cfg.n_heads // n, cfg.head_dim
+    total = T + max_new_tokens
+    max_len = total - 1  # last decode writes position T + N - 2
 
     def unembed_rep(ep, x):
         x = layer_norm(x, ep["lnf_g"], ep["lnf_b"])
@@ -153,10 +173,11 @@ def tp_generate(mesh, params_tp: dict, cfg: TransformerConfig,
     blocks_specs = {
         k: (P() if k in TP_REPLICATED else P(AXIS_MODEL)) for k in BLOCK_KEYS
     }
-    fn = jax.shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=(P(), blocks_specs, P(AXIS_DATA), P()),
-        out_specs=P(AXIS_DATA),
+    return jax.jit(
+        jax.shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(P(), blocks_specs, P(AXIS_DATA), P()),
+            out_specs=P(AXIS_DATA),
+        )
     )
-    return fn(embed_params, params_c["blocks"], prompt, key)

@@ -12,6 +12,7 @@ trains the pipelined layout across a mesh.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 
 import jax
@@ -97,6 +98,42 @@ def make_train_step(acts, optimizer):
     return step
 
 
+def make_dp_train_step(acts, optimizer, mesh):
+    """Data-parallel twin of :func:`make_train_step`: batch sharded over
+    the mesh's data axis, params/opt-state replicated; XLA inserts the
+    gradient all-reduce. Single-process meshes only (multi-host dense
+    DP feeds through the pipelined/ZeRO trainers' global-batch path).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tpu_dist_nn.parallel.mesh import AXIS_DATA
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    row = NamedSharding(mesh, PartitionSpec(AXIS_DATA))
+
+    def loss_fn(wb, x, y):
+        return cross_entropy(forward_logits(_join_params(wb, acts), x), y)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=((rep, rep), (row, row)),
+        out_shardings=((rep, rep), None),
+    )
+    def _step(state, batch):
+        wb, opt_state = state
+        x, y = batch
+        loss, grads = jax.value_and_grad(loss_fn)(wb, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, wb)
+        wb = optax.apply_updates(wb, updates)
+        return (wb, opt_state), loss
+
+    def step(wb, opt_state, x, y):
+        (wb, opt_state), loss = _step((wb, opt_state), (x, y))
+        return wb, opt_state, loss
+
+    return step
+
+
 def run_training_loop(
     step, params, opt_state, train_data, config, eval_fn=None, checkpoints=None
 ):
@@ -168,12 +205,36 @@ def train_fcnn(
     config: TrainConfig = TrainConfig(),
     eval_data: Dataset | None = None,
     checkpoints=None,
+    mesh=None,
 ):
-    """Train a dense params pytree; returns (params, history)."""
+    """Train a dense params pytree; returns (params, history).
+
+    With ``mesh`` (a data-axis mesh from a data-parallel placement) the
+    step shards each batch over the data axis — the same gradients
+    (mean over the batch is row-partition-invariant), computed across
+    the devices instead of one.
+    """
     wb, acts = _split_params(params)
     optimizer = optimizer_for(config, train_data)
     opt_state = optimizer.init(wb)
-    step = make_train_step(acts, optimizer)
+    data_size = 1
+    if mesh is not None:
+        from tpu_dist_nn.parallel.mesh import AXIS_DATA
+
+        data_size = mesh.shape.get(AXIS_DATA, 1)
+    if mesh is not None and data_size > 1 and jax.process_count() == 1:
+        if config.batch_size % data_size:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "train: batch_size %d not divisible by data axis %d; "
+                "training single-device", config.batch_size, data_size,
+            )
+            step = make_train_step(acts, optimizer)
+        else:
+            step = make_dp_train_step(acts, optimizer, mesh)
+    else:
+        step = make_train_step(acts, optimizer)
     eval_fn = None
     if eval_data is not None:
         eval_fn = lambda wb_: evaluate_fcnn(_join_params(wb_, acts), eval_data)
