@@ -325,3 +325,35 @@ def test_orbax_store_empty_dir_fresh_start(tmp_path):
     mgr = OrbaxCheckpointManager(tmp_path / "empty")
     assert mgr.restore_or_none({"w": np.zeros(2)}) is None
     mgr.close()
+
+
+def test_restore_structure_mismatch_is_explained(tmp_path):
+    # A checkpoint written under one trainer layout restored into a
+    # different template must fail with the operative fact, not a
+    # cryptic flax state-dict error (layouts changed across rounds).
+    from tpu_dist_nn.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path)
+    mgr.save(1, {"params": [np.ones(3), np.ones(2)]})
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        mgr.restore({"params": [np.ones(3), np.ones(2), np.ones(4)]}, 1)
+
+
+def test_hetero_clip_with_grad_accum_rejected():
+    from tpu_dist_nn.data.datasets import synthetic_mnist
+    from tpu_dist_nn.models.network import init_conv_mlp
+    from tpu_dist_nn.parallel.hetero_pipeline import HeteroPipeline, train_hetero
+    from tpu_dist_nn.train.trainer import TrainConfig
+    import jax
+
+    model = init_conv_mlp(
+        jax.random.key(0), in_shape=(6, 6, 1), conv_filters=(4,),
+        hidden=(8,), num_classes=3,
+    )
+    data = synthetic_mnist(48, num_classes=3, dim=model.input_dim, seed=0)
+    hp = HeteroPipeline(model, [2, len(model.layers) - 2])
+    with pytest.raises(ValueError, match="grad_accum"):
+        train_hetero(
+            hp, data,
+            TrainConfig(epochs=1, batch_size=24, clip_norm=1.0, grad_accum=2),
+        )

@@ -210,6 +210,31 @@ def test_engine_quantized_serves_trained_weights(tmp_path):
     assert eng._q is None
 
 
+def test_engine_quantized_pipelined_serves_trained_weights(tmp_path):
+    # Pipelined int8 engine: after train(), the per-stage quantized
+    # blocks must track the trained weights too.
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.models.fcnn import spec_from_params
+    from tpu_dist_nn.train.trainer import TrainConfig
+
+    data = synthetic_mnist(600, num_classes=4, dim=24, noise=0.25, seed=0)
+    train, test = data.split(0.8, seed=1)
+    params = init_fcnn(jax.random.key(5), [24, 16, 4])
+    model = spec_from_params(params, ["relu", "softmax"])
+    p = tmp_path / "m.json"
+    save_model(model, p)
+
+    eng = Engine.up(p, [1, 1], quantize="int8")
+    assert eng.pipelined and eng._q_pp is not None
+    before = float(np.mean(eng.infer(test.x).argmax(-1) == test.y))
+    eng.train(train, TrainConfig(epochs=15, batch_size=32))
+    after = float(np.mean(eng.infer(test.x).argmax(-1) == test.y))
+    assert after > before + 0.2  # training must reach the served path
+    eng.down()
+    assert eng._q_pp is None
+
+
 def test_quantize_honors_metadata_distribution(tmp_path):
     # A pipelined export carries layer_distribution metadata; quantized
     # serving now honors it (int8 composes with the pipeline executor).

@@ -69,7 +69,20 @@ def save_pytree(state: Any, path: str | Path) -> None:
 def restore_pytree(template: Any, path: str | Path) -> Any:
     """Restore a pytree into ``template``'s structure from a msgpack file."""
     with open(path, "rb") as f:
-        return serialization.from_bytes(template, f.read())
+        data = f.read()
+    try:
+        return serialization.from_bytes(template, data)
+    except (ValueError, KeyError) as e:
+        # Structure mismatch (e.g. a checkpoint written by a different
+        # trainer layout or placement than this run's template) surfaces
+        # as a cryptic msgpack/state-dict error deep inside flax —
+        # re-raise with the operative fact and the way out.
+        raise ValueError(
+            f"checkpoint {path} does not match this run's training state "
+            f"layout ({e}). It was likely written under a different "
+            "placement or trainer configuration — resume with the "
+            "original configuration or start a fresh --checkpoint-dir"
+        ) from e
 
 
 class CheckpointManager:

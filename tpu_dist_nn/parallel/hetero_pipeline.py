@@ -290,6 +290,18 @@ def train_hetero(
     import dataclasses as _dc
 
     config = config or TrainConfig()
+    if config.clip_norm is not None and config.grad_accum > 1:
+        # MultiSteps accumulates RAW gradients and clips the
+        # accumulated mean at the real update; this step clips each
+        # batch's mean on the host BEFORE MultiSteps sees it —
+        # mean-of-clipped != clip-of-mean, so the combination would
+        # silently diverge from the single-program trainer.
+        raise ValueError(
+            "clip_norm with grad_accum > 1 is not supported through the "
+            "hetero pipeline (clipping would apply per micro-step, not "
+            "to the accumulated gradient); drop one of the two or train "
+            "with the single-program executor"
+        )
     if config.batch_size % num_microbatches:
         raise ValueError(
             f"batch_size {config.batch_size} must be a multiple of "

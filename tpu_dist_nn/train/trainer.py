@@ -82,56 +82,39 @@ def _join_params(wb, acts):
     return [{"w": p["w"], "b": p["b"], "act": a} for p, a in zip(wb, acts)]
 
 
-def make_train_step(acts, optimizer):
-    """Build the jitted SGD step for the single-chip layout."""
+def make_train_step(acts, optimizer, mesh=None):
+    """Build the jitted SGD step.
+
+    Without ``mesh``: the single-chip layout. With ``mesh`` (a data-axis
+    mesh from a data-parallel placement): the identical step jitted with
+    the batch sharded over the data axis and params/opt-state
+    replicated — XLA inserts the gradient all-reduce. Single-process
+    meshes only (multi-host dense DP feeds through the pipelined/ZeRO
+    trainers' global-batch path).
+    """
 
     def loss_fn(wb, x, y):
         return cross_entropy(forward_logits(_join_params(wb, acts), x), y)
 
-    @jax.jit
     def step(wb, opt_state, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(wb, x, y)
         updates, opt_state = optimizer.update(grads, opt_state, wb)
         wb = optax.apply_updates(wb, updates)
         return wb, opt_state, loss
 
-    return step
-
-
-def make_dp_train_step(acts, optimizer, mesh):
-    """Data-parallel twin of :func:`make_train_step`: batch sharded over
-    the mesh's data axis, params/opt-state replicated; XLA inserts the
-    gradient all-reduce. Single-process meshes only (multi-host dense
-    DP feeds through the pipelined/ZeRO trainers' global-batch path).
-    """
+    if mesh is None:
+        return jax.jit(step)
     from jax.sharding import NamedSharding, PartitionSpec
 
     from tpu_dist_nn.parallel.mesh import AXIS_DATA
 
     rep = NamedSharding(mesh, PartitionSpec())
     row = NamedSharding(mesh, PartitionSpec(AXIS_DATA))
-
-    def loss_fn(wb, x, y):
-        return cross_entropy(forward_logits(_join_params(wb, acts), x), y)
-
-    @functools.partial(
-        jax.jit,
-        in_shardings=((rep, rep), (row, row)),
-        out_shardings=((rep, rep), None),
+    return jax.jit(
+        step,
+        in_shardings=(rep, rep, row, row),
+        out_shardings=(rep, rep, None),
     )
-    def _step(state, batch):
-        wb, opt_state = state
-        x, y = batch
-        loss, grads = jax.value_and_grad(loss_fn)(wb, x, y)
-        updates, opt_state = optimizer.update(grads, opt_state, wb)
-        wb = optax.apply_updates(wb, updates)
-        return (wb, opt_state), loss
-
-    def step(wb, opt_state, x, y):
-        (wb, opt_state), loss = _step((wb, opt_state), (x, y))
-        return wb, opt_state, loss
-
-    return step
 
 
 def run_training_loop(
@@ -232,7 +215,7 @@ def train_fcnn(
             )
             step = make_train_step(acts, optimizer)
         else:
-            step = make_dp_train_step(acts, optimizer, mesh)
+            step = make_train_step(acts, optimizer, mesh=mesh)
     else:
         step = make_train_step(acts, optimizer)
     eval_fn = None
