@@ -147,7 +147,21 @@ def throughput_bench(jax, jnp, on_accel: bool) -> float:
         t0 = time.monotonic()
         run_pass()
         times.append(time.monotonic() - t0)
-    return n_samples / min(times)
+    host_fed = n_samples / min(times)
+
+    # Device-resident variant: data already in HBM, compute only. The
+    # reference's 13.2k samples/s was itself an IN-MEMORY Keras predict
+    # (no wire), so this is the apples-to-apples figure; the host-fed
+    # number above additionally pays the real host->device transfer.
+    dx = jax.device_put(x)
+    jax.block_until_ready(dx)
+    times = []
+    for _ in range(7):
+        t0 = time.monotonic()
+        jax.block_until_ready(apply(params, dx))
+        times.append(time.monotonic() - t0)
+    resident = n_samples / min(times)
+    return host_fed, resident
 
 
 def mfu_bench(jax, jnp, device_kind: str | None, on_accel: bool) -> dict:
@@ -254,7 +268,7 @@ def main() -> int:
         jax.devices()  # force backend init under the watchdog
 
     on_accel = device_kind is not None
-    samples_per_sec = throughput_bench(jax, jnp, on_accel)
+    samples_per_sec, resident_sps = throughput_bench(jax, jnp, on_accel)
     mfu = mfu_bench(jax, jnp, device_kind, on_accel)
     print(
         json.dumps(
@@ -263,6 +277,10 @@ def main() -> int:
                 "value": round(samples_per_sec, 1),
                 "unit": "samples/sec",
                 "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+                "device_resident_samples_per_sec": round(resident_sps, 1),
+                "device_resident_vs_baseline": round(
+                    resident_sps / BASELINE_SAMPLES_PER_SEC, 1
+                ),
                 "backend": backend,
                 "device_kind": device_kind or "host cpu",
                 **mfu,
