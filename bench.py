@@ -66,9 +66,12 @@ def probe_tpu() -> tuple[str, str] | None:
     """
     from tpu_dist_nn.utils.backend import probe_default_backend
 
+    # 2 tries x 90s bounds the worst case (hung backend at round end)
+    # to ~3 min of probing before the CPU fallback still delivers a
+    # green artifact inside any sane driver budget.
     probed = probe_default_backend(
         timeout=float(os.environ.get("TDN_BENCH_TPU_TIMEOUT", "90")),
-        tries=int(os.environ.get("TDN_BENCH_TPU_TRIES", "3")),
+        tries=int(os.environ.get("TDN_BENCH_TPU_TRIES", "2")),
         log=lambda m: print(f"# {m}", file=sys.stderr),
     )
     if probed is None or probed[0] == "cpu":
@@ -79,8 +82,10 @@ def probe_tpu() -> tuple[str, str] | None:
     return probed
 
 
-def throughput_bench(jax, jnp, on_accel: bool) -> float:
-    """The headline: host-fed batched inference, samples/sec.
+def throughput_bench(jax, jnp, on_accel: bool) -> tuple[float, float]:
+    """The headline: (host-fed, device-resident) samples/sec — the
+    first pays the real host->device transfer, the second is compute
+    only (the reference's own number was an in-memory predict).
 
     ``on_accel`` is the probe's verdict (the platform may present a
     non-'tpu' name for real TPU hardware — e.g. a tunneled plugin — so
@@ -279,7 +284,7 @@ def main() -> int:
                 "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
                 "device_resident_samples_per_sec": round(resident_sps, 1),
                 "device_resident_vs_baseline": round(
-                    resident_sps / BASELINE_SAMPLES_PER_SEC, 1
+                    resident_sps / BASELINE_SAMPLES_PER_SEC, 3
                 ),
                 "backend": backend,
                 "device_kind": device_kind or "host cpu",
