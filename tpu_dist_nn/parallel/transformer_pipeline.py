@@ -24,7 +24,6 @@ from jax import lax
 
 from tpu_dist_nn.models.transformer import (
     TransformerConfig,
-    block_apply,
     maybe_remat,
     dot_product_attention,
     embed,

@@ -12,7 +12,6 @@ trains the pipelined layout across a mesh.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import time
 
 import jax
