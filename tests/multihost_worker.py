@@ -341,6 +341,36 @@ def scenario_checkpoint_resume_zero1() -> dict:
     }
 
 
+def scenario_checkpoint_io_failure_agreed() -> dict:
+    """A checkpoint-write IO failure on process 0 (the only writer)
+    must raise on BOTH processes — not leave process 1 marching into
+    the next training-step collective alone. Induced by pointing
+    process 0's writer at a directory that vanished between saves
+    (chmod tricks don't bite: tests run as root)."""
+    import pathlib
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from tpu_dist_nn.checkpoint.store import CheckpointManager
+
+    pid = jax.process_index()
+    d = tempfile.mkdtemp(prefix=f"tdn_mh_io_p{pid}_")
+    mgr = CheckpointManager(d, keep=2)
+    mgr.save(1, {"w": np.ones(4) * (pid + 1)})
+    first_ok = mgr.latest_step() == (1 if pid == 0 else None)
+
+    if pid == 0:
+        mgr.directory = pathlib.Path(d) / "vanished"  # mkstemp will fail
+    raised = False
+    try:
+        mgr.save(2, {"w": np.ones(4)})
+    except ValueError:
+        raised = True
+    return {"first_ok": bool(first_ok), "raised": raised}
+
+
 def _global_dataset():
     from tpu_dist_nn.data.datasets import Dataset
     import numpy as np

@@ -148,6 +148,14 @@ def test_two_process_zero1_checkpoint_resume_without_shared_fs():
     assert r0["retention_raised"] and r1["retention_raised"]
 
 
+def test_two_process_checkpoint_io_failure_fails_everyone():
+    """Process 0's write failure is broadcast: both processes raise the
+    same ValueError instead of host 1 hanging in the next collective."""
+    r0, r1 = _run_pair("checkpoint_io_failure_agreed")
+    assert r0["first_ok"] and r1["first_ok"]
+    assert r0["raised"] and r1["raised"]
+
+
 def _single_process_step_reference() -> dict:
     import optax
 

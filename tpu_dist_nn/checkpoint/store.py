@@ -137,15 +137,15 @@ class CheckpointManager:
             )
         return None
 
-    def _agree_valid(self, err: str | None) -> None:
-        """Raise the manifest-derived validation error on EVERY process.
+    def _agree_valid(self, err: str | None, what: str = "save") -> None:
+        """Raise a process-0-local failure on EVERY process.
 
-        In a multi-host job on non-shared filesystems only process 0's
-        manifest has steps, so a process-0-only raise before/inside the
-        save collective would leave the other processes entering the
-        gather alone — a hang, not a clean failure. Broadcast the
-        verdict first (the sentinel pattern resume_or_init uses) so all
-        processes exit the same way.
+        In a multi-host job only process 0 touches the filesystem, so a
+        process-0-only raise (retention validation against its manifest,
+        an IO error from the write) would leave the other processes
+        proceeding into the job's next collective alone — a hang, not a
+        clean failure. Broadcast the verdict (the sentinel pattern
+        resume_or_init uses) so all processes exit the same way.
         """
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -156,7 +156,7 @@ class CheckpointManager:
             failed = int(multihost_utils.broadcast_one_to_all(flag))
             if failed:
                 raise ValueError(
-                    err or "process 0 rejected the save (see its log)"
+                    err or f"process 0 failed the {what} (see its log)"
                 )
         elif err is not None:
             raise ValueError(err)
@@ -197,8 +197,23 @@ class CheckpointManager:
 
         step = int(step)
         state = to_host_numpy(state)  # collective; all procs reach it
-        self._agree_valid(self._retention_error(step))
-        return self._save_local(step, state, metadata)
+        err = self._retention_error(step)
+        if jax.process_count() == 1:
+            if err is not None:
+                raise ValueError(err)
+            return self._save_local(step, state, metadata)
+        # Multi-host: retention verdict and any IO failure from process
+        # 0 (the only writer) fold into ONE agreement broadcast — a
+        # process-0-only raise would leave the other processes marching
+        # into the next training-step collective alone.
+        path = self._path(step)
+        if jax.process_index() == 0 and err is None:
+            try:
+                self._save_local(step, state, metadata)
+            except Exception as e:  # noqa: BLE001 — re-raised on every process
+                err = f"checkpoint write failed on process 0: {e!r}"
+        self._agree_valid(err, what="checkpoint write")
+        return path
 
     def restore(self, template: Any, step: int | None = None) -> tuple[int, Any]:
         """Restore ``step`` (default: newest intact) into ``template``.
@@ -303,19 +318,36 @@ class AsyncCheckpointManager(CheckpointManager):
         if self._closed:
             # Enqueueing with no consumer would deadlock a later wait().
             raise RuntimeError("AsyncCheckpointManager is closed")
-        self._raise_pending()
         step = int(step)
-        # Both collectives happen HERE on the caller thread, where every
+        # All collectives happen HERE on the caller thread, where every
         # process reaches save() at the same step: the cross-process
-        # all-gather, and the retention-validation broadcast. The
-        # manifest on disk lags behind queued-but-unwritten saves, so
-        # validation also counts the pending steps.
+        # all-gather, then ONE agreement broadcast covering both
+        # retention validation (the on-disk manifest lags queued saves,
+        # so pending steps count too) and any earlier async-writer
+        # failure on process 0 — raising either on process 0 alone
+        # before the gather would strand the other processes in it.
         from tpu_dist_nn.parallel.multihost import to_host_numpy
 
         state = to_host_numpy(state)
-        self._agree_valid(
-            self._retention_error(step, extra_steps=tuple(self._pending_steps))
+        err = self._retention_error(
+            step, extra_steps=tuple(self._pending_steps)
         )
+        if jax.process_count() == 1:
+            self._raise_pending()  # original exception type, locally
+            if err is not None:
+                raise ValueError(err)
+        else:
+            if self._error is not None and jax.process_index() == 0:
+                # Consume the failure (as _raise_pending would): a
+                # transient writer error must not leave checkpointing
+                # permanently dead on this process while the peers
+                # recovered.
+                pending, self._error = self._error, None
+                err = (
+                    f"async checkpoint writer failed on process 0: "
+                    f"{pending!r}"
+                )
+            self._agree_valid(err)
         self._pending_steps.append(step)
         self._queue.put((step, state, metadata))
         return self._path(step)
