@@ -41,11 +41,14 @@ def test_greedy_generation_matches_teacher_forced_oracle():
     got = generate(params, CFG, prompt, n_new)
 
     # Oracle: grow the sequence one token at a time through the full
-    # batched forward (no cache) and take argmax each step.
+    # batched forward (no cache) and take argmax each step. Jitted per
+    # length: the growing-shape eager loop re-executes op-by-op every
+    # run, while the 10 small compiles land in the persistent cache.
+    jfwd = jax.jit(forward, static_argnums=2)
     seq = prompt
     want = []
     for _ in range(n_new):
-        logits = forward(params, seq, CFG)
+        logits = jfwd(params, seq, CFG)
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         want.append(nxt)
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)

@@ -146,6 +146,8 @@ class CheckpointManager:
         proceeding into the job's next collective alone — a hang, not a
         clean failure. Broadcast the verdict (the sentinel pattern
         resume_or_init uses) so all processes exit the same way.
+        Callers invoke this from their multi-host branches; the
+        single-process fallback raises locally for symmetry.
         """
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -371,10 +373,36 @@ class AsyncCheckpointManager(CheckpointManager):
 
 
 def flush(checkpoints) -> None:
-    """Make enqueued saves durable; no-op for sync managers/None."""
+    """Make enqueued saves durable; no-op for sync managers/None.
+
+    Multi-host: every process reaches the trainers' end-of-loop flush
+    together, so THIS is where a final async-writer failure on process
+    0 gets broadcast (a later save() would normally agree on it, but
+    the last saves of a run have no later save). ``wait()`` itself
+    stays collective-free because the resume path calls it on process
+    0 alone (resume_or_init).
+    """
     wait = getattr(checkpoints, "wait", None)
-    if wait is not None:
+    if wait is None:
+        return
+    err: BaseException | None = None
+    try:
         wait()
+    except BaseException as e:  # noqa: BLE001 — re-raised below
+        err = e
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        flag = np.int64(
+            1 if (err is not None and jax.process_index() == 0) else 0
+        )
+        failed = int(multihost_utils.broadcast_one_to_all(flag))
+        if failed and err is None:
+            raise RuntimeError(
+                "async checkpoint flush failed on process 0 (see its log)"
+            )
+    if err is not None:
+        raise err
 
 
 def _host_zeros_like(leaf):
