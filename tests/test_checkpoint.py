@@ -357,3 +357,32 @@ def test_hetero_clip_with_grad_accum_rejected():
             hp, data,
             TrainConfig(epochs=1, batch_size=24, clip_norm=1.0, grad_accum=2),
         )
+
+
+def test_flush_unwinding_skips_agreement_broadcast(tmp_path, monkeypatch):
+    # On the exception path, flush must stay collective-free: the peers
+    # may still be mid-step, and a broadcast here would pair with a
+    # mismatched collective and hang (ADVICE r2, store.flush docstring).
+    from tpu_dist_nn.checkpoint import AsyncCheckpointManager
+    from tpu_dist_nn.checkpoint import store as store_mod
+
+    calls = []
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    from jax.experimental import multihost_utils
+
+    def _broadcast(x):
+        calls.append(x)
+        return x
+
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", _broadcast)
+
+    mgr = AsyncCheckpointManager(tmp_path)
+    # Unwinding: wait() runs (saves durable) but no collective is issued.
+    store_mod.flush(mgr, unwinding=True)
+    assert calls == []
+    # Clean exit: the agreement broadcast runs.
+    store_mod.flush(mgr)
+    assert len(calls) == 1
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    mgr.close()

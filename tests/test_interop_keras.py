@@ -121,3 +121,23 @@ def test_cli_import_keras(tmp_path):
         oracle_forward_batch(model, x), np.asarray(net(x)),
         rtol=1e-5, atol=1e-6,
     )
+
+
+def test_keras_dense_no_bias_imports_with_zero_bias():
+    # Dense(use_bias=False) has a single 2-D weight; the schema always
+    # carries a bias, so it imports with zeros (ADVICE r2).
+    net = keras.Sequential(
+        [
+            keras.layers.Input((10,)),
+            keras.layers.Dense(6, activation="relu", use_bias=False),
+            keras.layers.Dense(4, activation="softmax"),
+        ]
+    )
+    model = model_from_keras(net)
+    assert model.layer_sizes == [10, 6, 4]
+    np.testing.assert_array_equal(model.layers[0].biases, np.zeros(6))
+    x = np.random.default_rng(2).uniform(0, 1, (5, 10)).astype(np.float32)
+    np.testing.assert_allclose(
+        oracle_forward_batch(model, x), np.asarray(net(x)),
+        rtol=1e-5, atol=1e-6,
+    )

@@ -250,3 +250,32 @@ def test_quantize_honors_metadata_distribution(tmp_path):
     eng = Engine.up(p, quantize="int8")
     assert eng.pipelined and eng._q_pp is not None
     assert eng.infer(np.asarray(x)).shape == (8, 4)
+
+
+def test_pipeline_filler_slots_pass_through_exactly():
+    # A stage with fewer real layers than L must NOT round-trip its
+    # activations through per-row int8 at the identity filler slots
+    # (ADVICE r2): the pipelined int8 path agrees with the single-chip
+    # int8 path to float tolerance, not just the 2e-2 int8 bound.
+    from tpu_dist_nn.core.schema import partition_model
+    from tpu_dist_nn.kernels.quantized import quantize_pipeline_weights
+    from tpu_dist_nn.models.fcnn import spec_from_params
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pipeline import (
+        build_pipeline_params,
+        pipeline_forward_quantized,
+    )
+
+    params, x = _params_and_x(sizes=(24, 32, 16, 4), batch=16)
+    acts = ["relu", "relu", "softmax"]
+    model = spec_from_params(params, acts)
+    # Distribution [2, 1]: stage 1 gets one real layer + one identity
+    # filler slot (L = 2).
+    stages = partition_model(model, [2, 1])
+    pp = build_pipeline_params(stages)
+    q = quantize_pipeline_weights(pp.weights)
+    mesh = build_mesh(MeshSpec(stage=2))
+    got = pipeline_forward_quantized(mesh, q, pp.meta, np.asarray(x))
+    want = forward_quantized(quantize_fcnn(params), x, acts)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

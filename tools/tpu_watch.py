@@ -1,0 +1,206 @@
+"""Tunnel-watch: poll the TPU backend all session; capture proof when up.
+
+Rounds 1-2 recorded zero TPU numbers because the tunneled backend was
+down at the single moment bench ran (VERDICT r2 weak item 1: "probes
+run once at bench time" — no mechanism to catch the tunnel when it
+returns). This tool is that mechanism: a bounded background poll of
+``utils/backend.py``'s subprocess probe, and the moment the backend
+answers it runs, in order,
+
+  1. ``python bench.py``                    -> artifacts/BENCH_tpu_{tag}.json
+  2. ``TDN_TEST_TPU=1 pytest tests/test_tpu_hardware.py``
+                                            -> artifacts/tpu_hardware_{tag}.log
+  3. ``python tools/tpu_capture.py``        -> artifacts/tpu_pipeline_{tag}.json
+                                               + profiler trace dir
+
+then ``git commit``s the artifacts (bounded retries around a concurrent
+index.lock). Every probe attempt is appended to
+``artifacts/tpu_watch_{tag}.log`` with a timestamp, so even an
+all-session-down round leaves committed evidence of the polling (the
+round-2 ``tpu_probe_r02.txt`` pattern, now automatic).
+
+Each capture step runs in a SUBPROCESS with its own timeout: the
+backend is known to hang rather than fail (utils/backend.py docstring),
+and a probe success only proves it answered once.
+
+Usage:  python tools/tpu_watch.py --tag r03 --interval 240 --hours 11
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def _append(path: str, line: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(line.rstrip("\n") + "\n")
+
+
+def _run(cmd, timeout, env=None, log=None):
+    """Run a capture step; returns (rc, stdout, stderr); rc=124 on timeout."""
+    merged = dict(os.environ, **(env or {}))
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO, env=merged,
+        )
+        return out.returncode, out.stdout, out.stderr
+    except subprocess.TimeoutExpired as e:
+        return 124, (e.stdout or ""), (e.stderr or "")
+
+
+def _git_commit(paths: list[str], message: str, watch_log: str) -> None:
+    """add+commit with retries: the build session commits concurrently."""
+    for attempt in range(10):
+        add = subprocess.run(
+            ["git", "add", "--"] + paths, cwd=REPO,
+            capture_output=True, text=True,
+        )
+        if add.returncode == 0:
+            commit = subprocess.run(
+                ["git", "commit", "-m", message], cwd=REPO,
+                capture_output=True, text=True,
+            )
+            if commit.returncode == 0:
+                _append(watch_log, f"{_now()} committed: {message}")
+                return
+            err = commit.stderr + commit.stdout
+        else:
+            err = add.stderr
+        if "index.lock" not in err and "nothing to commit" not in err:
+            _append(watch_log, f"{_now()} git failed: {err.strip()[-200:]}")
+        if "nothing to commit" in err:
+            return
+        time.sleep(30)
+    _append(watch_log, f"{_now()} giving up on git commit ({message})")
+
+
+def capture_all(tag: str, watch_log: str) -> bool:
+    """Backend is up: run the three captures; True if all artifacts landed."""
+    art = os.path.join(REPO, "artifacts")
+    os.makedirs(art, exist_ok=True)
+    produced: list[str] = []
+    ok = True
+
+    # 1. The headline bench (full MFU path; probe inside is quick now).
+    rc, out, err = _run([sys.executable, "bench.py"], timeout=900)
+    bench_path = os.path.join(art, f"BENCH_tpu_{tag}.json")
+    line = next(
+        (ln for ln in out.splitlines() if ln.startswith("{")), None
+    )
+    with open(bench_path, "w") as f:
+        f.write((line or json.dumps({"error": f"rc={rc}", "stderr": err[-500:]})) + "\n")
+    produced.append(bench_path)
+    bench_on_tpu = bool(line) and rc == 0 and "cpu-fallback" not in line
+    ok &= bench_on_tpu
+    _append(watch_log, f"{_now()} bench rc={rc} on_tpu={bench_on_tpu}")
+
+    # 2. The five hardware parity gates.
+    rc, out, err = _run(
+        [sys.executable, "-m", "pytest", "tests/test_tpu_hardware.py",
+         "-q", "--no-header"],
+        timeout=1200,
+        env={"TDN_TEST_TPU": "1"},
+    )
+    hw_path = os.path.join(art, f"tpu_hardware_{tag}.log")
+    with open(hw_path, "w") as f:
+        f.write(f"# {_now()} TDN_TEST_TPU=1 pytest tests/test_tpu_hardware.py -q"
+                f" (rc={rc})\n")
+        f.write(out[-8000:])
+        if err:
+            f.write("\n--- stderr ---\n" + err[-2000:])
+    produced.append(hw_path)
+    hw_green = rc == 0 and " passed" in out and "skipped" not in out
+    ok &= hw_green
+    _append(watch_log, f"{_now()} hardware gates rc={rc} green={hw_green}")
+
+    # 3. Pipelined step latency (the BASELINE p50 metric) + device trace.
+    trace_dir = os.path.join(art, f"trace_{tag}")
+    rc, out, err = _run(
+        [sys.executable, "tools/tpu_capture.py", "--trace-dir", trace_dir],
+        timeout=900,
+    )
+    cap_path = os.path.join(art, f"tpu_pipeline_{tag}.json")
+    line = next((ln for ln in out.splitlines() if ln.startswith("{")), None)
+    with open(cap_path, "w") as f:
+        f.write((line or json.dumps({"error": f"rc={rc}", "stderr": err[-500:]})) + "\n")
+    produced.append(cap_path)
+    ok &= rc == 0
+    _append(watch_log, f"{_now()} capture rc={rc}")
+    # Commit the trace only if it stayed small (plugins/profile/*.pb).
+    trace_bytes = sum(
+        os.path.getsize(os.path.join(r, f))
+        for r, _, fs in os.walk(trace_dir) for f in fs
+    ) if os.path.isdir(trace_dir) else 0
+    if 0 < trace_bytes < 20 * 1024 * 1024:
+        produced.append(trace_dir)
+    _append(watch_log, f"{_now()} trace bytes={trace_bytes}")
+
+    produced.append(watch_log)
+    _git_commit(
+        produced,
+        f"Real-TPU artifacts ({tag}): bench, hardware gates, "
+        "pipeline latency + trace",
+        watch_log,
+    )
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tag", default="r03")
+    ap.add_argument("--interval", type=float, default=240.0,
+                    help="seconds between probe attempts")
+    ap.add_argument("--hours", type=float, default=11.0)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    from tpu_dist_nn.utils.backend import probe_default_backend
+
+    watch_log = os.path.join(REPO, "artifacts", f"tpu_watch_{args.tag}.log")
+    deadline = time.monotonic() + args.hours * 3600
+    _append(watch_log, f"{_now()} tunnel-watch start (interval "
+                       f"{args.interval:.0f}s, {args.hours:.1f}h budget)")
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        msgs: list[str] = []
+        probed = probe_default_backend(
+            timeout=args.probe_timeout, tries=1, log=msgs.append,
+        )
+        if probed is not None and probed[0] != "cpu":
+            _append(watch_log,
+                    f"{_now()} attempt {attempt}: backend UP "
+                    f"({probed[0]}/{probed[1]}) — capturing")
+            if capture_all(args.tag, watch_log):
+                _append(watch_log, f"{_now()} all captures green; exiting")
+                return 0
+            _append(watch_log,
+                    f"{_now()} captures incomplete; continuing to poll")
+        else:
+            why = "; ".join(msgs) or "resolved to cpu"
+            _append(watch_log, f"{_now()} attempt {attempt}: down ({why})")
+        time.sleep(max(0.0, min(args.interval,
+                                deadline - time.monotonic())))
+    _append(watch_log, f"{_now()} deadline reached; backend never answered")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -469,10 +469,16 @@ class Engine:
                 if config.batch_size % d == 0
             )
             if mb != self.num_microbatches:
-                log.info(
+                # mb == 1 means NO pipeline overlap at all (e.g. a prime
+                # batch size): the user configured a pipelined placement
+                # but training would fully serialize — warn, don't bury.
+                log.log(
+                    logging.WARNING if mb == 1 else logging.INFO,
                     "train: using %d microbatches (engine's %d does not "
-                    "divide batch_size %d)",
+                    "divide batch_size %d)%s",
                     mb, self.num_microbatches, config.batch_size,
+                    " — pipelined training fully serializes; choose a "
+                    "batch size with a divisor > 1" if mb == 1 else "",
                 )
             params_list, history = train_hetero(
                 self._hp, train_data, config,

@@ -372,15 +372,24 @@ class AsyncCheckpointManager(CheckpointManager):
         self._raise_pending()
 
 
-def flush(checkpoints) -> None:
+def flush(checkpoints, *, unwinding: bool = False) -> None:
     """Make enqueued saves durable; no-op for sync managers/None.
 
     Multi-host: every process reaches the trainers' end-of-loop flush
-    together, so THIS is where a final async-writer failure on process
-    0 gets broadcast (a later save() would normally agree on it, but
-    the last saves of a run have no later save). ``wait()`` itself
-    stays collective-free because the resume path calls it on process
-    0 alone (resume_or_init).
+    together ON THE CLEAN-EXIT PATH, so that is where a final
+    async-writer failure on process 0 gets broadcast (a later save()
+    would normally agree on it, but the last saves of a run have no
+    later save). ``wait()`` itself stays collective-free because the
+    resume path calls it on process 0 alone (resume_or_init).
+
+    ``unwinding=True`` marks the exception path: a host-local failure
+    mid-epoch (data error, local OOM, KeyboardInterrupt on one host)
+    reaches this flush while the peers are still issuing training-step
+    collectives, so entering a broadcast here would pair with a
+    mismatched collective and convert a clean crash into a hang. On
+    that path the flush is plain wait()+local raise, collective-free —
+    the enqueued saves still become durable, only the cross-process
+    agreement is skipped.
     """
     wait = getattr(checkpoints, "wait", None)
     if wait is None:
@@ -390,7 +399,7 @@ def flush(checkpoints) -> None:
         wait()
     except BaseException as e:  # noqa: BLE001 — re-raised below
         err = e
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and not unwinding:
         from jax.experimental import multihost_utils
 
         flag = np.int64(

@@ -43,6 +43,16 @@ def _dense_triples(model) -> list[tuple[str, np.ndarray, np.ndarray, str]]:
     triples = []
     for layer in model.layers:
         weights = layer.get_weights()
+        if (
+            len(weights) == 1
+            and np.ndim(weights[0]) == 2
+            and getattr(layer, "use_bias", None) is False
+        ):
+            # Dense(use_bias=False): a single 2-D kernel. The schema
+            # always carries a bias, so import with zeros — numerically
+            # identical. The use_bias gate keeps other single-2D-weight
+            # layers (e.g. Embedding) on the error path below.
+            weights = [weights[0], np.zeros(weights[0].shape[1])]
         if len(weights) != 2 or np.ndim(weights[0]) != 2:
             cls = type(layer).__name__
             if cls in ("InputLayer", "Flatten", "Dropout"):

@@ -168,10 +168,12 @@ def quantize_pipeline_weights(weights) -> dict:
     Same symmetric scheme as :func:`quantize_fcnn`, applied to every
     padded layer slot: real blocks quantize over their embedded
     [in_dim, out_dim] region (rows beyond ``in_dim`` are zero and do not
-    move the column max); identity filler slots quantize to exactly
-    ±127·(1/127) — pass-through survives to ~1 ulp, and the executor's
-    width masks (``PipelineMeta.grad_masks`` geometry) keep padding
-    columns at exactly zero either way.
+    move the column max). Identity filler slots are quantized too, but
+    the executor never uses them: ``_stage_apply_quantized`` carries a
+    per-slot ``real`` mask (from ``PipelineMeta.in_width``) and passes
+    activations through filler slots EXACTLY, so no per-row activation
+    re-quantization noise accumulates on stages with fewer real layers
+    than L.
     """
     w = np.asarray(weights.w, np.float32)  # (S, L, D, D)
     absmax = np.maximum(np.abs(w).max(axis=2), 1e-8)  # (S, L, D)

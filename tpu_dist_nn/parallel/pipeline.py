@@ -233,11 +233,17 @@ def compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: b
     return run
 
 
-def _stage_apply_quantized(wq, scale, b, act, width, x):
+def _stage_apply_quantized(wq, scale, b, act, width, real, x):
     """Int8 variant of :func:`_stage_apply`: per-row activation
     quantization + int8×int8→int32 MXU matmul + rescale, per layer slot
     (the same arithmetic as the single-chip path,
     kernels/quantized.py:_int8_layer, under the pipeline's width masks).
+
+    ``real``: (L,) bool — identity filler slots pass ``x`` through
+    EXACTLY instead of round-tripping it through per-row int8
+    quantization (each such round-trip would add up to ~rowmax/254
+    error per element, so stages with fewer real layers than L would
+    otherwise accumulate avoidable noise vs the single-chip int8 path).
     """
     from tpu_dist_nn.kernels.quantized import _quantize_rows
 
@@ -249,7 +255,7 @@ def _stage_apply_quantized(wq, scale, b, act, width, x):
             preferred_element_type=jnp.int32,
         )
         y = z.astype(jnp.float32) * (sx * scale[li][None, :]) + b[li]
-        x = _masked_activation(y, act[li], width[li])
+        x = jnp.where(real[li], _masked_activation(y, act[li], width[li]), x)
     return x
 
 
@@ -262,11 +268,12 @@ def compiled_pipeline_quantized(mesh, meta: PipelineMeta, num_microbatches: int)
 
     act = jnp.asarray(meta.act_array(False))
     width = jnp.asarray(meta.width_array())
+    real = jnp.asarray(np.asarray(meta.in_width, np.int32) > 0)
 
     def stage_fn(params, x):
         return _stage_apply_quantized(
             params["wq"], params["scale"], params["b"],
-            params["act"], params["width"], x,
+            params["act"], params["width"], params["real"], x,
         )
 
     mapped = make_gpipe(
@@ -281,7 +288,7 @@ def compiled_pipeline_quantized(mesh, meta: PipelineMeta, num_microbatches: int)
     def run(q, xs):
         stage_params = {
             "wq": q["wq"], "scale": q["scale"], "b": q["b"],
-            "act": act, "width": width,
+            "act": act, "width": width, "real": real,
         }
         out = mapped(xs, stage_params)
         m, bsz, _ = out.shape

@@ -275,9 +275,16 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
                     i + 1, {"params": params, "opt_state": opt_state},
                     metadata={"step": i + 1, "loss": float(loss)},
                 )
-    finally:
+    except BaseException:
         # Enqueued async saves become durable even when the loop
-        # raises — the crash-resume guarantee is the point.
+        # raises — the crash-resume guarantee is the point. On this
+        # path peers may still be mid-step, so the flush must stay
+        # collective-free (store.flush docstring). An
+        # exc_info check inside a finally would misfire under a
+        # caller's active except handler; the explicit re-raise cannot.
+        flush(checkpoints, unwinding=True)
+        raise
+    else:
         flush(checkpoints)
     if pipelined:
         if schedule == "interleaved":

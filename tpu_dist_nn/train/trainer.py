@@ -12,6 +12,7 @@ trains the pipelined layout across a mesh.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 
 import jax
@@ -25,6 +26,8 @@ from tpu_dist_nn.data.feed import batch_iterator
 from tpu_dist_nn.models.fcnn import forward, forward_logits, spec_from_params
 from tpu_dist_nn.checkpoint.store import flush
 from tpu_dist_nn.train.metrics import classification_metrics
+
+log = logging.getLogger("tpu_dist_nn.train")
 
 
 @dataclasses.dataclass
@@ -174,9 +177,16 @@ def run_training_loop(
                     {"params": params, "opt_state": opt_state},
                     metadata=record,
                 )
-    finally:
+    except BaseException:
         # Enqueued async saves become durable even when the loop
-        # raises — the crash-resume guarantee is the point.
+        # raises — the crash-resume guarantee is the point. On this
+        # path peers may still be mid-step, so the flush must stay
+        # collective-free (store.flush docstring). An
+        # exc_info check inside a finally would misfire under a
+        # caller's active except handler; the explicit re-raise cannot.
+        flush(checkpoints, unwinding=True)
+        raise
+    else:
         flush(checkpoints)
     return params, history
 
@@ -206,9 +216,10 @@ def train_fcnn(
         data_size = mesh.shape.get(AXIS_DATA, 1)
     if mesh is not None and data_size > 1 and jax.process_count() == 1:
         if config.batch_size % data_size:
-            import logging
-
-            logging.getLogger(__name__).info(
+            # warning on the package logger (the one the CLI configures,
+            # engine.py's pattern): a silent downgrade from data-parallel
+            # to single-device training must be visible in library use.
+            log.warning(
                 "train: batch_size %d not divisible by data axis %d; "
                 "training single-device", config.batch_size, data_size,
             )
