@@ -1,0 +1,102 @@
+"""Vendor the UCI handwritten-digits set as IDX files (real data, no egress).
+
+Round-2 verdict: every accuracy number in the repo was synthetic because
+real MNIST needs network egress. This closes the real-data gap with the
+one real handwritten-digit dataset already ON the box: scikit-learn's
+bundled copy of the UCI ML "Optical Recognition of Handwritten Digits"
+test set — 1,797 genuine 8x8 grayscale scans of digits written by 43
+people (sklearn.datasets.load_digits; shipped as package data inside
+sklearn, `sklearn/datasets/data/digits.csv.gz`). It is NOT MNIST — the
+full-MNIST ≥97 % recipe stays a one-command run for a connected machine
+(docs/MNIST.md) — but it is real handwriting, so accuracy on its held-out
+split is a real generalization number, unlike the synthetic sets.
+
+Output: gzipped IDX files (the MNIST wire format, SURVEY C12 analogue;
+parsed by data/datasets.py:load_idx_images) under
+``tpu_dist_nn/data/digits/``:
+
+    train-images-idx3-ubyte.gz / train-labels-idx1-ubyte.gz   (1438)
+    t10k-images-idx3-ubyte.gz  / t10k-labels-idx1-ubyte.gz    (359)
+
+Pixels are rescaled 0..16 -> 0..255 uint8 (round(v * 255/16), injective
+on the 17 integer levels — a lossless linear recode, not resampling) so
+the files behave exactly like MNIST IDX: uint8 intensities normalized
+by /255 at load. The split is a deterministic stratified 80/20
+(seed 0): every class keeps its proportion in the held-out set.
+
+Deterministic: re-running reproduces the committed bytes.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tpu_dist_nn", "data", "digits",
+)
+
+
+def write_idx_images(path: str, imgs: np.ndarray) -> None:
+    """imgs: (N, rows, cols) uint8 -> IDX3, gzipped (mtime=0: stable bytes)."""
+    n, rows, cols = imgs.shape
+    payload = struct.pack(">IIII", 0x0803, n, rows, cols) + imgs.tobytes()
+    with open(path, "wb") as f:
+        f.write(gzip.compress(payload, mtime=0))
+
+
+def write_idx_labels(path: str, labels: np.ndarray) -> None:
+    payload = struct.pack(">II", 0x0801, len(labels)) + labels.astype(
+        np.uint8
+    ).tobytes()
+    with open(path, "wb") as f:
+        f.write(gzip.compress(payload, mtime=0))
+
+
+def main() -> int:
+    from sklearn.datasets import load_digits
+
+    bunch = load_digits()
+    x = bunch.images  # (1797, 8, 8) float, integer values 0..16
+    y = bunch.target.astype(np.uint8)
+    assert x.min() >= 0 and x.max() <= 16
+    imgs = np.round(x * (255.0 / 16.0)).astype(np.uint8)
+
+    # Stratified 80/20: per class, a seeded shuffle, last 20% held out.
+    rng = np.random.default_rng(0)
+    train_idx, test_idx = [], []
+    for c in range(10):
+        idx = np.flatnonzero(y == c)
+        idx = idx[rng.permutation(len(idx))]
+        k = int(round(len(idx) * 0.8))
+        train_idx.append(idx[:k])
+        test_idx.append(idx[k:])
+    train_idx = np.sort(np.concatenate(train_idx))
+    test_idx = np.sort(np.concatenate(test_idx))
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    write_idx_images(
+        os.path.join(OUT_DIR, "train-images-idx3-ubyte.gz"), imgs[train_idx]
+    )
+    write_idx_labels(
+        os.path.join(OUT_DIR, "train-labels-idx1-ubyte.gz"), y[train_idx]
+    )
+    write_idx_images(
+        os.path.join(OUT_DIR, "t10k-images-idx3-ubyte.gz"), imgs[test_idx]
+    )
+    write_idx_labels(
+        os.path.join(OUT_DIR, "t10k-labels-idx1-ubyte.gz"), y[test_idx]
+    )
+    print(
+        f"wrote {len(train_idx)} train / {len(test_idx)} test real digits "
+        f"to {OUT_DIR}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
