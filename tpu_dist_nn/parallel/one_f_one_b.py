@@ -66,6 +66,7 @@ def make_1f1b(
     *,
     microbatch_spec=None,
     stage_params_spec=None,
+    stage_static_spec=None,
     aux_spec=None,
     want_dx0: bool = True,
 ):
@@ -98,11 +99,17 @@ def make_1f1b(
     again) and its end-of-scan psum are skipped entirely and the dx0
     slot returns a scalar zero.
 
-    Restriction: ``stage_fn``/``tail_fn`` must not contain collectives
-    (the 1F1B tick wraps them in ``lax.switch``/``lax.cond`` branches,
-    where a collective would need every mesh participant to take the
-    same branch). Intra-stage tensor parallelism therefore stays on the
-    GPipe schedule for now.
+    Collectives inside ``stage_fn``/``tail_fn``: allowed over mesh axes
+    on which the tick predicate is INVARIANT — the predicate depends
+    only on ``(t, stage index)``, so every participant of a collective
+    over a disjoint axis (``model``, ``seq``, ``expert``) takes the same
+    branch at the same tick and the collective pairs correctly inside
+    the ``lax.switch``. Megatron tensor parallelism (psums over
+    ``model``, tensor_parallel.tp_block_apply) therefore composes with
+    this schedule — see transformer_pipeline.make_pipeline_tp_lm_1f1b_grad.
+    Still banned: collectives over ``stage`` or ``data`` inside the
+    bodies (the predicate varies over ``stage``, and the executor owns
+    the ``data``-axis reduction itself, once, after the scan).
     """
     S, M = num_stages, num_microbatches
     K = min(S, M)
@@ -114,6 +121,11 @@ def make_1f1b(
         microbatch_spec = P(AXIS_DATA)
     if stage_params_spec is None:
         stage_params_spec = P(AXIS_STAGE)
+    if stage_static_spec is None:
+        # A plain per-leaf default, NOT stage_params_spec: that may be a
+        # pytree of specs (e.g. the Megatron per-leaf dict) whose
+        # structure the static operand does not share.
+        stage_static_spec = P(AXIS_STAGE)
     if aux_spec is None:
         aux_spec = P(None, *microbatch_spec)
     xs_spec = P(None, *microbatch_spec)
@@ -136,21 +148,35 @@ def make_1f1b(
         def fwd_only(p, x):
             return stage_fn(p, st, x)
 
-        def vcast(z):
-            # Idempotent "mark varying over (stage, data)": zeros_like of
-            # an already-varying tracer is itself varying, and pcast
+        def mark_varying(z, axes):
+            # Idempotent "mark varying over `axes`": zeros_like of an
+            # already-varying tracer is itself varying, and pcast
             # rejects re-adding axes.
             have = getattr(jax.typeof(z), "vma", frozenset())
-            need = tuple(a for a in vary if a not in have)
+            need = tuple(a for a in axes if a not in have)
             return lax.pcast(z, need, to="varying") if need else z
+
+        def vcast(z):
+            return mark_varying(z, vary)
+
+        def zeros_like_vma(ref):
+            # Grad accumulators must carry the PRIMAL leaf's varying
+            # axes: a model-sharded Megatron leaf (varying over `model`)
+            # accumulates per-shard cotangents, so an accumulator left
+            # invariant over `model` would fail the vma check at the
+            # first add.
+            return mark_varying(
+                jnp.zeros(ref.shape, ref.dtype),
+                getattr(jax.typeof(ref), "vma", frozenset()),
+            )
 
         zeros_wire = vcast(jnp.zeros(mb_shape, dt))
         carry0 = (
             zeros_wire,                                  # activations from s-1
             zeros_wire,                                  # grads from s+1
             vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
-            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), sp),
-            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), tp),
+            jax.tree.map(zeros_like_vma, sp),
+            jax.tree.map(zeros_like_vma, tp),
             # dx cotangents at stage 0 (skipped when not wanted: the
             # M-sized buffer would re-couple live memory to M).
             vcast(jnp.zeros((M if want_dx0 else 1, *mb_shape), dt)),
@@ -261,7 +287,7 @@ def make_1f1b(
         in_specs=(
             xs_spec,
             stage_params_spec,
-            stage_params_spec,
+            stage_static_spec,
             P(),
             aux_spec,
         ),

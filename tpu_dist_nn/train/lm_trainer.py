@@ -85,7 +85,8 @@ def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
 def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
                                 num_microbatches: int, optimizer,
                                 attn_fn=None, schedule: str = "gpipe",
-                                num_virtual: int = 1):
+                                num_virtual: int = 1,
+                                tensor_parallel: int = 1):
     """Pipelined train step.
 
     ``schedule``: "gpipe" (AD through the forward schedule; blocks in
@@ -96,12 +97,32 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     chunks per device, blocks in
     :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks_interleaved`
     layout — bubble cut to 2(S-1) chunk-ticks).
+
+    ``tensor_parallel > 1`` Megatron-shards each stage's blocks over the
+    mesh's ``model`` axis (blocks in
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks_pp_tp`
+    layout) and composes with "gpipe" AND "1f1b" — the memory-flat
+    schedule tolerates the block psums because its tick predicate is
+    model-invariant (one_f_one_b.make_1f1b docstring). Interleaved x TP
+    is not implemented yet.
     """
+    from tpu_dist_nn.parallel.mesh import AXIS_MODEL
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
     attn = _resolve_attn_fn(attn_fn)
+    if tensor_parallel > 1 and mesh.shape.get(AXIS_MODEL, 1) != tensor_parallel:
+        raise ValueError(
+            f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
+            f"axis has size {mesh.shape.get(AXIS_MODEL, 1)}"
+        )
     if schedule == "interleaved":
+        if tensor_parallel > 1:
+            raise ValueError(
+                "schedule='interleaved' with tensor_parallel > 1 is not "
+                "implemented; use schedule='1f1b' for the memory-flat "
+                "schedule with Megatron stages"
+            )
         from tpu_dist_nn.parallel.transformer_pipeline import (
             make_pipeline_lm_interleaved_grad,
         )
@@ -111,12 +132,32 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
         )
         return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule == "1f1b":
+        if tensor_parallel > 1:
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                make_pipeline_tp_lm_1f1b_grad,
+            )
+
+            vag = make_pipeline_tp_lm_1f1b_grad(
+                mesh, cfg, num_stages, num_microbatches, attn
+            )
+        else:
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                make_pipeline_lm_1f1b_grad,
+            )
+
+            vag = make_pipeline_lm_1f1b_grad(
+                mesh, cfg, num_stages, num_microbatches, attn
+            )
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+    if tensor_parallel > 1:
         from tpu_dist_nn.parallel.transformer_pipeline import (
-            make_pipeline_lm_1f1b_grad,
+            make_pipeline_tp_lm_loss,
         )
 
-        vag = make_pipeline_lm_1f1b_grad(mesh, cfg, num_stages, num_microbatches, attn)
-        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+        loss_fn = make_pipeline_tp_lm_loss(
+            mesh, cfg, num_stages, num_microbatches, attn
+        )
+        return jax.jit(make_step_body(loss_fn, optimizer))
     loss_fn = make_pipeline_lm_loss(mesh, cfg, num_stages, num_microbatches, attn)
     return jax.jit(make_step_body(loss_fn, optimizer))
 
