@@ -240,3 +240,170 @@ def test_doctor_serving_failure_is_unhealthy(capsys, monkeypatch):
     out = _json.loads(capsys.readouterr().out)
     assert rc == 1 and out["healthy"] is False
     assert out["serving"]["round_trip"] is False
+
+
+class _SlowEngine:
+    """Fake engine with a fixed per-LAUNCH cost — models the device
+    dispatch latency that request coalescing amortizes (on the real
+    tunneled TPU each engine.infer pays a host->device round trip; on
+    the CPU test host that cost is near zero, so the mechanism is
+    benchmarked against a controlled launch cost instead)."""
+
+    def __init__(self, launch_seconds=0.010, dim=8):
+        import dataclasses
+        self.launch_seconds = launch_seconds
+        self.launches = 0
+        self.model = dataclasses.make_dataclass("M", ["input_dim"])(dim)
+
+    def infer(self, x):
+        import time as _t
+        self.launches += 1
+        _t.sleep(self.launch_seconds)
+        return np.asarray(x) * 2.0
+
+
+def _round_trip_rounds(port, rows, rounds):
+    import time as _t
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpu_dist_nn.serving import GrpcClient
+
+    clients = [GrpcClient(f"127.0.0.1:{port}") for _ in range(len(rows))]
+    with ThreadPoolExecutor(max_workers=len(rows)) as ex:
+        def volley():
+            return list(
+                ex.map(lambda cr: cr[0].process(cr[1]), zip(clients, rows))
+            )
+
+        volley()  # warm
+        t0 = _t.monotonic()
+        outs = [volley() for _ in range(rounds)]
+        dt = _t.monotonic() - t0
+    for c in clients:
+        c.close()
+    return dt / rounds, outs[-1]
+
+
+def test_coalescing_beats_lock_when_launches_dominate():
+    # VERDICT r2 item 4's bar: >2x aggregate throughput for 10
+    # concurrent single-row clients vs the serialized engine lock, in
+    # the regime coalescing targets (launch-cost-bound serving).
+    from tpu_dist_nn.serving import serve_engine
+
+    rows = [np.full((1, 8), i, np.float64) for i in range(10)]
+
+    eng_lock = _SlowEngine()
+    server, port = serve_engine(eng_lock, 0, host="127.0.0.1", coalesce=False)
+    t_lock, _ = _round_trip_rounds(port, rows, rounds=5)
+    server.stop(0)
+
+    eng_co = _SlowEngine()
+    server, port = serve_engine(eng_co, 0, host="127.0.0.1", coalesce=True)
+    t_co, outs = _round_trip_rounds(port, rows, rounds=5)
+    stats = (server.batcher.requests_total, server.batcher.batches_total)
+    server.stop(0)
+
+    # Wire parity: every client got exactly its own rows back.
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(out, rows[i] * 2.0)
+    # The HARD gate is structural — far fewer device launches than
+    # requests (the quantity coalescing controls); the wall-clock ratio
+    # (10 serial launches vs ~3-4 coalesced per volley, 100ms vs
+    # ~30-40ms at 10ms/launch) is additionally asserted with margin for
+    # scheduler jitter on a loaded 1-core runner.
+    assert stats[1] < stats[0] / 2, stats
+    assert t_lock / t_co > 2.0, (
+        f"speedup {t_lock / t_co:.2f}x "
+        f"(lock {t_lock*1e3:.1f}ms, coalesced {t_co*1e3:.1f}ms)"
+    )
+
+
+def test_coalescing_real_engine_parity_and_no_regression(served_engine):
+    # The real engine behind the coalescing path: concurrent mixed-size
+    # requests each get exactly their own slice of the shared batch.
+    from tpu_dist_nn.serving import serve_engine
+
+    engine, _, _ = served_engine
+    server, port = serve_engine(
+        engine, 0, host="127.0.0.1", coalesce=True, warm_rows=16
+    )
+    try:
+        rng = np.random.default_rng(7)
+        dim = engine.model.input_dim
+        rows = [rng.uniform(0, 1, (1 + i % 3, dim)) for i in range(10)]
+        _, outs = _round_trip_rounds(port, rows, rounds=3)
+        for i, out in enumerate(outs):
+            want = np.asarray(engine.infer(rows[i]), np.float64)
+            np.testing.assert_allclose(out, want, rtol=1e-6, atol=1e-9)
+        assert server.batcher.batches_total < server.batcher.requests_total
+    finally:
+        server.stop(0)
+
+
+def test_coalescing_dim_mismatch_fails_alone(served_engine):
+    # A wrong-width request must abort with INVALID_ARGUMENT without
+    # poisoning the shared batch of concurrent good requests.
+    import grpc
+
+    from tpu_dist_nn.serving import GrpcClient, serve_engine
+
+    engine, _, _ = served_engine
+    server, port = serve_engine(engine, 0, host="127.0.0.1", coalesce=True)
+    try:
+        dim = engine.model.input_dim
+        good = GrpcClient(f"127.0.0.1:{port}")
+        bad = GrpcClient(f"127.0.0.1:{port}")
+        with pytest.raises(grpc.RpcError) as e:
+            bad.process(np.zeros((1, dim + 3)))
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        out = good.process(np.zeros((2, dim)))
+        assert out.shape[0] == 2
+    finally:
+        server.stop(0)
+
+
+def test_batcher_width_guard_without_declared_input_dim():
+    # Engine without model.input_dim: the handler cannot pre-validate,
+    # so the batcher groups coalesced requests by feature width and
+    # launches per group — a wrong-width request gets the ENGINE's own
+    # dim error while concurrent well-formed requests still succeed.
+    import grpc
+
+    from tpu_dist_nn.serving import GrpcClient, serve_engine
+    from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+    class NoDimEngine:
+        def infer(self, x):
+            x = np.asarray(x)
+            if x.shape[1] != 8:
+                raise InvalidArgumentError(
+                    f"expected input of shape (N, 8), got {tuple(x.shape)}"
+                )
+            return x + 1.0
+
+    server, port = serve_engine(NoDimEngine(), 0, host="127.0.0.1",
+                                coalesce=True)
+    try:
+        from concurrent.futures import ThreadPoolExecutor
+
+        clients = [GrpcClient(f"127.0.0.1:{port}") for _ in range(4)]
+        xs = [np.zeros((1, 8)), np.zeros((1, 8)), np.zeros((1, 5)),
+              np.zeros((1, 8))]
+
+        def call(i):
+            try:
+                return clients[i].process(xs[i])
+            except grpc.RpcError as e:
+                return e
+
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            outs = list(ex.map(call, range(4)))
+        # The 5-wide request fails with the engine's dim error no
+        # matter which batch it joined; every 8-wide request succeeds.
+        assert isinstance(outs[2], grpc.RpcError)
+        assert outs[2].code() == grpc.StatusCode.INVALID_ARGUMENT
+        for i in (0, 1, 3):
+            assert isinstance(outs[i], np.ndarray), outs[i]
+            np.testing.assert_array_equal(outs[i], np.ones((1, 8)))
+    finally:
+        server.stop(0)

@@ -228,7 +228,11 @@ def cmd_up(args) -> int:
     if args.grpc_port is not None:
         from tpu_dist_nn.serving import serve_engine
 
-        server, bound = serve_engine(engine, args.grpc_port)
+        # warm_rows precompiles the request-coalescing bucket shapes so
+        # the first concurrent burst doesn't pay XLA compiles mid-flight.
+        server, bound = serve_engine(
+            engine, args.grpc_port, warm_rows=args.serve_warm_rows
+        )
         print(json.dumps({"grpc_port": bound}), flush=True)
 
         def teardown():
@@ -1134,6 +1138,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "endpoint on this port (wire-compatible with "
                         "run_grpc_inference.py; its stage-0 port is 5101) "
                         "and stay up until Ctrl-C")
+    p.add_argument("--serve-warm-rows", type=int, default=64,
+                   help="precompile request-coalescing bucket shapes up "
+                        "to this many rows before opening the port "
+                        "(0 disables)")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
