@@ -168,3 +168,100 @@ def test_interleaved_dense_chunk_count_mismatch():
     mesh = build_mesh(MeshSpec(stage=2, data=2))
     with pytest.raises(ValueError, match="distribution"):
         compiled_interleaved_dense_grad(mesh, params.meta, 2, 4, jnp.float32)
+
+
+def test_engine_interleaved_inference_parity(tmp_path):
+    # VERDICT r2 item 7: the interleaved (virtual-stage) schedule on the
+    # ENGINE inference path. A 4-chunk dense model on 2 stage devices
+    # (v=2) must reproduce the plain pipelined engine bit-for-bit.
+    import numpy as np
+
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.testing.factories import random_model
+
+    model = random_model([12, 10, 8, 6, 4], seed=11)
+    path = tmp_path / "m.json"
+    save_model(model, path)
+    x = np.random.default_rng(12).uniform(0, 1, (23, 12))
+
+    ref = Engine.up(path, [1, 1, 1, 1]).infer(x)
+    eng = Engine.up(path, [1, 1, 1, 1], virtual_stages=2, data_parallel=2)
+    assert eng.placement()["virtual_stages"] == 2
+    assert eng.placement()["devices"] == 4  # 2 stage devices x 2 data
+    got = eng.infer(x)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-8)
+
+    # Interleaved TRAINING stays trainer-level: clear error, not a
+    # shape explosion inside the pipelined trainer.
+    from tpu_dist_nn.data.datasets import Dataset
+    from tpu_dist_nn.train.trainer import TrainConfig
+
+    data = Dataset(
+        np.random.default_rng(0).uniform(0, 1, (24, 12)).astype(np.float32),
+        np.random.default_rng(0).integers(0, 4, 24).astype(np.int32), 4,
+    )
+    with pytest.raises(ValueError, match="interleaved TRAINING"):
+        eng.train(data, TrainConfig(epochs=1, batch_size=8))
+
+
+def test_cli_infer_virtual_stages(tmp_path, capsys):
+    import numpy as np
+
+    from tpu_dist_nn.cli import main
+    from tpu_dist_nn.core.schema import save_examples, save_model
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.testing.oracle import oracle_forward_batch
+
+    model = random_model([10, 8, 8, 6, 4], seed=13)
+    mp = tmp_path / "m.json"
+    save_model(model, mp)
+    rng = np.random.default_rng(14)
+    x = rng.uniform(0, 1, (12, 10))
+    labels = oracle_forward_batch(model, x).argmax(-1)
+    save_examples(x, labels, tmp_path / "e.json")
+    rc = main([
+        "infer", "--config", str(mp), "--inputs", str(tmp_path / "e.json"),
+        "--distribution", "1,1,1,1", "--virtual-stages", "2",
+    ])
+    assert rc == 0
+    assert "accuracy 1.0000" in capsys.readouterr().out
+
+
+def test_forward_table_builder_rejects_and_verifies():
+    from tpu_dist_nn.parallel.schedule_table import (
+        build_interleaved_forward,
+        verify_tables,
+    )
+
+    with pytest.raises(ValueError, match=">= 1"):
+        build_interleaved_forward(0, 2, 2)
+    # A healthy table re-verifies (the builder already did once).
+    tb = build_interleaved_forward(2, 3, 5)
+    verify_tables(tb, forward_only=True)
+    assert tb.num_chunks == 6 and tb.ticks >= 5 * 3
+
+
+def test_engine_virtual_stages_validation_and_degrade(tmp_path):
+    import numpy as np
+
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.testing.factories import random_model
+    from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+    model = random_model([12, 10, 8, 6, 4], seed=15)
+    path = tmp_path / "m.json"
+    save_model(model, path)
+
+    with pytest.raises(InvalidArgumentError, match=">= 1"):
+        Engine.up(path, [1, 1, 1, 1], virtual_stages=0)
+    with pytest.raises(InvalidArgumentError, match="divisible"):
+        Engine.up(path, [2, 1, 1], virtual_stages=2)
+
+    # Device shortage degrades to single-chip (the plain placement's
+    # contract), it does not hard-fail.
+    eng = Engine.up(path, [1, 1, 1, 1], virtual_stages=2, data_parallel=8)
+    assert not eng.pipelined and eng.virtual_stages == 1
+    x = np.random.default_rng(16).uniform(0, 1, (5, 12))
+    assert eng.infer(x).shape == (5, 4)

@@ -88,7 +88,7 @@ class Engine:
 
     def __init__(self, model: ModelSpec, distribution, mesh_spec: MeshSpec,
                  num_microbatches: int, dtype, devices=None,
-                 quantize: str | None = None):
+                 quantize: str | None = None, virtual_stages: int = 1):
         # Fail fast on quantize mode/placement BEFORE building any
         # placement state (matches up()'s fail-fast convention).
         if quantize is not None:
@@ -104,6 +104,13 @@ class Engine:
                     "layers have no int8 path); it composes with pipeline "
                     "and data-parallel placements"
                 )
+            if virtual_stages > 1:
+                raise InvalidArgumentError(
+                    "quantize='int8' does not compose with the interleaved "
+                    "(virtual-stage) placement yet; drop --virtual-stages "
+                    "or serve f32"
+                )
+        self.virtual_stages = int(virtual_stages)
         # Copy metadata so export()'s annotations never mutate a
         # ModelSpec the caller still holds.
         self.model = ModelSpec(model.layers, dict(model.metadata))
@@ -111,7 +118,10 @@ class Engine:
         self.mesh_spec = mesh_spec
         self.num_microbatches = num_microbatches
         self.dtype = dtype
-        self.pipelined = mesh_spec.stage > 1
+        # Interleaved placements pipeline V = stage*v chunks over a
+        # stage-axis mesh of size V/v, so stage==1 with v>1 still runs
+        # the (virtual-stage) pipeline executor.
+        self.pipelined = mesh_spec.stage > 1 or virtual_stages > 1
         self.mesh = build_mesh(mesh_spec, devices)
         # Pure data parallelism on a single-stage plan: batch sharded
         # over the data axis, params replicated.
@@ -171,6 +181,7 @@ class Engine:
         devices=None,
         warmup: bool = True,
         quantize: str | None = None,
+        virtual_stages: int = 1,
     ) -> "Engine":
         """Validate, place, compile; returns a ready engine.
 
@@ -178,6 +189,12 @@ class Engine:
         ``engine.setup_seconds`` (run_grpc_fcnn.py:321-322 parity).
         ``quantize="int8"`` serves the dense chain through the fused
         int8 Pallas path (f32 masters kept for train/export).
+
+        ``virtual_stages=v > 1`` selects the INTERLEAVED (virtual-stage)
+        inference placement: the distribution's ``V`` entries become
+        ``V`` pipeline chunks with chunk ``c`` on device ``c % (V/v)``
+        — a V-chunk pipeline on V/v devices, served by the table-driven
+        forward executor (parallel/interleaved.make_interleaved_forward).
         """
         t0 = time.monotonic()
         if not isinstance(model, ModelSpec):
@@ -191,29 +208,65 @@ class Engine:
 
         n_devices = len(devices or jax.devices())
         stages = len(distribution)
-        if stages > 1 and not model.is_dense and data_parallel > 1:
-            # The heterogeneous executor pins one stage per device and
-            # has no data axis; pipeline placement wins.
-            log.info(
-                "placement: non-dense pipeline ignores data_parallel=%d",
-                data_parallel,
+        if virtual_stages < 1:
+            from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"virtual_stages must be >= 1, got {virtual_stages}"
             )
-            data_parallel = 1
-        if stages * data_parallel > n_devices:
-            log.info(
-                "placement: %d stages x %d data shards exceed %d device(s); "
-                "collapsing to the single-chip executor",
-                stages, data_parallel, n_devices,
-            )
-            mesh_spec = MeshSpec(stage=1, data=1)
-            distribution = [len(model.layers)]
+        if virtual_stages > 1:
+            from tpu_dist_nn.utils.errors import InvalidArgumentError
+
+            if not model.is_dense:
+                raise InvalidArgumentError(
+                    "virtual_stages applies to dense pipelined models "
+                    "(the heterogeneous executor pins one stage per device)"
+                )
+            if stages % virtual_stages:
+                raise InvalidArgumentError(
+                    f"distribution has {stages} entries (chunks), not "
+                    f"divisible by virtual_stages={virtual_stages}"
+                )
+            stage_devices = stages // virtual_stages
+            if stage_devices * data_parallel > n_devices:
+                # Same graceful-degradation contract as the plain
+                # placement below: serve single-chip rather than fail.
+                log.info(
+                    "placement: interleaved %d stage device(s) x %d data "
+                    "shards exceed %d device(s); collapsing to the "
+                    "single-chip executor",
+                    stage_devices, data_parallel, n_devices,
+                )
+                virtual_stages = 1
+                mesh_spec = MeshSpec(stage=1, data=1)
+                distribution = [len(model.layers)]
+            else:
+                mesh_spec = MeshSpec(stage=stage_devices, data=data_parallel)
         else:
-            mesh_spec = MeshSpec(stage=stages, data=data_parallel)
-        if mesh_spec.stage == 1:
-            distribution = [len(model.layers)]
+            if stages > 1 and not model.is_dense and data_parallel > 1:
+                # The heterogeneous executor pins one stage per device
+                # and has no data axis; pipeline placement wins.
+                log.info(
+                    "placement: non-dense pipeline ignores data_parallel=%d",
+                    data_parallel,
+                )
+                data_parallel = 1
+            if stages * data_parallel > n_devices:
+                log.info(
+                    "placement: %d stages x %d data shards exceed %d "
+                    "device(s); collapsing to the single-chip executor",
+                    stages, data_parallel, n_devices,
+                )
+                mesh_spec = MeshSpec(stage=1, data=1)
+                distribution = [len(model.layers)]
+            else:
+                mesh_spec = MeshSpec(stage=stages, data=data_parallel)
+            if mesh_spec.stage == 1:
+                distribution = [len(model.layers)]
 
         engine = cls(model, distribution, mesh_spec, num_microbatches, dtype,
-                     devices, quantize=quantize)
+                     devices, quantize=quantize,
+                     virtual_stages=virtual_stages)
         if warmup:
             # Compilation is the readiness check (the analogue of the
             # orchestrator's TCP poll, run_grpc_fcnn.py:157-172).
@@ -230,6 +283,8 @@ class Engine:
             "data_parallel": self.mesh_spec.data,
             "pipelined": self.pipelined,
         }
+        if self.virtual_stages > 1:
+            base["virtual_stages"] = self.virtual_stages
         if self._hp is not None:
             base.update(self._hp.placement_summary())
         elif self.pipelined:
@@ -281,6 +336,17 @@ class Engine:
 
                 out = pipeline_forward_quantized(
                     self.mesh, self._q_pp, self._pp.meta, x,
+                    num_microbatches=self.num_microbatches,
+                )
+                return to_host_numpy(out)
+            if self.virtual_stages > 1:
+                from tpu_dist_nn.parallel.pipeline import (
+                    pipeline_forward_interleaved,
+                )
+
+                out = pipeline_forward_interleaved(
+                    self.mesh, self._pp, x,
+                    num_virtual=self.virtual_stages,
                     num_microbatches=self.num_microbatches,
                 )
                 return to_host_numpy(out)
@@ -434,14 +500,15 @@ class Engine:
         from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
         validate_schedule(schedule)
-        if schedule == "interleaved":
+        if schedule == "interleaved" or self.virtual_stages > 1:
             raise ValueError(
-                "schedule='interleaved' is not available through the engine: "
-                "its placement serves inference on a chunk-per-device mesh, "
-                "while virtual stages need a smaller device mesh. Use "
-                "tdn lm --schedule interleaved (LM family, end to end) or "
+                "interleaved TRAINING is not available through the engine "
+                "(inference is: Engine.up(..., virtual_stages=v) / "
+                "tdn infer --virtual-stages). Use tdn lm --schedule "
+                "interleaved (LM family, end to end) or "
                 "make_pipeline_train_step(..., schedule='interleaved', "
-                "num_virtual=v) for dense chains at the trainer level."
+                "num_virtual=v) / compiled_interleaved_dense_grad for "
+                "dense chains at the trainer level."
             )
         # The heterogeneous executor trains through its own hand-rolled
         # GPipe schedule (train_hetero), which has no 1f1b variant.

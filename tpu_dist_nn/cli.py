@@ -169,6 +169,11 @@ def _add_up_args(p, config_required=True):
     p.add_argument("--quantize", choices=["int8"],
                    help="serve through the fused int8 kernel "
                         "(dense single-chip only)")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="interleaved (virtual-stage) inference placement: "
+                        "the distribution's V entries become V pipeline "
+                        "chunks on V/v devices, chunk c on device c %% "
+                        "(V/v) (Megatron placement, table-driven forward)")
 
 
 def _engine_from_args(args, warmup=True):
@@ -181,6 +186,7 @@ def _engine_from_args(args, warmup=True):
         num_microbatches=getattr(args, "microbatches", 4),
         warmup=warmup,
         quantize=getattr(args, "quantize", None),
+        virtual_stages=getattr(args, "virtual_stages", 1),
     )
 
 

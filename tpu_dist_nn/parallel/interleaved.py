@@ -265,3 +265,130 @@ def make_interleaved_1f1b(
         ),
         out_specs=(P(), chunk_params_spec, P(), xs_spec if want_dx0 else P()),
     )
+
+
+def make_interleaved_forward(
+    mesh,
+    stage_fn,
+    num_virtual: int,
+    num_microbatches: int,
+    *,
+    microbatch_spec=None,
+    chunk_params_spec=None,
+    tables: ScheduleTables | None = None,
+):
+    """Forward-only (inference) interleaved executor.
+
+    The inference leg of :func:`make_interleaved_1f1b`: plays back a
+    :func:`~tpu_dist_nn.parallel.schedule_table.build_interleaved_forward`
+    table — FWD/IDLE ticks only, activations on the ``s -> s+1 (mod S)``
+    ring, no stash/cotangents — and collects the LAST chunk's output
+    per microbatch. Same ``stage_fn(chunk_params, chunk_static, x)``
+    contract and ``(S, v, ...)`` chunk layout as the training executor.
+
+    Returns ``f(xs, chunk_params, chunk_static) -> (M, *microbatch_shape)``.
+    """
+    from tpu_dist_nn.parallel.schedule_table import build_interleaved_forward
+
+    S = mesh.shape[AXIS_STAGE]
+    v, M = num_virtual, num_microbatches
+    V = S * v
+    if tables is None:
+        tables = build_interleaved_forward(S, v, M)
+    if (tables.num_devices, tables.num_chunks, tables.num_microbatches) != (S, V, M):
+        raise ValueError("tables do not match (S, v, M)")
+    T, A = tables.ticks, tables.abuf_slots
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    vary = (AXIS_STAGE, AXIS_DATA)
+    if microbatch_spec is None:
+        microbatch_spec = P(AXIS_DATA)
+    if chunk_params_spec is None:
+        chunk_params_spec = P(AXIS_STAGE)
+    xs_spec = P(None, *microbatch_spec)
+    tb = {
+        name: jnp.asarray(getattr(tables, name))
+        for name in ("op", "chunk", "mb", "abuf_read", "abuf_write")
+    }
+
+    def device_fn(xs, chunk_params, chunk_static):
+        sp = jax.tree.map(lambda a: a[0], chunk_params)
+        st = jax.tree.map(lambda a: a[0], chunk_static)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        mb_shape = xs.shape[1:]
+        dt = xs.dtype
+
+        def vcast(z):
+            have = getattr(jax.typeof(z), "vma", frozenset())
+            need = tuple(a for a in vary if a not in have)
+            return lax.pcast(z, need, to="varying") if need else z
+
+        row = {
+            k: lax.dynamic_index_in_dim(val, s_idx, 0, keepdims=False)
+            for k, val in tb.items()
+        }
+        zeros_wire = vcast(jnp.zeros(mb_shape, dt))
+        carry0 = (
+            zeros_wire,                            # fwd ring payload
+            vcast(jnp.zeros((A, *mb_shape), dt)),  # activation recv buf
+            vcast(jnp.zeros((M, *mb_shape), dt)),  # per-mb outputs
+        )
+
+        def tick(carry, t):
+            fwd_wire, abuf, outs = carry
+            aw = row["abuf_write"][t]
+            abuf = jnp.where(
+                aw >= 0,
+                lax.dynamic_update_index_in_dim(
+                    abuf, fwd_wire, jnp.clip(aw, 0, A - 1), 0
+                ),
+                abuf,
+            )
+            g_slot = row["chunk"][t]
+            f = row["mb"][t]
+            c_global = g_slot * S + s_idx
+            pc = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, g_slot, 0, keepdims=False),
+                sp,
+            )
+            stc = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, g_slot, 0, keepdims=False),
+                st,
+            )
+
+            def idle(_):
+                return zeros_wire, outs
+
+            def fwd(_):
+                ar = row["abuf_read"][t]
+                feed = lax.dynamic_index_in_dim(xs, f, 0, keepdims=False)
+                buf = lax.dynamic_index_in_dim(
+                    abuf, jnp.clip(ar, 0, A - 1), 0, keepdims=False
+                )
+                x_in = jnp.where(ar < 0, feed, buf)
+                y = stage_fn(pc, stc, x_in)
+                is_last = c_global == V - 1
+                new_outs = jnp.where(
+                    is_last,
+                    lax.dynamic_update_index_in_dim(outs, y, f, 0),
+                    outs,
+                )
+                return jnp.where(is_last, zeros_wire, y), new_outs
+
+            send_y, outs = lax.switch(row["op"][t], [idle, fwd], 0)
+            with jax.named_scope("interleaved_fwd_ring_hop"):
+                nxt = (
+                    lax.ppermute(send_y, AXIS_STAGE, fwd_perm)
+                    if S > 1 else send_y
+                )
+            return (nxt, abuf, outs), None
+
+        (_w, _a, outs), _ = lax.scan(tick, carry0, jnp.arange(T))
+        # Outputs live only on the last chunk's device (S-1): replicate.
+        return lax.psum(outs, AXIS_STAGE)
+
+    return jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(xs_spec, chunk_params_spec, chunk_params_spec),
+        out_specs=xs_spec,
+    )

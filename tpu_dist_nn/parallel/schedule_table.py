@@ -314,7 +314,106 @@ def build_interleaved_1f1b(
     return out
 
 
-def verify_tables(tb: ScheduleTables) -> None:
+def build_interleaved_forward(
+    num_devices: int, num_virtual: int, num_microbatches: int
+) -> ScheduleTables:
+    """Compile a FORWARD-ONLY interleaved schedule (inference).
+
+    Same placement as :func:`build_interleaved_1f1b` — global chunk
+    ``c`` on device ``c % S``, local slot ``c // S`` — but ticks carry
+    only FWD/IDLE ops: microbatches stream through the ``V = S*v``
+    chunk ring and the last chunk's outputs are the results. Greedy
+    list-scheduling (earliest microbatch, deepest ready chunk) under
+    the same one-op-per-device, one-tick-transport model;
+    slot-allocated receive buffers; verified by
+    :func:`verify_tables` (which skips backward bookkeeping when no
+    BWD op exists). The stash is unused for inference: ``stash`` stays
+    0 with one dummy slot.
+    """
+    S, v, M = num_devices, num_virtual, num_microbatches
+    if S < 1 or v < 1 or M < 1:
+        raise ValueError(f"need S,v,M >= 1, got {S},{v},{M}")
+    V = S * v
+    fwd_done = np.full((V, M), -1, dtype=np.int64)
+    abuf_pool = [_SlotPool() for _ in range(S)]
+    abuf_slot: dict[tuple[int, int], int] = {}
+    cols: list[dict] = []
+    next_fwd = [0] * V
+    done_ops = 0
+    t = 0
+    max_ticks = 4 * (M * v + S) + 16
+    while done_ops < V * M:
+        if t > max_ticks:
+            raise RuntimeError(
+                f"forward schedule did not converge (S={S}, v={v}, M={M})"
+            )
+        col = [dict(op=IDLE) for _ in range(S)]
+        for s in range(S):
+            best = None
+            for c in range(s, V, S):
+                f = next_fwd[c]
+                if f >= M:
+                    continue
+                if c > 0 and (fwd_done[c - 1, f] < 0 or fwd_done[c - 1, f] + 1 > t):
+                    continue
+                key = (f, -c)
+                if best is None or key < best[0]:
+                    best = (key, c, f)
+            if best is not None:
+                col[s] = dict(op=FWD, c=best[1], f=best[2])
+        for s in range(S):
+            rec = col[s]
+            if rec["op"] != FWD:
+                continue
+            c, f = rec["c"], rec["f"]
+            if c > 0:
+                rslot = abuf_slot.pop((c, f))
+                rec["abuf_read"] = rslot
+                abuf_pool[s].release(rslot)
+            fwd_done[c, f] = t
+            next_fwd[c] = f + 1
+            done_ops += 1
+            if c < V - 1:
+                rs = (c + 1) % S
+                wslot = abuf_pool[rs].acquire()
+                abuf_slot[(c + 1, f)] = wslot
+                rec["send_abuf_slot"] = wslot
+        cols.append(col)
+        t += 1
+
+    T = len(cols)
+    A = max(p.high for p in abuf_pool) or 1
+    tables = {
+        name: np.full((S, T), fill, dtype=np.int32)
+        for name, fill in [
+            ("op", IDLE), ("chunk", 0), ("mb", 0), ("stash", 0),
+            ("abuf_read", -1), ("gbuf_read", -1),
+            ("abuf_write", -1), ("gbuf_write", -1), ("is_c0", 0),
+        ]
+    }
+    for t_i, col in enumerate(cols):
+        for s in range(S):
+            rec = col[s]
+            if rec["op"] == IDLE:
+                continue
+            c, f = rec["c"], rec["f"]
+            tables["op"][s, t_i] = FWD
+            tables["chunk"][s, t_i] = c // S
+            tables["mb"][s, t_i] = f
+            tables["abuf_read"][s, t_i] = rec.get("abuf_read", -1)
+            if "send_abuf_slot" in rec:
+                rs = (c + 1) % S
+                tables["abuf_write"][rs, t_i + 1] = rec["send_abuf_slot"]
+
+    out = ScheduleTables(
+        num_devices=S, num_chunks=V, num_microbatches=M, ticks=T,
+        abuf_slots=A, gbuf_slots=1, stash_slots=1, **tables,
+    )
+    verify_tables(out, forward_only=True)
+    return out
+
+
+def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
     """Replay the tables with symbolic values; raise on any flaw.
 
     Checks: every FWD consumes exactly the activation its upstream chunk
@@ -372,7 +471,8 @@ def verify_tables(tb: ScheduleTables) -> None:
                             f"t={t} s={s}: fwd({c},{f}) read {x}, "
                             f"wanted act({c - 1},{f})"
                         )
-                stash[s][int(tb.stash[s, t])] = ("x", c, f)
+                if not forward_only:
+                    stash[s][int(tb.stash[s, t])] = ("x", c, f)
                 new_fwd_sent[ (c + 1) % S ] = ("act", c, f) if c < V - 1 else None
                 fwd_count[c, f] += 1
             else:
@@ -399,8 +499,14 @@ def verify_tables(tb: ScheduleTables) -> None:
                 bwd_count[c, f] += 1
         fwd_sent, bwd_sent = new_fwd_sent, new_bwd_sent
 
-    if not (fwd_count == 1).all() or not (bwd_count == 1).all():
-        raise AssertionError("schedule did not run every (chunk, mb) exactly once")
+    if not (fwd_count == 1).all():
+        raise AssertionError(
+            "schedule did not run every (chunk, mb) FORWARD exactly once"
+        )
+    if not forward_only and not (bwd_count == 1).all():
+        raise AssertionError(
+            "schedule did not run every (chunk, mb) BACKWARD exactly once"
+        )
     if any(abuf[s] for s in range(S)) or any(gbuf[s] for s in range(S)):
         raise AssertionError("unconsumed receive-buffer values at end")
     if any(stash[s] for s in range(S)):

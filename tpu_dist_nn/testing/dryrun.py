@@ -136,6 +136,21 @@ def dryrun_multichip(n_devices: int) -> None:
     jax.block_until_ready(weights)
     assert float(loss) > 0, "1f1b training step produced a non-positive CE loss"
 
+    # Interleaved (virtual-stage) INFERENCE placement: V = 2*stage
+    # chunks on the same stage axis, table-driven forward executor
+    # (engine --virtual-stages path, round 3).
+    if stage > 1:
+        from tpu_dist_nn.parallel.pipeline import pipeline_forward_interleaved
+
+        sizes_v = [12] + [8] * (2 * stage - 1) + [4]
+        model_v = random_model(sizes_v, seed=1)
+        pp_v = build_pipeline_params(partition_model(model_v, [1] * (2 * stage)))
+        out = pipeline_forward_interleaved(
+            mesh, pp_v, bx[: 2 * data], num_virtual=2, num_microbatches=2
+        )
+        jax.block_until_ready(out)
+        assert out.shape == (2 * data, 4)
+
     if n_devices % 2 == 0:
         _dryrun_transformer_sp_tp(n_devices)
         _dryrun_moe_ep(n_devices)
