@@ -208,15 +208,22 @@ class Engine:
 
         n_devices = len(devices or jax.devices())
         stages = len(distribution)
-        if virtual_stages < 1:
-            from tpu_dist_nn.utils.errors import InvalidArgumentError
+        from tpu_dist_nn.utils.errors import InvalidArgumentError
 
+        if virtual_stages < 1:
             raise InvalidArgumentError(
                 f"virtual_stages must be >= 1, got {virtual_stages}"
             )
         if virtual_stages > 1:
-            from tpu_dist_nn.utils.errors import InvalidArgumentError
-
+            if quantize is not None:
+                # Checked HERE, before the device-shortage degrade can
+                # reset virtual_stages: the flag combination must fail
+                # the same way on every host size.
+                raise InvalidArgumentError(
+                    "quantize='int8' does not compose with the "
+                    "interleaved (virtual-stage) placement yet; drop "
+                    "--virtual-stages or serve f32"
+                )
             if not model.is_dense:
                 raise InvalidArgumentError(
                     "virtual_stages applies to dense pipelined models "
