@@ -186,13 +186,15 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
 
     # Int8 serving path: the quantized chain on the same workload
     # (fused Pallas on TPU, jnp int8 elsewhere — kernels/quantized.py
-    # picks per backend/VMEM fit).
-    from tpu_dist_nn.kernels.quantized import (
-        fcnn_quantized_forward,
-        quantize_fcnn,
-    )
-
+    # picks per backend/VMEM fit). The import lives INSIDE the guard:
+    # a backend where the pallas import itself fails must degrade to
+    # int8_resident=null, not lose the already-measured headline.
     try:
+        from tpu_dist_nn.kernels.quantized import (
+            fcnn_quantized_forward,
+            quantize_fcnn,
+        )
+
         qp = quantize_fcnn(params)
         int8_apply = jax.jit(
             lambda q, bx: fcnn_quantized_forward(

@@ -191,18 +191,8 @@ def test_engine_interleaved_inference_parity(tmp_path):
     assert eng.placement()["devices"] == 4  # 2 stage devices x 2 data
     got = eng.infer(x)
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-8)
-
-    # Interleaved TRAINING stays trainer-level: clear error, not a
-    # shape explosion inside the pipelined trainer.
-    from tpu_dist_nn.data.datasets import Dataset
-    from tpu_dist_nn.train.trainer import TrainConfig
-
-    data = Dataset(
-        np.random.default_rng(0).uniform(0, 1, (24, 12)).astype(np.float32),
-        np.random.default_rng(0).integers(0, 4, 24).astype(np.int32), 4,
-    )
-    with pytest.raises(ValueError, match="interleaved TRAINING"):
-        eng.train(data, TrainConfig(epochs=1, batch_size=8))
+    # (Engine-level interleaved TRAINING is covered by
+    # test_engine_interleaved_dense_training_matches_gpipe.)
 
 
 def test_cli_infer_virtual_stages(tmp_path, capsys):
@@ -265,3 +255,44 @@ def test_engine_virtual_stages_validation_and_degrade(tmp_path):
     assert not eng.pipelined and eng.virtual_stages == 1
     x = np.random.default_rng(16).uniform(0, 1, (5, 12))
     assert eng.infer(x).shape == (5, 4)
+
+
+def test_engine_interleaved_dense_training_matches_gpipe(tmp_path):
+    # Engine-level interleaved dense TRAINING (closes the last scoping
+    # gap): a virtual-stage placement trains through the table-driven
+    # schedule and reproduces the gpipe engine's trajectory on the same
+    # data/seed (the schedules are numerically interchangeable).
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.data.datasets import real_digits
+    from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
+    from tpu_dist_nn.train.trainer import TrainConfig
+
+    params = init_fcnn(jax.random.key(20), [64, 24, 16, 12, 10])
+    path = tmp_path / "m.json"
+    save_model(spec_from_params(params, ["relu"] * 3 + ["softmax"]), path)
+    tr, te = real_digits("train"), real_digits("test")
+    cfg = TrainConfig(epochs=2, batch_size=64)
+
+    eng_g = Engine.up(path, [1, 1, 1, 1])
+    h_g = eng_g.train(tr, cfg, eval_data=te)
+    eng_i = Engine.up(path, [1, 1, 1, 1], virtual_stages=2, data_parallel=2)
+    assert eng_i.placement()["virtual_stages"] == 2
+    h_i = eng_i.train(tr, cfg, eval_data=te)
+    for a, b in zip(h_g, h_i):
+        assert abs(a["loss"] - b["loss"]) < 1e-4
+        assert abs(a["eval"]["accuracy"] - b["eval"]["accuracy"]) < 1e-6
+
+    # The trained interleaved engine exports and re-serves correctly.
+    out = tmp_path / "trained.json"
+    eng_i.export(out)
+    ref = eng_i.infer(te.x)
+    got = Engine.up(out).infer(te.x)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+
+    # 1f1b is meaningless on a virtual placement: clear error.
+    with pytest.raises(ValueError, match="1f1b.*interleaved|interleaved.*1f1b"):
+        eng_i.train(tr, cfg, schedule="1f1b")
+    # And interleaved without the placement points at --virtual-stages.
+    with pytest.raises(ValueError, match="virtual_stages"):
+        eng_g.train(tr, cfg, schedule="interleaved")

@@ -111,6 +111,11 @@ class Engine:
                     "or serve f32"
                 )
         self.virtual_stages = int(virtual_stages)
+        # Engine.up overwrites this with the ORIGINAL request when the
+        # device-shortage degrade resets virtual_stages (train() uses
+        # it to warn-and-fallback instead of raising a contradictory
+        # "pass --virtual-stages" error).
+        self.requested_virtual_stages = int(virtual_stages)
         # Copy metadata so export()'s annotations never mutate a
         # ModelSpec the caller still holds.
         self.model = ModelSpec(model.layers, dict(model.metadata))
@@ -214,6 +219,11 @@ class Engine:
             raise InvalidArgumentError(
                 f"virtual_stages must be >= 1, got {virtual_stages}"
             )
+        # Remember the REQUEST: the device-shortage degrade below may
+        # reset virtual_stages to 1, and train(schedule="interleaved")
+        # must then warn-and-fallback rather than tell the user to pass
+        # the flag they already passed.
+        requested_virtual = virtual_stages
         if virtual_stages > 1:
             if quantize is not None:
                 # Checked HERE, before the device-shortage degrade can
@@ -274,6 +284,7 @@ class Engine:
         engine = cls(model, distribution, mesh_spec, num_microbatches, dtype,
                      devices, quantize=quantize,
                      virtual_stages=virtual_stages)
+        engine.requested_virtual_stages = requested_virtual
         if warmup:
             # Compilation is the readiness check (the analogue of the
             # orchestrator's TCP poll, run_grpc_fcnn.py:157-172).
@@ -498,31 +509,63 @@ class Engine:
 
         ``checkpoints`` (a :class:`tpu_dist_nn.checkpoint.CheckpointManager`)
         turns on epoch-level save + resume for whichever trainer flavor
-        this engine's placement selects. ``schedule`` ("gpipe" | "1f1b")
-        picks the pipeline training schedule; it only applies to the
-        pipelined placement (other placements have no schedule).
+        this engine's placement selects. ``schedule``
+        ("gpipe" | "1f1b" | "interleaved") picks the pipeline training
+        schedule; it only applies to the pipelined placement. An
+        interleaved (``virtual_stages > 1``) placement auto-selects
+        "interleaved" (the default "gpipe" is upgraded; "1f1b" is
+        rejected there — it assumes chunk-per-device); "interleaved" on
+        a non-virtual placement is rejected with a pointer at
+        ``virtual_stages``.
         """
         # Validate regardless of placement: a typo'd schedule on a
         # non-pipelined engine must not silently train with the default.
         from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
         validate_schedule(schedule)
-        if schedule == "interleaved" or self.virtual_stages > 1:
-            raise ValueError(
-                "interleaved TRAINING is not available through the engine "
-                "(inference is: Engine.up(..., virtual_stages=v) / "
-                "tdn infer --virtual-stages). Use tdn lm --schedule "
-                "interleaved (LM family, end to end) or "
-                "make_pipeline_train_step(..., schedule='interleaved', "
-                "num_virtual=v) / compiled_interleaved_dense_grad for "
-                "dense chains at the trainer level."
-            )
+        if self.virtual_stages > 1:
+            # The placement determines the schedule: V chunks on V/v
+            # devices can only run the table-driven interleaved
+            # executors (gpipe/1f1b assume chunk-per-device).
+            if schedule == "1f1b":
+                raise ValueError(
+                    "schedule='1f1b' does not apply to an interleaved "
+                    "(virtual_stages > 1) placement; the schedule is "
+                    "'interleaved' there (the default 'gpipe' auto-"
+                    "selects it)"
+                )
+            if schedule == "gpipe":
+                log.info(
+                    "train: interleaved placement (virtual_stages=%d) "
+                    "selects schedule='interleaved'", self.virtual_stages,
+                )
+            schedule = "interleaved"
+        elif schedule == "interleaved":
+            if self.requested_virtual_stages > 1:
+                # The user DID request a virtual placement; the
+                # device-shortage degrade collapsed it to single-chip.
+                # Honor the degradation contract: train single-chip
+                # with the default schedule instead of raising an error
+                # that tells them to pass the flag they already passed.
+                log.warning(
+                    "train: interleaved placement was collapsed to the "
+                    "single-chip executor at up() (too few devices); "
+                    "training with the default schedule"
+                )
+                schedule = "gpipe"
+            else:
+                raise ValueError(
+                    "schedule='interleaved' needs an interleaved "
+                    "placement: bring the engine up with virtual_stages=v "
+                    "(tdn train --virtual-stages v) so the distribution's "
+                    "V chunks land on V/v devices"
+                )
         # The heterogeneous executor trains through its own hand-rolled
         # GPipe schedule (train_hetero), which has no 1f1b variant.
         if schedule != "gpipe" and (not self.pipelined or self._hp is not None):
             raise ValueError(
-                "schedule='1f1b' applies to the dense pipelined placement "
-                "only (this engine was placed "
+                f"schedule={schedule!r} applies to the dense pipelined "
+                "placement only (this engine was placed "
                 + ("heterogeneous" if self._hp is not None else "single-program")
                 + "); place a dense model with a multi-stage distribution "
                 "to use it"
@@ -572,6 +615,7 @@ class Engine:
                 eval_data=eval_data,
                 checkpoints=checkpoints,
                 schedule=schedule,
+                num_virtual=self.virtual_stages,
             )
             self.model = extract_model(self._pp, self.model, self.distribution)
         elif self._plan is not None:

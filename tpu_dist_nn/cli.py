@@ -443,6 +443,7 @@ def cmd_train(args) -> int:
         _parse_distribution(args.distribution),
         data_parallel=args.data_parallel,
         num_microbatches=args.microbatches,
+        virtual_stages=args.virtual_stages,
     )
 
     import jax as _jax
@@ -1203,10 +1204,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--distribution")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--microbatches", type=int, default=4)
-    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+    p.add_argument("--schedule", choices=["gpipe", "1f1b", "interleaved"],
+                   default="gpipe",
                    help="pipeline training schedule: gpipe (AD through the "
-                        "forward schedule) or 1f1b (activation-recompute, "
-                        "O(stages) live memory)")
+                        "forward schedule), 1f1b (activation-recompute, "
+                        "O(stages) live memory), or interleaved "
+                        "(auto-selected by --virtual-stages placements)")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="interleaved (Megatron virtual-stage) placement: "
+                        "the distribution's V entries become V chunks on "
+                        "V/v devices, trained by the table-driven schedule")
     p.add_argument("--epochs", type=int, default=5)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--lr", type=float, default=1e-3)

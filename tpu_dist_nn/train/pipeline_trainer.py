@@ -146,12 +146,18 @@ def train_pipelined(
     eval_data: Dataset | None = None,
     checkpoints=None,
     schedule: str = "gpipe",
+    num_virtual: int = 1,
 ):
     """Train pipelined weights over the mesh; returns (params, history).
 
     ``checkpoints`` enables epoch-level save/resume of (weights,
     opt_state) — see :mod:`tpu_dist_nn.checkpoint`. Restored leaves are
     re-placed onto the mesh by the step function's shardings.
+
+    ``schedule="interleaved"`` with ``num_virtual=v`` trains the
+    virtual-stage placement (``meta`` describing ``stage*v`` chunks,
+    the engine's ``virtual_stages`` layout); eval then rides the
+    table-driven forward executor.
     """
     weights, meta = params
     data_size = mesh.shape[AXIS_DATA]
@@ -191,7 +197,8 @@ def train_pipelined(
     optimizer = optimizer_for(_dc.replace(config, batch_size=local_bs), train_data)
     opt_state = optimizer.init(weights)
     step = make_pipeline_train_step(
-        mesh, meta, num_microbatches, optimizer, weights.w.dtype, schedule=schedule
+        mesh, meta, num_microbatches, optimizer, weights.w.dtype,
+        schedule=schedule, num_virtual=num_virtual,
     )
 
     from tpu_dist_nn.checkpoint.store import resume_or_init
@@ -242,7 +249,9 @@ def train_pipelined(
             new_params = PipelineParams(weights=weights, meta=meta)
             if eval_data is not None:
                 record["eval"] = evaluate_pipelined(
-                    new_params, mesh, eval_data, num_microbatches=num_microbatches
+                    new_params, mesh, eval_data,
+                    num_microbatches=num_microbatches,
+                    num_virtual=num_virtual,
                 )
             history.append(record)
             if checkpoints is not None:
@@ -272,6 +281,7 @@ def evaluate_pipelined(
     *,
     num_microbatches: int = 1,
     batch_size: int = 1024,
+    num_virtual: int = 1,
 ) -> dict:
     from tpu_dist_nn.parallel.multihost import to_host_numpy
 
@@ -280,6 +290,18 @@ def evaluate_pipelined(
         # Every host evaluates the SAME full set (pipeline_forward
         # splits each batch across hosts and the gather below restores
         # it), so metrics come out identical everywhere.
-        out = pipeline_forward(mesh, params, bx, num_microbatches=num_microbatches)
+        if num_virtual > 1:
+            from tpu_dist_nn.parallel.pipeline import (
+                pipeline_forward_interleaved,
+            )
+
+            out = pipeline_forward_interleaved(
+                mesh, params, bx, num_virtual=num_virtual,
+                num_microbatches=num_microbatches,
+            )
+        else:
+            out = pipeline_forward(
+                mesh, params, bx, num_microbatches=num_microbatches
+            )
         preds.append(to_host_numpy(out).argmax(-1))
     return classification_metrics(np.concatenate(preds), data.y, data.num_classes)
