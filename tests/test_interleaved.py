@@ -37,6 +37,20 @@ def test_schedule_tables_build_and_verify(S, v, M):
     assert tb.stash_slots <= S * v + S
 
 
+def test_schedule_tables_large_v_converges():
+    """The convergence safety bound must scale with V = S*v: S=16, v=8,
+    M=1 needs ~128 forward ticks, which a bound linear in S alone
+    spuriously rejected. Both builders must handle large-V shapes."""
+    from tpu_dist_nn.parallel.schedule_table import build_interleaved_forward
+
+    tb = build_interleaved_forward(16, 8, 1)
+    assert tb.ticks >= 16 * 8  # at least V ticks to traverse the ring
+    tb2 = build_interleaved_1f1b(16, 8, 1)
+    assert tb2.ticks >= 2 * 16 * 8
+    tb3 = build_interleaved_forward(8, 8, 1)  # previously a 64-vs-80 margin
+    assert tb3.ticks >= 64
+
+
 def test_megatron_order_hits_optimal_bubble():
     """With M % S == 0 the bubble must be the interleaved optimum
     2(S-1) chunk-ticks — v times less than contiguous-chunk 1F1B."""

@@ -165,7 +165,11 @@ def build_interleaved_1f1b(
     next_bwd = [0] * V
     done_ops = 0
     t = 0
-    max_ticks = 4 * (M * v + S) + 16  # generous safety bound
+    # Safety bound must scale with the TOTAL chunk count V = S*v, not
+    # just S: pipeline fill/drain alone costs ~2V ticks with transport,
+    # so a bound linear in S spuriously fails at large v (e.g. S=16,
+    # v=8, M=1 needs ~128 ticks).
+    max_ticks = 4 * (M * v + V) + 16
     while done_ops < 2 * V * M:
         if t > max_ticks:
             raise RuntimeError(
@@ -341,7 +345,7 @@ def build_interleaved_forward(
     next_fwd = [0] * V
     done_ops = 0
     t = 0
-    max_ticks = 4 * (M * v + S) + 16
+    max_ticks = 4 * (M * v + V) + 16  # scales with V: fill/drain ~2V ticks
     while done_ops < V * M:
         if t > max_ticks:
             raise RuntimeError(
