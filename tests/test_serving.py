@@ -362,6 +362,70 @@ def test_coalescing_dim_mismatch_fails_alone(served_engine):
         server.stop(0)
 
 
+def test_batcher_submit_timeout_on_wedged_engine():
+    # A wedged engine (the tunneled-TPU hang mode) must surface as
+    # DEADLINE_EXCEEDED on the affected RPCs instead of blocking the
+    # gRPC worker thread forever — an unbounded wait would eventually
+    # strand every worker and leave the server unable to return errors.
+    import threading
+    import time
+
+    import grpc
+
+    from tpu_dist_nn.serving import GrpcClient, serve_engine
+
+    release = threading.Event()
+
+    class WedgedEngine:
+        def __init__(self):
+            import dataclasses
+            self.model = dataclasses.make_dataclass("M", ["input_dim"])(8)
+            self.seen_rows = []
+
+        def infer(self, x):
+            release.wait(10.0)  # wedge until the test releases it
+            self.seen_rows.append(np.asarray(x)[:, 0].tolist())
+            return np.asarray(x)
+
+    eng = WedgedEngine()
+    server, port = serve_engine(
+        eng, 0, host="127.0.0.1", coalesce=True, submit_timeout=0.3,
+    )
+    try:
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=5.0)
+        t0 = time.monotonic()
+        # First request occupies the batcher thread (wedged in infer);
+        # the second sits in _pending, times out, and must be DISCARDED
+        # at pop time rather than computed after recovery.
+        waiter = threading.Thread(
+            target=lambda: pytest.raises(grpc.RpcError, client.process,
+                                         np.zeros((1, 8))),
+            daemon=True,
+        )
+        waiter.start()
+        time.sleep(0.05)  # let request 1 reach the wedged infer
+        c2 = GrpcClient(f"127.0.0.1:{port}", timeout=5.0)
+        with pytest.raises(grpc.RpcError) as exc_info:
+            c2.process(np.full((1, 8), 7.0))
+        assert exc_info.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        # The bound must come from submit_timeout (0.3s), not the
+        # client's 5s RPC deadline.
+        assert time.monotonic() - t0 < 3.0
+        waiter.join(timeout=5.0)
+
+        # Unwedge: a fresh request must succeed, and the abandoned rows
+        # (value 7.0) must never have been computed.
+        release.set()
+        out = c2.process(np.full((1, 8), 3.0))
+        np.testing.assert_array_equal(out, np.full((1, 8), 3.0))
+        assert not any(7.0 in rows for rows in eng.seen_rows), eng.seen_rows
+        client.close()
+        c2.close()
+    finally:
+        release.set()
+        server.stop(0)
+
+
 def test_batcher_width_guard_without_declared_input_dim():
     # Engine without model.input_dim: the handler cannot pre-validate,
     # so the batcher groups coalesced requests by feature width and

@@ -56,9 +56,11 @@ class _Batcher:
     window — no artificial delay is ever inserted.
     """
 
-    def __init__(self, engine, max_batch_rows: int = 65536):
+    def __init__(self, engine, max_batch_rows: int = 65536,
+                 submit_timeout: float | None = 120.0):
         self._engine = engine
         self._max_rows = int(max_batch_rows)
+        self._submit_timeout = submit_timeout
         self._cond = threading.Condition()
         self._pending: list[dict] = []
         self._closed = False
@@ -72,17 +74,45 @@ class _Batcher:
         )
         self._thread.start()
 
-    def submit(self, x: np.ndarray) -> np.ndarray:
+    def submit(self, x: np.ndarray,
+               timeout: float | None = None) -> np.ndarray:
+        """Block until this request's rows are served.
+
+        ``timeout`` is the CALLER's remaining budget (the RPC deadline);
+        the effective wait is ``min(timeout, submit_timeout)`` — there
+        is no point holding a worker thread past the moment its client
+        gave up.
+        """
         from tpu_dist_nn.utils.errors import UnavailableError
 
-        item = {"x": x, "done": threading.Event(), "out": None, "err": None}
+        item = {"x": x, "done": threading.Event(), "out": None, "err": None,
+                "abandoned": False}
         with self._cond:
             if self._closed:
                 raise UnavailableError("server is shutting down")
             self._pending.append(item)
             self.requests_total += 1
             self._cond.notify()
-        item["done"].wait()
+        bounds = [t for t in (self._submit_timeout, timeout) if t is not None]
+        wait = min(bounds) if bounds else None
+        # Bounded wait: if the engine wedges mid-batch (the tunneled-TPU
+        # hang mode), the gRPC worker thread must get back to the client
+        # with DEADLINE_EXCEEDED instead of blocking forever — an
+        # unbounded wait here would eventually strand every worker
+        # thread and leave the server unable even to return errors.
+        if not item["done"].wait(wait):
+            from tpu_dist_nn.utils.errors import DeadlineExceededError
+
+            # Mark abandoned under the lock so the consumer discards it
+            # at pop time: without this, a long wedge accumulates dead
+            # requests unboundedly and the recovered engine burns its
+            # first launches computing rows nobody is waiting for.
+            with self._cond:
+                item["abandoned"] = True
+            raise DeadlineExceededError(
+                f"coalesced batch did not complete within {wait}s "
+                "(engine wedged or request backlogged?)"
+            )
         if item["err"] is not None:
             raise item["err"]
         return item["out"]
@@ -99,8 +129,13 @@ class _Batcher:
                     not batch
                     or rows + len(self._pending[0]["x"]) <= self._max_rows
                 ):
-                    rows += len(self._pending[0]["x"])
-                    batch.append(self._pending.pop(0))
+                    it = self._pending.pop(0)
+                    if it["abandoned"]:  # caller timed out; don't compute
+                        continue
+                    rows += len(it["x"])
+                    batch.append(it)
+                if not batch:
+                    continue
                 self.rows_total += rows
             # Group by feature width: engines without a declared
             # input_dim cannot be pre-validated in the handler, and a
@@ -175,16 +210,26 @@ def _make_handler(engine, batcher: _Batcher | None):
             )
         try:
             if batcher is not None:
-                out = batcher.submit(x)
+                # Pass the RPC's remaining deadline so the worker never
+                # waits for a client that already gave up.
+                out = batcher.submit(x, timeout=context.time_remaining())
             else:
                 with lock:
                     out = engine.infer(x)
         except Exception as e:  # noqa: BLE001 — map to status codes
-            from tpu_dist_nn.utils.errors import InvalidArgumentError, UnavailableError
+            from tpu_dist_nn.utils.errors import (
+                DeadlineExceededError,
+                InvalidArgumentError,
+                UnavailableError,
+            )
 
             if isinstance(e, InvalidArgumentError):
                 # The reference's dim-check path (grpc_node.py:149-153).
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            if isinstance(e, DeadlineExceededError):
+                # Batcher wait expired (wedged engine): the reference's
+                # per-RPC timeout semantics (grpc_node.py:133).
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             if isinstance(e, UnavailableError):
                 # Engine torn down mid-flight: the reference's
                 # dead-channel semantics (clients may retry elsewhere).
@@ -206,7 +251,8 @@ def _make_handler(engine, batcher: _Batcher | None):
 
 def serve_engine(engine, port: int, *, max_workers: int = 10,
                  host: str = "0.0.0.0", coalesce: bool = True,
-                 max_batch_rows: int = 65536, warm_rows: int = 0):
+                 max_batch_rows: int = 65536, warm_rows: int = 0,
+                 submit_timeout: float | None = 120.0):
     """Start a gRPC server bound to ``host:port``; returns
     ``(server, bound_port)`` (``port=0`` picks an ephemeral port;
     ``host="127.0.0.1"`` keeps self-checks off the network).
@@ -225,6 +271,11 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
     distinct XLA program, and an unwarmed bucket pays its compile on
     the first unlucky request mix (~hundreds of ms) instead of at
     startup.
+
+    ``submit_timeout`` bounds how long a coalescing gRPC worker waits
+    for its batch (``None`` = forever): a wedged engine turns into
+    DEADLINE_EXCEEDED for the affected requests instead of stranding
+    every worker thread.
     """
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -233,7 +284,9 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
             ("grpc.max_receive_message_length", -1),
         ],
     )
-    batcher = _Batcher(engine, max_batch_rows) if coalesce else None
+    batcher = (
+        _Batcher(engine, max_batch_rows, submit_timeout) if coalesce else None
+    )
     if coalesce and warm_rows > 0:
         # Bucket shapes only exist on the coalescing path; the lock
         # path forwards raw client shapes and would never hit them.

@@ -46,6 +46,14 @@ class InternalError(FrameworkError, RuntimeError):
     code = "INTERNAL"
 
 
+class DeadlineExceededError(FrameworkError, TimeoutError):
+    """A bounded wait expired — the reference's DEADLINE_EXCEEDED
+    (its per-RPC timeouts: 10 s forward hop grpc_node.py:133, client
+    ``--timeout`` run_grpc_inference.py:87,141)."""
+
+    code = "DEADLINE_EXCEEDED"
+
+
 class UnavailableError(FrameworkError, RuntimeError):
     """Cluster/engine not ready — the reference's readiness-poll failure
     (run_grpc_fcnn.py:157-172 timing out) / UNAVAILABLE channel state."""
