@@ -273,6 +273,120 @@ def pipeline_latency_bench(jax) -> dict:
     }
 
 
+def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
+                  rpcs_per_client: int = 20, big_batch: bool = False) -> dict:
+    """Wire-path serving numbers as driver artifacts (VERDICT r3 #6).
+
+    Measures the FULL loopback path — client encode, gRPC, server
+    decode, engine inference, encode, decode — on the flagship model:
+    batch-RPC throughput, then ``clients`` concurrent single-row
+    clients against the coalescing batcher and against the serialized
+    engine-lock path (p50/p99 per-RPC latency, aggregate RPC/s, and
+    the coalescing on/off ratio). Replaces docs/PERF.md's prose-only
+    ~38k samples/s and 1.38x claims with reproducible JSON.
+    """
+    import threading
+
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
+    from tpu_dist_nn.serving.server import GrpcClient, serve_engine
+
+    params = init_fcnn(jax.random.key(0), [784, 128, 64, 10])
+    model = spec_from_params(params, ["relu", "relu", "softmax"])
+    engine = Engine.up(model)
+    rng = np.random.default_rng(0)
+    out: dict = {}
+
+    def time_batch(client, xb, label):
+        client.process(xb)  # warmup (bucket compile)
+        times = []
+        for _ in range(batch_rpcs):
+            t0 = time.monotonic()
+            client.process(xb)
+            times.append(time.monotonic() - t0)
+        out[f"{label}_rpc_samples_per_sec"] = round(len(xb) / min(times), 1)
+        out[f"{label}_rpc_ms"] = round(min(times) * 1e3, 2)
+
+    def run_concurrent(port):
+        lats: list[float] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+        xs = rng.uniform(0.0, 1.0, (clients, 784))
+
+        def worker(i):
+            mine: list[float] = []
+            try:
+                c = GrpcClient(f"127.0.0.1:{port}")
+                row = xs[i:i + 1]
+                for _ in range(rpcs_per_client):
+                    t0 = time.monotonic()
+                    c.process(row)
+                    mine.append(time.monotonic() - t0)
+                c.close()
+                with lock:
+                    lats.extend(mine)
+            except Exception as e:  # noqa: BLE001 — recorded, not hidden
+                with lock:
+                    lats.extend(mine)
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(clients)
+        ]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t0
+        if not lats:
+            raise RuntimeError(f"all serving workers failed: {errors[:3]}")
+        arr = np.asarray(lats)
+        res = {
+            # Completed RPCs only — a partially failed run must not
+            # ship an overstated throughput artifact.
+            "rps": round(len(lats) / wall, 1),
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+        }
+        if errors:
+            res["completed"] = len(lats)
+            res["failed_workers"] = len(errors)
+            res["errors"] = errors[:3]
+        return res
+
+    # Coalescing server: warm the single-row buckets the concurrent
+    # phase will hit (1..32) plus the batch shapes.
+    server, port = serve_engine(
+        engine, 0, host="127.0.0.1", coalesce=True, warm_rows=32
+    )
+    client = GrpcClient(f"127.0.0.1:{port}")
+    time_batch(client, rng.uniform(0.0, 1.0, (512, 784)), "batch512")
+    if big_batch:
+        time_batch(client, rng.uniform(0.0, 1.0, (4096, 784)), "batch4096")
+    b = server.batcher
+    req0, bat0 = b.requests_total, b.batches_total
+    co = run_concurrent(port)
+    co["requests"] = b.requests_total - req0
+    co["batches"] = b.batches_total - bat0
+    out["coalesced"] = co
+    client.close()
+    server.stop(0)
+
+    server2, port2 = serve_engine(engine, 0, host="127.0.0.1", coalesce=False)
+    c2 = GrpcClient(f"127.0.0.1:{port2}")
+    c2.process(rng.uniform(0.0, 1.0, (1, 784)))  # warm the 1-row program
+    c2.close()
+    out["locked"] = run_concurrent(port2)
+    server2.stop(0)
+    out["coalescing_speedup"] = round(
+        out["coalesced"]["rps"] / out["locked"]["rps"], 2
+    )
+    out["concurrent_clients"] = clients
+    out["rpcs_per_client"] = rpcs_per_client
+    return out
+
+
 def mfu_bench(jax, jnp, device_kind: str | None, on_accel: bool) -> dict:
     """Compute-bound single-chip training step: achieved FLOP/s and MFU.
 
@@ -335,7 +449,10 @@ def mfu_bench(jax, jnp, device_kind: str | None, on_accel: bool) -> dict:
     }
 
 
-def main() -> int:
+def _bring_up():
+    """Probe-gated backend bring-up shared by the default and
+    ``--serving`` modes; returns ``(jax, jnp, backend, device_kind,
+    on_accel)`` with the CPU fallback applied and init bounded."""
     probed = probe_tpu()
     if probed is None:
         backend, device_kind = "cpu-fallback (tpu backend unavailable)", None
@@ -383,8 +500,36 @@ def main() -> int:
         float(os.environ.get("TDN_BENCH_TPU_TIMEOUT", "90")), _init_hung
     ):
         jax.devices()  # force backend init under the watchdog
+    return jax, jnp, backend, device_kind, device_kind is not None
 
-    on_accel = device_kind is not None
+
+def serving_main() -> int:
+    """``bench.py --serving``: the dedicated serving artifact (bigger
+    sample counts + the 4096-row batch point), one JSON line."""
+    jax, _jnp, backend, device_kind, _ = _bring_up()
+    sv = serving_bench(
+        jax, batch_rpcs=7, clients=10, rpcs_per_client=50, big_batch=True
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serving wire-path throughput (gRPC loopback, flagship FCNN)",
+                "value": sv["batch512_rpc_samples_per_sec"],
+                "unit": "samples/sec",
+                "vs_baseline": round(
+                    sv["batch512_rpc_samples_per_sec"] / BASELINE_SAMPLES_PER_SEC, 3
+                ),
+                "backend": backend,
+                "device_kind": device_kind or "host cpu",
+                **sv,
+            }
+        )
+    )
+    return 0
+
+
+def main() -> int:
+    jax, jnp, backend, device_kind, on_accel = _bring_up()
     tp = throughput_bench(jax, jnp, on_accel)
     mfu = mfu_bench(jax, jnp, device_kind, on_accel)
     try:
@@ -393,6 +538,12 @@ def main() -> int:
         print(f"# pipeline latency bench unavailable "
               f"({type(e).__name__}: {e})", file=sys.stderr)
         pipe = {"p50_per_stage_pipeline_step_latency_s": None}
+    try:
+        serving = serving_bench(jax)
+    except Exception as e:  # pragma: no cover - must not cost the headline
+        print(f"# serving bench unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        serving = None
 
     def _r(v):
         return round(v, 1) if v is not None else None
@@ -418,6 +569,7 @@ def main() -> int:
                 "backend": backend,
                 "device_kind": device_kind or "host cpu",
                 **pipe,
+                "serving": serving,
                 **mfu,
             }
         )
@@ -427,7 +579,7 @@ def main() -> int:
 
 if __name__ == "__main__":
     try:
-        sys.exit(main())
+        sys.exit(serving_main() if "--serving" in sys.argv else main())
     except BaseException as e:  # noqa: BLE001 — JSON error record, not a traceback
         if isinstance(e, SystemExit):
             raise
