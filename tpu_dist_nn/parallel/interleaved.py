@@ -39,6 +39,7 @@ def make_interleaved_1f1b(
     *,
     microbatch_spec=None,
     chunk_params_spec=None,
+    chunk_static_spec=None,
     aux_spec=None,
     want_dx0: bool = True,
     tables: ScheduleTables | None = None,
@@ -72,6 +73,12 @@ def make_interleaved_1f1b(
         microbatch_spec = P(AXIS_DATA)
     if chunk_params_spec is None:
         chunk_params_spec = P(AXIS_STAGE)
+    if chunk_static_spec is None:
+        # A plain per-leaf default, NOT chunk_params_spec: that may be a
+        # pytree of specs (e.g. the Megatron per-leaf dict) whose
+        # structure the static operand does not share (make_1f1b's
+        # stage_static_spec note).
+        chunk_static_spec = P(AXIS_STAGE)
     if aux_spec is None:
         aux_spec = P(None, *microbatch_spec)
     xs_spec = P(None, *microbatch_spec)
@@ -95,10 +102,25 @@ def make_interleaved_1f1b(
         mb_shape = xs.shape[1:]
         dt = xs.dtype
 
-        def vcast(z):
+        def mark_varying(z, axes):
+            # Idempotent "mark varying over `axes`" (one_f_one_b.py).
             have = getattr(jax.typeof(z), "vma", frozenset())
-            need = tuple(a for a in vary if a not in have)
+            need = tuple(a for a in axes if a not in have)
             return lax.pcast(z, need, to="varying") if need else z
+
+        def vcast(z):
+            return mark_varying(z, vary)
+
+        def zeros_like_vma(ref):
+            # Grad accumulators must carry the PRIMAL leaf's varying
+            # axes: a model-sharded Megatron chunk leaf (varying over
+            # `model`) accumulates per-shard cotangents, so an
+            # accumulator left invariant over `model` would fail the
+            # lax.switch branch-type check at the first bwd tick.
+            return mark_varying(
+                jnp.zeros(ref.shape, ref.dtype),
+                getattr(jax.typeof(ref), "vma", frozenset()),
+            )
 
         tp = jax.tree.map(lambda a: vcast(jnp.asarray(a)), tail_params)
 
@@ -115,8 +137,8 @@ def make_interleaved_1f1b(
             vcast(jnp.zeros((A, *mb_shape), dt)),        # activation recv buf
             vcast(jnp.zeros((G, *mb_shape), dt)),        # cotangent recv buf
             vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
-            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), sp),
-            jax.tree.map(lambda a: vcast(jnp.zeros(a.shape, a.dtype)), tp),
+            jax.tree.map(zeros_like_vma, sp),
+            jax.tree.map(zeros_like_vma, tp),
             vcast(jnp.zeros((M if want_dx0 else 1, *mb_shape), dt)),
             vcast(jnp.zeros((), jnp.float32)),           # loss accumulator
         )
@@ -259,7 +281,7 @@ def make_interleaved_1f1b(
         in_specs=(
             xs_spec,
             chunk_params_spec,
-            chunk_params_spec,
+            chunk_static_spec,
             P(),
             aux_spec,
         ),
@@ -275,6 +297,7 @@ def make_interleaved_forward(
     *,
     microbatch_spec=None,
     chunk_params_spec=None,
+    chunk_static_spec=None,
     tables: ScheduleTables | None = None,
 ):
     """Forward-only (inference) interleaved executor.
@@ -304,6 +327,10 @@ def make_interleaved_forward(
         microbatch_spec = P(AXIS_DATA)
     if chunk_params_spec is None:
         chunk_params_spec = P(AXIS_STAGE)
+    if chunk_static_spec is None:
+        # Same asymmetry guard as the training executor: the params
+        # spec may be a per-leaf pytree the static operand doesn't share.
+        chunk_static_spec = P(AXIS_STAGE)
     xs_spec = P(None, *microbatch_spec)
     tb = {
         name: jnp.asarray(getattr(tables, name))
@@ -389,6 +416,6 @@ def make_interleaved_forward(
     return jax.shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(xs_spec, chunk_params_spec, chunk_params_spec),
+        in_specs=(xs_spec, chunk_params_spec, chunk_static_spec),
         out_specs=xs_spec,
     )

@@ -207,33 +207,39 @@ def make_pipeline_lm_1f1b_grad(mesh, cfg: TransformerConfig, num_stages: int,
     return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
 
 
-def shard_blocks_interleaved(blocks: dict, num_stages: int, num_virtual: int) -> dict:
-    """Stacked blocks ``(L, ...)`` -> interleaved chunk layout
-    ``(S, v, L/V, ...)``: global chunk ``c`` (blocks
-    ``[c*L/V, (c+1)*L/V)``) lives on device ``c % S``, local slot
-    ``c // S`` — the Megatron virtual-stage placement."""
+def _chunk_regroup(a, num_stages: int, num_virtual: int):
+    """``(L, ...) -> (S, v, L/V, ...)``: global chunk ``c`` (blocks
+    ``[c*L/V, (c+1)*L/V)``) to device ``c % S``, local slot ``c // S``
+    — THE definition of the Megatron virtual-stage placement (every
+    interleaved layout helper goes through here)."""
     S, v = num_stages, num_virtual
     V = S * v
+    L = a.shape[0]
+    chunks = a.reshape(V, L // V, *a.shape[1:])       # chunk-major
+    return jnp.swapaxes(chunks.reshape(v, S, L // V, *a.shape[1:]), 0, 1)
+
+
+def _chunk_ungroup(a):
+    """Inverse of :func:`_chunk_regroup`: ``(S, v, Lc, ...) -> (L, ...)``."""
+    return jnp.swapaxes(a, 0, 1).reshape(-1, *a.shape[3:])
+
+
+def shard_blocks_interleaved(blocks: dict, num_stages: int, num_virtual: int) -> dict:
+    """Stacked blocks ``(L, ...)`` -> interleaved chunk layout
+    ``(S, v, L/V, ...)`` (:func:`_chunk_regroup`'s placement)."""
+    V = num_stages * num_virtual
     L = jax.tree.leaves(blocks)[0].shape[0]
     if L % V:
         raise ValueError(f"n_layers={L} not divisible by S*v={V}")
-
-    def regroup(a):
-        chunks = a.reshape(V, L // V, *a.shape[1:])       # chunk-major
-        return jnp.swapaxes(chunks.reshape(v, S, L // V, *a.shape[1:]), 0, 1)
-
-    return jax.tree.map(regroup, blocks)
+    return jax.tree.map(
+        lambda a: _chunk_regroup(a, num_stages, num_virtual), blocks
+    )
 
 
 def unshard_blocks_interleaved(staged: dict) -> dict:
     """Inverse of :func:`shard_blocks_interleaved`: ``(S, v, Lc, ...) ->
     (L, ...)``."""
-
-    def ungroup(a):
-        S, v, Lc = a.shape[0], a.shape[1], a.shape[2]
-        return jnp.swapaxes(a, 0, 1).reshape(S * v * Lc, *a.shape[3:])
-
-    return jax.tree.map(ungroup, staged)
+    return jax.tree.map(_chunk_ungroup, staged)
 
 
 def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
@@ -435,6 +441,107 @@ def make_pipeline_tp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
         mesh, stage_fn, tail_fn, num_stages, num_microbatches,
         microbatch_spec=P(AXIS_DATA, None, None),
         stage_params_spec=blocks_spec,
+        aux_spec=P(None, AXIS_DATA, None),
+    )
+    return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
+
+
+def shard_blocks_interleaved_tp(blocks: dict, cfg: TransformerConfig,
+                                num_stages: int, num_virtual: int,
+                                n_tp: int) -> dict:
+    """Stacked blocks ``(L, ...)`` -> interleaved chunk layout with
+    Megatron sharding: TP-sharded leaves become ``(S, v, N, L/V, ...)``
+    (stage leading, local chunk slot second, model shard third),
+    TP-replicated leaves ``(S, v, L/V, ...)``. Global chunk ``c`` lives
+    on device ``c % S`` at slot ``c // S`` (:func:`shard_blocks_interleaved`'s
+    placement, applied to each TP shard independently)."""
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        TP_REPLICATED,
+        tp_shard_blocks,
+    )
+
+    S, v = num_stages, num_virtual
+    V = S * v
+    L = jax.tree.leaves(blocks)[0].shape[0]
+    if L % V:
+        raise ValueError(f"n_layers={L} not divisible by S*v={V}")
+
+    regroup = lambda a: _chunk_regroup(a, S, v)  # noqa: E731 — vmapped below
+    tp = tp_shard_blocks(blocks, cfg, n_tp)  # sharded leaves: (N, L, ...)
+    out = {}
+    for k, val in tp.items():
+        if k in TP_REPLICATED:  # (L, ...) -> (S, v, L/V, ...)
+            out[k] = regroup(val)
+        else:  # (N, L, ...) -> (N, S, v, L/V, ...) -> (S, v, N, L/V, ...)
+            out[k] = jnp.moveaxis(jax.vmap(regroup)(val), 0, 2)
+    return out
+
+
+def unshard_blocks_interleaved_tp(staged: dict, cfg: TransformerConfig) -> dict:
+    """Inverse of :func:`shard_blocks_interleaved_tp`: back to stacked
+    ``(L, ...)``."""
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        TP_REPLICATED,
+        tp_unshard_blocks,
+    )
+
+    tp = {}
+    for k, val in staged.items():
+        if k in TP_REPLICATED:
+            tp[k] = _chunk_ungroup(val)
+        else:  # (S, v, N, Lc, ...) -> (N, L, ...)
+            tp[k] = jax.vmap(_chunk_ungroup)(jnp.moveaxis(val, 2, 0))
+    return tp_unshard_blocks(tp, cfg)
+
+
+def make_pipeline_tp_lm_interleaved_grad(mesh, cfg: TransformerConfig,
+                                         num_virtual: int,
+                                         num_microbatches: int,
+                                         attn_fn=dot_product_attention):
+    """-> ``f(params, tokens) -> (loss, grads)``: interleaved
+    (virtual-stage) 1F1B x Megatron TP — the last cell of the
+    schedule x sharding matrix (gpipe x TP, 1F1B x TP landed earlier).
+
+    Why psum-bearing chunk bodies are legal inside the table executor:
+    the per-tick branch is selected by ``op[device, tick]`` tables that
+    are INVARIANT over the ``model`` axis (the schedule never consults
+    data), so every ``model``-axis peer of a psum takes the same
+    ``lax.switch`` branch at the same tick and the block's collectives
+    pair correctly — the same argument that unlocked 1F1B x TP
+    (one_f_one_b.make_1f1b docstring), applied to
+    :func:`~tpu_dist_nn.parallel.interleaved.make_interleaved_1f1b`.
+    Chunk outputs stay model-invariant (psum + replicated residual), so
+    the rings, receive buffers, stash, and recompute-backward are
+    exactly the dense executor's.
+
+    ``params["blocks"]`` must be in :func:`shard_blocks_interleaved_tp`
+    layout; grads come back in that layout.
+    """
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_MODEL
+    from tpu_dist_nn.parallel.tensor_parallel import BLOCK_KEYS, TP_REPLICATED
+
+    _, tail_fn = _lm_sched_stage_and_tail(mesh, cfg, num_microbatches, attn_fn)
+    tp_stage_fn, _ = _tp_stage_fn_and_spec(mesh, cfg, attn_fn)
+
+    def stage_fn(chunk_blocks, _static, x):
+        # chunk_blocks leaves: sharded (1, L/V, ...) — model dim kept by
+        # the executor's slot indexing — replicated (L/V, ...); exactly
+        # the layout tp_stage_fn strips and scans.
+        return tp_stage_fn(chunk_blocks, x)
+
+    blocks_spec = {
+        k: (
+            P(AXIS_STAGE)
+            if k in TP_REPLICATED
+            else P(AXIS_STAGE, None, AXIS_MODEL)
+        )
+        for k in BLOCK_KEYS
+    }
+    mapped = make_interleaved_1f1b(
+        mesh, stage_fn, tail_fn, num_virtual, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None, None),
+        chunk_params_spec=blocks_spec,
         aux_spec=P(None, AXIS_DATA, None),
     )
     return _lm_vag_from_mapped(mapped, cfg, num_microbatches)

@@ -323,8 +323,9 @@ def _dryrun_moe_ep(n_devices: int) -> None:
 
 def _dryrun_pp_tp_3d(n_devices: int) -> None:
     """3D composition: pipeline x Megatron tensor x data — GPipe grad
-    step AND the full 1F1B x TP train step (the memory-flat schedule
-    with psum-bearing stage bodies, new in round 3)."""
+    step, the full 1F1B x TP train step (round 3), and the
+    interleaved x TP train step (round 4: the table-driven virtual-stage
+    executor with psum-bearing chunk bodies)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -334,6 +335,7 @@ def _dryrun_pp_tp_3d(n_devices: int) -> None:
     from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
     from tpu_dist_nn.parallel.transformer_pipeline import (
         make_pipeline_tp_lm_loss,
+        shard_blocks_interleaved_tp,
         shard_blocks_pp_tp,
     )
     from tpu_dist_nn.train.lm_trainer import make_pipeline_lm_train_step
@@ -363,5 +365,19 @@ def _dryrun_pp_tp_3d(n_devices: int) -> None:
         tensor_parallel=model,
     )
     new_params, _, loss = step(params_3d, optimizer.init(params_3d), tokens)
+    jax.block_until_ready(new_params)
+    assert float(loss) > 0
+
+    # Interleaved x TP: v=1 keeps the dryrun cheap while still running
+    # the table executor with Megatron chunk bodies end to end.
+    params_il = dict(
+        params,
+        blocks=shard_blocks_interleaved_tp(params["blocks"], cfg, stage, 1, model),
+    )
+    step_il = make_pipeline_lm_train_step(
+        mesh, cfg, stage, 2, optimizer, schedule="interleaved",
+        num_virtual=1, tensor_parallel=model,
+    )
+    new_params, _, loss = step_il(params_il, optimizer.init(params_il), tokens)
     jax.block_until_ready(new_params)
     assert float(loss) > 0

@@ -99,12 +99,14 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     layout — bubble cut to 2(S-1) chunk-ticks).
 
     ``tensor_parallel > 1`` Megatron-shards each stage's blocks over the
-    mesh's ``model`` axis (blocks in
-    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks_pp_tp`
-    layout) and composes with "gpipe" AND "1f1b" — the memory-flat
-    schedule tolerates the block psums because its tick predicate is
-    model-invariant (one_f_one_b.make_1f1b docstring). Interleaved x TP
-    is not implemented yet.
+    mesh's ``model`` axis and composes with ALL three schedules — the
+    scheduled executors tolerate the block psums because their tick
+    predicates are model-invariant (one_f_one_b.make_1f1b docstring;
+    for the table executor the [device, tick] tables never consult the
+    model axis). Layouts: "gpipe"/"1f1b" expect
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks_pp_tp`,
+    "interleaved" expects
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks_interleaved_tp`.
     """
     from tpu_dist_nn.parallel.mesh import AXIS_MODEL
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
@@ -118,18 +120,21 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
         )
     if schedule == "interleaved":
         if tensor_parallel > 1:
-            raise ValueError(
-                "schedule='interleaved' with tensor_parallel > 1 is not "
-                "implemented; use schedule='1f1b' for the memory-flat "
-                "schedule with Megatron stages"
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                make_pipeline_tp_lm_interleaved_grad,
             )
-        from tpu_dist_nn.parallel.transformer_pipeline import (
-            make_pipeline_lm_interleaved_grad,
-        )
 
-        vag = make_pipeline_lm_interleaved_grad(
-            mesh, cfg, num_virtual, num_microbatches, attn
-        )
+            vag = make_pipeline_tp_lm_interleaved_grad(
+                mesh, cfg, num_virtual, num_microbatches, attn
+            )
+        else:
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                make_pipeline_lm_interleaved_grad,
+            )
+
+            vag = make_pipeline_lm_interleaved_grad(
+                mesh, cfg, num_virtual, num_microbatches, attn
+            )
         return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule == "1f1b":
         if tensor_parallel > 1:
