@@ -191,15 +191,33 @@ class TestTextData:
         assert synthetic_wikitext(5000, seed=3) == synthetic_wikitext(5000, seed=3)
         assert synthetic_wikitext(5000, seed=3) != synthetic_wikitext(5000, seed=4)
 
-    def test_load_corpus_fallback_and_file(self, tmp_path, monkeypatch):
+    def test_load_corpus_prefers_vendored_real_then_explicit(
+        self, tmp_path, monkeypatch
+    ):
         monkeypatch.delenv("TDN_WIKITEXT_PATH", raising=False)
-        text, source = load_corpus(synthetic_chars=1000)
-        assert source == "synthetic" and len(text) == 1000
+        # Default: the VENDORED real corpus (committed with the package)
+        # wins over the synthetic generator.
+        text, source = load_corpus()
+        assert source.endswith("licenses_corpus.txt") and len(text) > 100_000
+        assert "GNU GENERAL PUBLIC LICENSE" in text  # real bytes, not Zipf
+        # An explicit WikiText-style file still takes precedence.
         f = tmp_path / "wiki.train.tokens"
         f.write_text("real corpus text here")
         monkeypatch.setenv("TDN_WIKITEXT_PATH", str(f))
         text, source = load_corpus()
         assert source == str(f) and text == "real corpus text here"
+
+    def test_load_corpus_synthetic_fallback_is_gated(self, monkeypatch):
+        from tpu_dist_nn.data import text as text_mod
+
+        monkeypatch.delenv("TDN_WIKITEXT_PATH", raising=False)
+        missing = text_mod._VENDORED_CORPUS.with_name("nope.txt")
+        monkeypatch.setattr(text_mod, "_VENDORED_CORPUS", missing)
+        monkeypatch.setattr(text_mod, "_DEFAULT_PATHS", ())
+        text, source = text_mod.load_corpus(synthetic_chars=1000)
+        assert source == "synthetic" and len(text) == 1000
+        with pytest.raises(ValueError, match="allow_synthetic"):
+            text_mod.load_corpus(allow_synthetic=False)
 
     def test_lm_sequences_and_batches(self):
         rows = lm_sequences(np.arange(100, dtype=np.int32), seq_len=9)

@@ -1,14 +1,19 @@
 """Text corpus + LM batching for the Tiny-Transformer config.
 
 BASELINE.json configs[4] names WikiText-2 as the workload. The build
-environment has zero network egress, so corpus acquisition is gated:
-:func:`load_corpus` reads a real on-disk corpus when one is present
-(``TDN_WIKITEXT_PATH`` or a conventional path), and otherwise generates
-a deterministic synthetic Wikipedia-markup-like corpus with matched
-surface statistics (articles, headings, punctuation, a Zipfian word
-distribution) so training/eval pipelines run identically either way —
-the same pattern as :func:`tpu_dist_nn.data.datasets.synthetic_mnist`
-vs. the reference's real-MNIST scripts (generate_mnist_pytorch.py:14-20).
+environment has zero network egress, so corpus acquisition is tiered:
+:func:`load_corpus` reads a real WikiText file when one is present
+(``TDN_WIKITEXT_PATH`` or a conventional path), then falls back to the
+VENDORED real corpus shipped in this package
+(``data/corpus/licenses_corpus.txt`` — ~238 KB of real human-written
+English from the Debian common-licenses texts, built by
+``tools/make_text_corpus.py``; the round-3 vendored-digits move applied
+to text), and only generates the deterministic synthetic
+Wikipedia-markup-alike when even that is missing — so by default every
+LM number derives from real bytes, with the synthetic path kept for
+surface-statistics tests (the pattern of
+:func:`tpu_dist_nn.data.datasets.synthetic_mnist` vs. the reference's
+real-MNIST scripts, generate_mnist_pytorch.py:14-20).
 
 Tokenization is byte-level (vocab 256): no vocabulary file to ship,
 fully reversible, and the Tiny-Transformer target is architecture/
@@ -29,6 +34,11 @@ _WIKITEXT_ENV = "TDN_WIKITEXT_PATH"
 _DEFAULT_PATHS = (
     "/root/data/wikitext-2/wiki.train.tokens",
     "/root/data/wikitext-2-raw/wiki.train.raw",
+)
+# The vendored real corpus (tools/make_text_corpus.py): last real
+# candidate before the synthetic fallback.
+_VENDORED_CORPUS = Path(__file__).resolve().parent / (
+    "corpus/licenses_corpus.txt"
 )
 
 # Word stems for the synthetic corpus; frequencies get a Zipf tail.
@@ -83,11 +93,16 @@ def synthetic_wikitext(n_chars: int = 500_000, seed: int = 0) -> str:
 
 
 def load_corpus(path: str | os.PathLike | None = None, *,
-                synthetic_chars: int = 500_000, seed: int = 0) -> tuple[str, str]:
+                synthetic_chars: int = 500_000, seed: int = 0,
+                allow_synthetic: bool = True) -> tuple[str, str]:
     """-> (text, source): a real corpus when available, else synthetic.
 
     Lookup order: explicit ``path`` arg, ``$TDN_WIKITEXT_PATH``, the
-    conventional on-disk locations, then the synthetic generator.
+    conventional WikiText locations, the VENDORED real corpus shipped
+    with the package, then the synthetic generator (or ``ValueError``
+    with ``allow_synthetic=False`` — for callers recording real-data
+    evidence, where silently training on synthetic bytes would
+    invalidate the record).
     """
     candidates = []
     if path is not None:
@@ -95,9 +110,16 @@ def load_corpus(path: str | os.PathLike | None = None, *,
     if os.environ.get(_WIKITEXT_ENV):
         candidates.append(Path(os.environ[_WIKITEXT_ENV]))
     candidates.extend(Path(p) for p in _DEFAULT_PATHS)
+    candidates.append(_VENDORED_CORPUS)
     for cand in candidates:
         if cand.is_file():
             return cand.read_text(encoding="utf-8", errors="replace"), str(cand)
+    if not allow_synthetic:
+        raise ValueError(
+            "no real corpus found (checked explicit path, "
+            f"${_WIKITEXT_ENV}, conventional WikiText locations, and the "
+            f"vendored {_VENDORED_CORPUS}) and allow_synthetic=False"
+        )
     return synthetic_wikitext(synthetic_chars, seed), "synthetic"
 
 
