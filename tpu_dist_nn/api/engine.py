@@ -523,6 +523,12 @@ class Engine:
         from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
         validate_schedule(schedule)
+        if schedule == "zb":
+            raise ValueError(
+                "schedule='zb' (zero-bubble) is implemented for the "
+                "transformer LM pipeline only (tdn lm --schedule zb); "
+                "the classifier engine supports gpipe/1f1b/interleaved"
+            )
         if self.virtual_stages > 1:
             # The placement determines the schedule: V chunks on V/v
             # devices can only run the table-driven interleaved

@@ -500,9 +500,12 @@ def cmd_train(args) -> int:
 def cmd_lm(args) -> int:
     """Train + evaluate the Tiny-Transformer LM (BASELINE configs[4]).
 
-    Corpus: a real on-disk WikiText file when present (``--corpus`` or
-    the conventional paths in data/text.py), else the synthetic
-    gated-fallback corpus. Pipelined over ``--stages`` when > 1.
+    Corpus tiers (data/text.py load_corpus): a real on-disk WikiText
+    file when present (``--corpus`` or the conventional paths), else
+    the VENDORED real corpus shipped with the package (~238 KB of real
+    English from the Debian common-licenses texts — the default on
+    this zero-egress box), else the synthetic gated fallback.
+    Pipelined over ``--stages`` when > 1.
     """
     import jax
 
@@ -806,13 +809,21 @@ def cmd_lm(args) -> int:
     checkpoints = None
     if args.checkpoint_dir:
         checkpoints = _make_checkpoint_manager(args)
+    # --virtual-stages default depends on the schedule: interleaved is
+    # pointless at v=1 (it IS the v>1 placement), while zb's documented
+    # default is the classic contiguous v=1 placement — inheriting
+    # interleaved's 2 would silently change the layout (and break
+    # n_layers % (S*v) for valid zb runs).
+    num_virtual = getattr(args, "virtual_stages", None)
+    if num_virtual is None:
+        num_virtual = 2 if args.schedule == "interleaved" else 1
     t0 = time.monotonic()
     params, history = train_lm(
         params, cfg, batches, train_cfg, mesh=mesh,
         num_stages=args.stages, num_microbatches=args.microbatches,
         checkpoints=checkpoints, step_fn=step_fn,
         schedule=args.schedule, globalize=globalize,
-        num_virtual=getattr(args, "virtual_stages", 1),
+        num_virtual=num_virtual,
     )
     train_seconds = time.monotonic() - t0
     if unshard_fn is not None:
@@ -1209,7 +1220,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline training schedule: gpipe (AD through the "
                         "forward schedule), 1f1b (activation-recompute, "
                         "O(stages) live memory), or interleaved "
-                        "(auto-selected by --virtual-stages placements)")
+                        "(auto-selected by --virtual-stages placements); "
+                        "zero-bubble ('zb') is LM-only (tdn lm)")
     p.add_argument("--virtual-stages", type=int, default=1,
                    help="interleaved (Megatron virtual-stage) placement: "
                         "the distribution's V entries become V chunks on "
@@ -1269,14 +1281,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
-    p.add_argument("--schedule", choices=["gpipe", "1f1b", "interleaved"],
+    p.add_argument("--schedule", choices=["gpipe", "1f1b", "interleaved", "zb"],
                    default="gpipe",
                    help="pipeline training schedule when --stages > 1 "
                         "(interleaved = Megatron virtual stages, see "
-                        "--virtual-stages)")
-    p.add_argument("--virtual-stages", type=int, default=2,
+                        "--virtual-stages; zb = zero-bubble ZB-H1 split "
+                        "backward, half the 1F1B bubble)")
+    p.add_argument("--virtual-stages", type=int, default=None,
                    help="model chunks per device for --schedule "
-                        "interleaved (bubble shrinks ~v-fold)")
+                        "interleaved/zb (bubble shrinks ~v-fold under "
+                        "interleaved); default 2 for interleaved, 1 "
+                        "(classic contiguous placement) for zb")
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="shard the sequence axis over N devices "

@@ -15,8 +15,17 @@ chunk-ticks, ``v``x less than contiguous-chunk 1F1B.
 
 The executor is schedule-agnostic: any
 :class:`~tpu_dist_nn.parallel.schedule_table.ScheduleTables` with the
-same wire model plays back unchanged (a zero-bubble variant would only
-add a table builder).
+same wire model plays back unchanged — proven by the zero-bubble
+(ZB-H1) schedule, which arrives as just another table
+(:func:`~tpu_dist_nn.parallel.schedule_table.build_zero_bubble`): its
+SPLIT backward ops play back as two extra ``lax.switch`` branches —
+``BWD_B`` recomputes the chunk forward and emits only the input
+cotangent (the critical-path op, sent downstream immediately), parking
+the consumed ``dy`` in a cotangent stash; ``BWD_W`` recomputes again
+and emits only the weight gradient from the parked ``(x, dy)`` pair in
+what would otherwise be a bubble tick. (Two recomputes per microbatch
+instead of one — the extra forward is the price of the bubble halving;
+XLA's DCE trims the unused cotangent from each branch.)
 """
 
 from __future__ import annotations
@@ -66,6 +75,13 @@ def make_interleaved_1f1b(
     if (tables.num_devices, tables.num_chunks, tables.num_microbatches) != (S, S * v, M):
         raise ValueError("tables do not match (S, v, M)")
     T, A, G, K = tables.ticks, tables.abuf_slots, tables.gbuf_slots, tables.stash_slots
+    D = tables.dybuf_slots
+    # Split-backward (zero-bubble) branches are traced only when the
+    # tables actually contain BWD_B/BWD_W ops — combined-backward
+    # schedules pay no extra compile cost.
+    from tpu_dist_nn.parallel.schedule_table import BWD_B
+
+    has_split = bool((tables.op >= BWD_B).any())
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [(i, (i - 1) % S) for i in range(S)]
     vary = (AXIS_STAGE, AXIS_DATA)
@@ -89,6 +105,7 @@ def make_interleaved_1f1b(
             "abuf_read", "gbuf_read", "abuf_write", "gbuf_write", "is_c0",
         )
     }
+    tb["dy_stash"] = jnp.asarray(tables.dy_stash_or_empty())
 
     def device_fn(xs, chunk_params, chunk_static, tail_params, aux):
         # Strip the length-1 stage-shard axis -> (v, ...) leaves; mark
@@ -137,6 +154,9 @@ def make_interleaved_1f1b(
             vcast(jnp.zeros((A, *mb_shape), dt)),        # activation recv buf
             vcast(jnp.zeros((G, *mb_shape), dt)),        # cotangent recv buf
             vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
+            # Cotangent stash bridging split BWD_B -> BWD_W (1 dummy
+            # slot for combined schedules).
+            vcast(jnp.zeros((D, *mb_shape), dt)),
             jax.tree.map(zeros_like_vma, sp),
             jax.tree.map(zeros_like_vma, tp),
             vcast(jnp.zeros((M if want_dx0 else 1, *mb_shape), dt)),
@@ -144,7 +164,8 @@ def make_interleaved_1f1b(
         )
 
         def tick(carry, t):
-            fwd_wire, bwd_wire, abuf, gbuf, stash, g_sp, g_tp, dx0, loss_acc = carry
+            (fwd_wire, bwd_wire, abuf, gbuf, stash, dybuf, g_sp, g_tp,
+             dx0, loss_acc) = carry
             # Receive phase: store last tick's ring payloads into their
             # scheduled slots (-1 = not for us / discard).
             aw = row["abuf_write"][t]
@@ -179,7 +200,8 @@ def make_interleaved_1f1b(
                 return stage_fn(p, stc, x)
 
             def idle(_):
-                return zeros_wire, zeros_wire, stash, g_sp, g_tp, dx0, loss_acc
+                return (zeros_wire, zeros_wire, stash, dybuf, g_sp, g_tp,
+                        dx0, loss_acc)
 
             def fwd(_):
                 ar = row["abuf_read"][t]
@@ -190,11 +212,13 @@ def make_interleaved_1f1b(
                 x_in = jnp.where(ar < 0, feed, buf)
                 new_stash = lax.dynamic_update_index_in_dim(stash, x_in, k_slot, 0)
                 y = chunk_fwd_g(pc, x_in)
-                return y, zeros_wire, new_stash, g_sp, g_tp, dx0, loss_acc
+                return (y, zeros_wire, new_stash, dybuf, g_sp, g_tp,
+                        dx0, loss_acc)
 
-            def bwd(_):
-                x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
-                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+            def resolve_dy(y):
+                """This op's cotangent: the loss tail (last chunk) or
+                the received upstream grad — plus the tail's loss and
+                tail-param grads (zeros off the last chunk)."""
                 gr = row["gbuf_read"][t]
                 aux_f = jax.tree.map(
                     lambda a: lax.dynamic_index_in_dim(a, f, 0, keepdims=False),
@@ -219,9 +243,10 @@ def make_interleaved_1f1b(
                 grad_in = lax.dynamic_index_in_dim(
                     gbuf, jnp.clip(gr, 0, G - 1), 0, keepdims=False
                 )
-                dy = jnp.where(gr < 0, dy_tail, grad_in)
-                d_pc, dx = svjp(dy)
-                new_g_sp = jax.tree.map(
+                return jnp.where(gr < 0, dy_tail, grad_in), loss_f, d_tp
+
+            def accumulate_g_sp(d_pc):
+                return jax.tree.map(
                     lambda acc, d: lax.dynamic_update_index_in_dim(
                         acc,
                         lax.dynamic_index_in_dim(acc, g_slot, 0, keepdims=False) + d,
@@ -231,27 +256,79 @@ def make_interleaved_1f1b(
                     g_sp,
                     d_pc,
                 )
-                if want_dx0:
-                    new_dx0 = jnp.where(
-                        row["is_c0"][t] > 0,
-                        lax.dynamic_update_index_in_dim(dx0, dx, f, 0),
-                        dx0,
-                    )
-                else:
-                    new_dx0 = dx0
+
+            def record_dx0(dx):
+                if not want_dx0:
+                    return dx0
+                return jnp.where(
+                    row["is_c0"][t] > 0,
+                    lax.dynamic_update_index_in_dim(dx0, dx, f, 0),
+                    dx0,
+                )
+
+            def bwd(_):
+                x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
+                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                dy, loss_f, d_tp = resolve_dy(y)
+                d_pc, dx = svjp(dy)
                 return (
                     zeros_wire,
                     dx,
                     stash,
-                    new_g_sp,
+                    dybuf,
+                    accumulate_g_sp(d_pc),
                     jax.tree.map(jnp.add, g_tp, d_tp),
-                    new_dx0,
+                    record_dx0(dx),
                     loss_acc + loss_f,
                 )
 
-            send_y, send_dx, stash, g_sp, g_tp, dx0, loss_acc = lax.switch(
-                row["op"][t], [idle, fwd, bwd], 0
-            )
+            def bwd_b(_):
+                # Zero-bubble split: input grad ONLY (critical path).
+                # The consumed dy is parked in the cotangent stash for
+                # the matching BWD_W tick; d_pc is unused, so XLA's DCE
+                # trims the weight-grad computation from this branch.
+                x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
+                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                dy, loss_f, d_tp = resolve_dy(y)
+                _d_pc, dx = svjp(dy)
+                dslot = jnp.clip(row["dy_stash"][t], 0, D - 1)
+                new_dybuf = lax.dynamic_update_index_in_dim(dybuf, dy, dslot, 0)
+                return (
+                    zeros_wire,
+                    dx,
+                    stash,
+                    new_dybuf,
+                    g_sp,
+                    jax.tree.map(jnp.add, g_tp, d_tp),
+                    record_dx0(dx),
+                    loss_acc + loss_f,
+                )
+
+            def bwd_w(_):
+                # Zero-bubble split: weight grad from the parked
+                # (x, dy) pair; no wire traffic, so the scheduler can
+                # park this op in any bubble tick.
+                x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
+                dy = lax.dynamic_index_in_dim(
+                    dybuf, jnp.clip(row["dy_stash"][t], 0, D - 1), 0,
+                    keepdims=False,
+                )
+                _y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                d_pc, _dx = svjp(dy)
+                return (
+                    zeros_wire,
+                    zeros_wire,
+                    stash,
+                    dybuf,
+                    accumulate_g_sp(d_pc),
+                    g_tp,
+                    dx0,
+                    loss_acc,
+                )
+
+            branches = [idle, fwd, bwd] + ([bwd_b, bwd_w] if has_split else [])
+            (send_y, send_dx, stash, dybuf, g_sp, g_tp, dx0,
+             loss_acc) = lax.switch(row["op"][t], branches, 0)
             with jax.named_scope("interleaved_ring_hop"):
                 nxt_fwd = (
                     lax.ppermute(send_y, AXIS_STAGE, fwd_perm) if S > 1 else send_y
@@ -260,10 +337,11 @@ def make_interleaved_1f1b(
                     lax.ppermute(send_dx, AXIS_STAGE, bwd_perm) if S > 1 else send_dx
                 )
             return (
-                nxt_fwd, nxt_bwd, abuf, gbuf, stash, g_sp, g_tp, dx0, loss_acc
+                nxt_fwd, nxt_bwd, abuf, gbuf, stash, dybuf, g_sp, g_tp,
+                dx0, loss_acc
             ), None
 
-        (_f, _b, _a, _g, _s, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
+        (_f, _b, _a, _g, _s, _dy, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
         g_sp = jax.tree.map(lambda a: lax.psum(a, AXIS_DATA)[None], g_sp)

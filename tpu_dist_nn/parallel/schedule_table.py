@@ -34,20 +34,30 @@ import dataclasses
 import numpy as np
 
 IDLE, FWD, BWD = 0, 1, 2
+# Zero-bubble split backward (ZB-H1): BWD_B computes the INPUT gradient
+# only (the op on the critical path — downstream stages wait for its
+# dx); BWD_W computes the WEIGHT gradient, which nothing downstream
+# consumes, so the scheduler is free to park W ops in what would
+# otherwise be bubble ticks.
+BWD_B, BWD_W = 3, 4
 
 
 @dataclasses.dataclass(frozen=True)
 class ScheduleTables:
     """Dense ``[S, T]`` int32 tables driving the table executor.
 
-    ``op``: IDLE/FWD/BWD. ``chunk``: local chunk slot (0..v-1).
-    ``mb``: microbatch id. ``stash``: stash slot to write (fwd) or read
-    (bwd). ``abuf_read``: fwd input slot (-1 = read the input feed —
-    chunk 0). ``gbuf_read``: bwd cotangent slot (-1 = loss tail — chunk
-    V-1). ``abuf_write``/``gbuf_write``: receive-buffer slot into which
-    the incoming ring payload is stored at the START of this tick (-1 =
-    discard). ``is_c0``: this bwd op belongs to global chunk 0 (its dx
-    is the input cotangent, recorded per microbatch).
+    ``op``: IDLE/FWD/BWD/BWD_B/BWD_W. ``chunk``: local chunk slot
+    (0..v-1). ``mb``: microbatch id. ``stash``: input-stash slot — write
+    for FWD, read for BWD (freeing) / BWD_B (peek) / BWD_W (freeing).
+    ``abuf_read``: fwd input slot (-1 = read the input feed — chunk 0).
+    ``gbuf_read``: bwd cotangent slot (-1 = loss tail — chunk V-1),
+    consumed by BWD or BWD_B. ``abuf_write``/``gbuf_write``:
+    receive-buffer slot into which the incoming ring payload is stored
+    at the START of this tick (-1 = discard). ``is_c0``: this bwd op
+    belongs to global chunk 0 (its dx is the input cotangent, recorded
+    per microbatch). ``dy_stash``: cotangent-stash slot bridging a
+    split backward — BWD_B writes the dy it consumed there, the
+    matching BWD_W reads and frees it (-1 for non-split ops).
     """
 
     num_devices: int
@@ -66,12 +76,23 @@ class ScheduleTables:
     abuf_write: np.ndarray
     gbuf_write: np.ndarray
     is_c0: np.ndarray
+    dybuf_slots: int = 1
+    dy_stash: np.ndarray | None = None
+
+    def dy_stash_or_empty(self) -> np.ndarray:
+        return (
+            self.dy_stash
+            if self.dy_stash is not None
+            else np.full_like(self.op, -1)
+        )
 
     @property
     def bubble_ticks(self) -> int:
-        """Idle ticks beyond the work lower bound (2*M*v per device)."""
-        v = self.num_chunks // self.num_devices
-        return self.ticks - 2 * self.num_microbatches * v
+        """Idle ticks beyond the per-device work lower bound (the max
+        non-idle op count over devices: 2*M*v for combined-backward
+        schedules, 3*M*v for split-backward ones)."""
+        per_device_ops = int((self.op != IDLE).sum(axis=1).max())
+        return self.ticks - per_device_ops
 
 
 class _SlotPool:
@@ -90,6 +111,57 @@ class _SlotPool:
 
     def release(self, slot: int) -> None:
         self.free.append(slot)
+
+
+def _emit_tables(cols: list, S: int) -> dict:
+    """THE dense-table emission pass, shared by every builder: convert
+    the scheduler's per-tick op records into the ``[S, T]`` int32
+    arrays (one definition, so a table-layout change cannot land in
+    one builder and leave the shared executor misplaying the others).
+
+    Record contract: ``op`` + (non-idle) ``c``/``f``; op-specific keys
+    ``stash``, ``abuf_read``/``send_abuf_slot`` (FWD),
+    ``gbuf_read``/``is_c0``/``send_gbuf_slot`` (BWD/BWD_B),
+    ``dy_stash`` (BWD_B write / BWD_W read). Ring sends land in the
+    receiver's ``*_write`` column at tick ``t+1`` (a send at the final
+    tick cannot exist: its receive would fall off the table, and every
+    schedule ends with an op that sends nothing).
+    """
+    T = len(cols)
+    tables = {
+        name: np.full((S, T), fill, dtype=np.int32)
+        for name, fill in [
+            ("op", IDLE), ("chunk", 0), ("mb", 0), ("stash", 0),
+            ("abuf_read", -1), ("gbuf_read", -1),
+            ("abuf_write", -1), ("gbuf_write", -1), ("is_c0", 0),
+            ("dy_stash", -1),
+        ]
+    }
+    for t_i, col in enumerate(cols):
+        for s in range(S):
+            rec = col[s]
+            op = rec["op"]
+            if op == IDLE:
+                continue
+            c, f = rec["c"], rec["f"]
+            tables["op"][s, t_i] = op
+            tables["chunk"][s, t_i] = c // S
+            tables["mb"][s, t_i] = f
+            tables["stash"][s, t_i] = rec.get("stash", 0)
+            if op == FWD:
+                tables["abuf_read"][s, t_i] = rec.get("abuf_read", -1)
+                if "send_abuf_slot" in rec:
+                    tables["abuf_write"][(c + 1) % S, t_i + 1] = rec["send_abuf_slot"]
+            elif op in (BWD, BWD_B):
+                tables["gbuf_read"][s, t_i] = rec.get("gbuf_read", -1)
+                tables["is_c0"][s, t_i] = rec.get("is_c0", 0)
+                if op == BWD_B:
+                    tables["dy_stash"][s, t_i] = rec["dy_stash"]
+                if "send_gbuf_slot" in rec:
+                    tables["gbuf_write"][(c - 1) % S, t_i + 1] = rec["send_gbuf_slot"]
+            else:  # BWD_W
+                tables["dy_stash"][s, t_i] = rec["dy_stash"]
+    return tables
 
 
 def _megatron_orders(S: int, v: int, M: int) -> list[list[tuple[str, int, int]]]:
@@ -273,46 +345,13 @@ def build_interleaved_1f1b(
         cols.append(col)
         t += 1
 
-    T = len(cols)
     A = max(p.high for p in abuf_pool) or 1
     G = max(p.high for p in gbuf_pool) or 1
     K = max(p.high for p in stash_pool) or 1
 
-    tables = {
-        name: np.full((S, T), fill, dtype=np.int32)
-        for name, fill in [
-            ("op", IDLE), ("chunk", 0), ("mb", 0), ("stash", 0),
-            ("abuf_read", -1), ("gbuf_read", -1),
-            ("abuf_write", -1), ("gbuf_write", -1), ("is_c0", 0),
-        ]
-    }
-    for t_i, col in enumerate(cols):
-        for s in range(S):
-            rec = col[s]
-            if rec["op"] == IDLE:
-                continue
-            c, f = rec["c"], rec["f"]
-            tables["op"][s, t_i] = rec["op"]
-            tables["chunk"][s, t_i] = c // S
-            tables["mb"][s, t_i] = f
-            tables["stash"][s, t_i] = rec["stash"]
-            if rec["op"] == FWD:
-                tables["abuf_read"][s, t_i] = rec.get("abuf_read", -1)
-                if "send_abuf_slot" in rec:
-                    # The receiver writes the payload at the START of
-                    # tick t+1.
-                    rs = (c + 1) % S
-                    tables["abuf_write"][rs, t_i + 1] = rec["send_abuf_slot"]
-            else:
-                tables["gbuf_read"][s, t_i] = rec.get("gbuf_read", -1)
-                tables["is_c0"][s, t_i] = rec.get("is_c0", 0)
-                if "send_gbuf_slot" in rec:
-                    rs = (c - 1) % S
-                    tables["gbuf_write"][rs, t_i + 1] = rec["send_gbuf_slot"]
-
     out = ScheduleTables(
-        num_devices=S, num_chunks=V, num_microbatches=M, ticks=T,
-        abuf_slots=A, gbuf_slots=G, stash_slots=K, **tables,
+        num_devices=S, num_chunks=V, num_microbatches=M, ticks=len(cols),
+        abuf_slots=A, gbuf_slots=G, stash_slots=K, **_emit_tables(cols, S),
     )
     verify_tables(out)
     return out
@@ -385,35 +424,185 @@ def build_interleaved_forward(
         cols.append(col)
         t += 1
 
-    T = len(cols)
     A = max(p.high for p in abuf_pool) or 1
-    tables = {
-        name: np.full((S, T), fill, dtype=np.int32)
-        for name, fill in [
-            ("op", IDLE), ("chunk", 0), ("mb", 0), ("stash", 0),
-            ("abuf_read", -1), ("gbuf_read", -1),
-            ("abuf_write", -1), ("gbuf_write", -1), ("is_c0", 0),
-        ]
-    }
-    for t_i, col in enumerate(cols):
-        for s in range(S):
-            rec = col[s]
-            if rec["op"] == IDLE:
-                continue
-            c, f = rec["c"], rec["f"]
-            tables["op"][s, t_i] = FWD
-            tables["chunk"][s, t_i] = c // S
-            tables["mb"][s, t_i] = f
-            tables["abuf_read"][s, t_i] = rec.get("abuf_read", -1)
-            if "send_abuf_slot" in rec:
-                rs = (c + 1) % S
-                tables["abuf_write"][rs, t_i + 1] = rec["send_abuf_slot"]
-
     out = ScheduleTables(
-        num_devices=S, num_chunks=V, num_microbatches=M, ticks=T,
-        abuf_slots=A, gbuf_slots=1, stash_slots=1, **tables,
+        num_devices=S, num_chunks=V, num_microbatches=M, ticks=len(cols),
+        abuf_slots=A, gbuf_slots=1, stash_slots=1, **_emit_tables(cols, S),
     )
     verify_tables(out, forward_only=True)
+    return out
+
+
+def build_zero_bubble(
+    num_devices: int,
+    num_virtual: int,
+    num_microbatches: int,
+    *,
+    couple_w: bool = False,
+) -> ScheduleTables:
+    """Compile the ZB-H1 zero-bubble schedule: backward SPLIT into
+    BWD_B (input grad — critical path) and BWD_W (weight grad — no
+    consumer), with W ops parked in what 1F1B leaves as bubble ticks.
+
+    The executor was built so "a zero-bubble variant would only add a
+    table builder" (interleaved.py:16-20) — this is that builder.
+    Greedy list scheduling under the same one-op-per-device,
+    one-tick-transport wire model: per device, priority B > F > W —
+    the input-grad chain drains as fast as dependencies allow, forwards
+    keep the pipe full, and weight grads soak up idle ticks (ZB-H1's
+    move; Qi et al., "Zero Bubble Pipeline Parallelism") — EXCEPT that
+    a W backlog of ``S`` forces a W ahead of the next forward: without
+    that cap the steady state (which has no idle ticks) would defer
+    every W to the drain, holding all ``M`` microbatch stashes live.
+    With it the input stash (held F -> W) and the cotangent stash
+    (``dy_stash``, held B -> W) stay O(S) — ~2-3 stages' worth,
+    independent of M (asserted in tests) — while the bubble stays at
+    the H1 optimum S-1 (half of 1F1B's 2(S-1) at v=1).
+
+    ``couple_w=True`` builds the CONTROL schedule: W forced immediately
+    after its B (the coupling combined-backward implies), same split-op
+    accounting — the bubble delta between the two is exactly what
+    decoupling W buys.
+    """
+    S, v, M = num_devices, num_virtual, num_microbatches
+    if S < 1 or v < 1 or M < 1:
+        raise ValueError(f"need S,v,M >= 1, got {S},{v},{M}")
+    V = S * v
+    fwd_done = np.full((V, M), -1, dtype=np.int64)
+    b_done = np.full((V, M), -1, dtype=np.int64)
+    abuf_pool = [_SlotPool() for _ in range(S)]
+    gbuf_pool = [_SlotPool() for _ in range(S)]
+    stash_pool = [_SlotPool() for _ in range(S)]
+    dybuf_pool = [_SlotPool() for _ in range(S)]
+    abuf_slot: dict[tuple[int, int], int] = {}
+    gbuf_slot: dict[tuple[int, int], int] = {}
+    stash_slot: dict[tuple[int, int], int] = {}
+    dybuf_slot: dict[tuple[int, int], int] = {}
+
+    cols: list[dict] = []
+    next_fwd = [0] * V
+    next_b = [0] * V
+    # Pending W ops per device, oldest first (their B is done).
+    w_queue: list[list[tuple[int, int]]] = [[] for _ in range(S)]
+    done_ops = 0
+    t = 0
+    max_ticks = 6 * (M * v + V) + 16  # 3 ops/chunk/mb: 1.5x the 1F1B bound
+    while done_ops < 3 * V * M:
+        if t > max_ticks:
+            raise RuntimeError(
+                f"zero-bubble schedule did not converge (S={S}, v={v}, M={M})"
+            )
+        col = [dict(op=IDLE) for _ in range(S)]
+        for s in range(S):
+            chosen = None
+            # Control arm: W is glued to its B — run it the tick after.
+            if couple_w and w_queue[s]:
+                c, f = w_queue[s][0]
+                chosen = dict(op=BWD_W, c=c, f=f)
+            if chosen is None:
+                # B first (critical path), deepest chunk first.
+                for c in range(V - 1 - ((V - 1 - s) % S), -1, -S):
+                    f = next_b[c]
+                    if f >= M or f >= next_fwd[c]:
+                        continue
+                    if fwd_done[c, f] < 0 or fwd_done[c, f] >= t:
+                        continue
+                    if c < V - 1 and (b_done[c + 1, f] < 0 or b_done[c + 1, f] + 1 > t):
+                        continue
+                    chosen = dict(op=BWD_B, c=c, f=f)
+                    break
+            if chosen is None and len(w_queue[s]) >= S:
+                # Memory guard: the steady state has no idle ticks, so
+                # an unchecked backlog defers every W to the drain and
+                # holds all M stashes live; a cap of S keeps memory
+                # O(S) without costing bubble (measured: the H1
+                # optimum S-1 survives).
+                c, f = w_queue[s][0]
+                chosen = dict(op=BWD_W, c=c, f=f)
+            if chosen is None:
+                # Forward: earliest microbatch, deepest ready chunk.
+                best = None
+                for c in range(s, V, S):
+                    f = next_fwd[c]
+                    if f >= M:
+                        continue
+                    if c > 0 and (fwd_done[c - 1, f] < 0 or fwd_done[c - 1, f] + 1 > t):
+                        continue
+                    key = (f, -c)
+                    if best is None or key < best[0]:
+                        best = (key, c, f)
+                if best is not None:
+                    chosen = dict(op=FWD, c=best[1], f=best[2])
+            if chosen is None and w_queue[s]:
+                # The zero-bubble move: weight grads fill the bubble.
+                c, f = w_queue[s][0]
+                chosen = dict(op=BWD_W, c=c, f=f)
+            if chosen is not None:
+                col[s] = chosen
+        # Commit effects (reads above saw state from ticks < t only).
+        for s in range(S):
+            rec = col[s]
+            if rec["op"] == FWD:
+                c, f = rec["c"], rec["f"]
+                slot = stash_pool[s].acquire()
+                stash_slot[(c, f)] = slot
+                rec["stash"] = slot
+                if c > 0:
+                    rslot = abuf_slot.pop((c, f))
+                    rec["abuf_read"] = rslot
+                    abuf_pool[s].release(rslot)
+                fwd_done[c, f] = t
+                next_fwd[c] = f + 1
+                done_ops += 1
+                if c < V - 1:
+                    rs = (c + 1) % S
+                    wslot = abuf_pool[rs].acquire()
+                    abuf_slot[(c + 1, f)] = wslot
+                    rec["send_abuf_slot"] = wslot
+            elif rec["op"] == BWD_B:
+                c, f = rec["c"], rec["f"]
+                rec["stash"] = stash_slot[(c, f)]  # peek — W frees it
+                dslot = dybuf_pool[s].acquire()
+                dybuf_slot[(c, f)] = dslot
+                rec["dy_stash"] = dslot
+                if c < V - 1:
+                    rslot = gbuf_slot.pop((c + 1, f))
+                    rec["gbuf_read"] = rslot
+                    gbuf_pool[s].release(rslot)
+                b_done[c, f] = t
+                next_b[c] = f + 1
+                w_queue[s].append((c, f))
+                done_ops += 1
+                rec["is_c0"] = int(c == 0)
+                if c > 0:
+                    rs = (c - 1) % S
+                    wslot = gbuf_pool[rs].acquire()
+                    gbuf_slot[(c, f)] = wslot
+                    rec["send_gbuf_slot"] = wslot
+            elif rec["op"] == BWD_W:
+                c, f = rec["c"], rec["f"]
+                w_queue[s].remove((c, f))
+                slot = stash_slot.pop((c, f))
+                rec["stash"] = slot
+                stash_pool[s].release(slot)
+                dslot = dybuf_slot.pop((c, f))
+                rec["dy_stash"] = dslot
+                dybuf_pool[s].release(dslot)
+                done_ops += 1
+        cols.append(col)
+        t += 1
+
+    A = max(p.high for p in abuf_pool) or 1
+    G = max(p.high for p in gbuf_pool) or 1
+    K = max(p.high for p in stash_pool) or 1
+    D = max(p.high for p in dybuf_pool) or 1
+
+    out = ScheduleTables(
+        num_devices=S, num_chunks=V, num_microbatches=M, ticks=len(cols),
+        abuf_slots=A, gbuf_slots=G, stash_slots=K, dybuf_slots=D,
+        **_emit_tables(cols, S),
+    )
+    verify_tables(out)
     return out
 
 
@@ -427,13 +616,17 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
     """
     S, V, M, T = tb.num_devices, tb.num_chunks, tb.num_microbatches, tb.ticks
     v = V // S
+    dy_stash_tb = tb.dy_stash_or_empty()
     abuf = [dict() for _ in range(S)]   # slot -> symbolic value
     gbuf = [dict() for _ in range(S)]
     stash = [dict() for _ in range(S)]
+    dybuf = [dict() for _ in range(S)]  # BWD_B -> BWD_W cotangent bridge
     fwd_sent: list = [None] * S  # payload in flight on the fwd ring
     bwd_sent: list = [None] * S
     fwd_count = np.zeros((V, M), dtype=int)
     bwd_count = np.zeros((V, M), dtype=int)
+    b_count = np.zeros((V, M), dtype=int)
+    w_count = np.zeros((V, M), dtype=int)
 
     for t in range(T):
         # Start of tick: receive last tick's payloads.
@@ -479,9 +672,12 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
                     stash[s][int(tb.stash[s, t])] = ("x", c, f)
                 new_fwd_sent[ (c + 1) % S ] = ("act", c, f) if c < V - 1 else None
                 fwd_count[c, f] += 1
-            else:
+            elif op in (BWD, BWD_B):
                 slot = int(tb.stash[s, t])
-                x = stash[s].pop(slot, None)
+                if op == BWD:
+                    x = stash[s].pop(slot, None)  # combined bwd frees x
+                else:
+                    x = stash[s].get(slot)  # split B peeks; W frees
                 if x != ("x", c, f):
                     raise AssertionError(
                         f"t={t} s={s}: bwd({c},{f}) stash read {x}"
@@ -499,19 +695,62 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
                         )
                 if bool(tb.is_c0[s, t]) != (c == 0):
                     raise AssertionError(f"t={t} s={s}: is_c0 mismatch for c={c}")
+                if op == BWD_B:
+                    dslot = int(dy_stash_tb[s, t])
+                    if dslot < 0:
+                        raise AssertionError(
+                            f"t={t} s={s}: split B({c},{f}) has no dy_stash slot"
+                        )
+                    if dslot in dybuf[s]:
+                        raise AssertionError(
+                            f"t={t} s={s}: dy_stash slot {dslot} clobbered"
+                        )
+                    dybuf[s][dslot] = ("dy", c, f)
+                    b_count[c, f] += 1
+                else:
+                    bwd_count[c, f] += 1
                 new_bwd_sent[ (c - 1) % S ] = ("grad", c, f) if c > 0 else None
-                bwd_count[c, f] += 1
+            else:  # BWD_W
+                slot = int(tb.stash[s, t])
+                x = stash[s].pop(slot, None)
+                if x != ("x", c, f):
+                    raise AssertionError(
+                        f"t={t} s={s}: W({c},{f}) stash read {x}"
+                    )
+                dslot = int(dy_stash_tb[s, t])
+                dy = dybuf[s].pop(dslot, None)
+                if dy != ("dy", c, f):
+                    raise AssertionError(
+                        f"t={t} s={s}: W({c},{f}) dy_stash read {dy}"
+                    )
+                if b_count[c, f] != 1:
+                    raise AssertionError(
+                        f"t={t} s={s}: W({c},{f}) ran before its B"
+                    )
+                w_count[c, f] += 1
         fwd_sent, bwd_sent = new_fwd_sent, new_bwd_sent
 
     if not (fwd_count == 1).all():
         raise AssertionError(
             "schedule did not run every (chunk, mb) FORWARD exactly once"
         )
-    if not forward_only and not (bwd_count == 1).all():
-        raise AssertionError(
-            "schedule did not run every (chunk, mb) BACKWARD exactly once"
-        )
+    split = bool(b_count.any() or w_count.any())
+    if not forward_only:
+        if split:
+            if bwd_count.any():
+                raise AssertionError("schedule mixes combined and split backward")
+            if not ((b_count == 1).all() and (w_count == 1).all()):
+                raise AssertionError(
+                    "split schedule did not run every (chunk, mb) B and W "
+                    "exactly once"
+                )
+        elif not (bwd_count == 1).all():
+            raise AssertionError(
+                "schedule did not run every (chunk, mb) BACKWARD exactly once"
+            )
     if any(abuf[s] for s in range(S)) or any(gbuf[s] for s in range(S)):
         raise AssertionError("unconsumed receive-buffer values at end")
     if any(stash[s] for s in range(S)):
         raise AssertionError("unconsumed stash values at end")
+    if any(dybuf[s] for s in range(S)):
+        raise AssertionError("unconsumed dy-stash values at end")

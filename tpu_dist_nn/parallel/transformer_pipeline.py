@@ -244,7 +244,8 @@ def unshard_blocks_interleaved(staged: dict) -> dict:
 
 def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
                                       num_virtual: int, num_microbatches: int,
-                                      attn_fn=dot_product_attention):
+                                      attn_fn=dot_product_attention,
+                                      tables=None):
     """-> ``f(params, tokens) -> (loss, grads)`` via the interleaved
     (virtual-stage) 1F1B schedule — Megatron-style: each device holds
     ``num_virtual`` non-contiguous block chunks, cutting the pipeline
@@ -264,8 +265,33 @@ def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
         mesh, stage_fn, tail_fn, num_virtual, num_microbatches,
         microbatch_spec=P(AXIS_DATA, None, None),
         aux_spec=P(None, AXIS_DATA, None),
+        tables=tables,
     )
     return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
+
+
+def make_pipeline_lm_zb_grad(mesh, cfg: TransformerConfig,
+                             num_virtual: int, num_microbatches: int,
+                             attn_fn=dot_product_attention):
+    """-> ``f(params, tokens) -> (loss, grads)`` via the ZB-H1
+    zero-bubble schedule: backward split into input-grad (BWD_B, the
+    critical path) and weight-grad (BWD_W, parked in bubble ticks),
+    halving the pipeline bubble vs 1F1B (S-1 vs 2(S-1) ticks at v=1 —
+    asserted in tests) at the cost of one extra recompute per
+    microbatch. Same semantics as
+    ``jax.value_and_grad(make_pipeline_lm_loss)`` (parity-tested); same
+    :func:`shard_blocks_interleaved` block layout as the interleaved
+    schedule (``num_virtual=1`` for the classic contiguous placement).
+    """
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
+
+    tables = build_zero_bubble(
+        mesh.shape[_AS], num_virtual, num_microbatches
+    )
+    return make_pipeline_lm_interleaved_grad(
+        mesh, cfg, num_virtual, num_microbatches, attn_fn, tables=tables
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -497,7 +523,8 @@ def unshard_blocks_interleaved_tp(staged: dict, cfg: TransformerConfig) -> dict:
 def make_pipeline_tp_lm_interleaved_grad(mesh, cfg: TransformerConfig,
                                          num_virtual: int,
                                          num_microbatches: int,
-                                         attn_fn=dot_product_attention):
+                                         attn_fn=dot_product_attention,
+                                         tables=None):
     """-> ``f(params, tokens) -> (loss, grads)``: interleaved
     (virtual-stage) 1F1B x Megatron TP — the last cell of the
     schedule x sharding matrix (gpipe x TP, 1F1B x TP landed earlier).
@@ -543,5 +570,26 @@ def make_pipeline_tp_lm_interleaved_grad(mesh, cfg: TransformerConfig,
         microbatch_spec=P(AXIS_DATA, None, None),
         chunk_params_spec=blocks_spec,
         aux_spec=P(None, AXIS_DATA, None),
+        tables=tables,
     )
     return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
+
+
+def make_pipeline_tp_lm_zb_grad(mesh, cfg: TransformerConfig,
+                                num_virtual: int, num_microbatches: int,
+                                attn_fn=dot_product_attention):
+    """ZB-H1 x Megatron TP: the zero-bubble tables played back with
+    psum-bearing chunk bodies — legal by the same [device, tick]
+    model-invariance argument as :func:`make_pipeline_tp_lm_interleaved_grad`
+    (the split W op adds no wire traffic, so nothing new crosses the
+    ring). Blocks in :func:`shard_blocks_interleaved_tp` layout.
+    """
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
+
+    tables = build_zero_bubble(
+        mesh.shape[_AS], num_virtual, num_microbatches
+    )
+    return make_pipeline_tp_lm_interleaved_grad(
+        mesh, cfg, num_virtual, num_microbatches, attn_fn, tables=tables
+    )

@@ -212,6 +212,15 @@ def _dryrun_lm_1f1b(n_devices: int) -> None:
     jax.block_until_ready(new_params)
     assert float(loss) > 0
 
+    # Zero-bubble (ZB-H1) split-backward schedule — same layout, new
+    # tables + the BWD_B/BWD_W executor branches (round 4).
+    step_zb = make_pipeline_lm_train_step(
+        mesh, cfg, stage, 2, optimizer, schedule="zb", num_virtual=1
+    )
+    new_params, _, loss = step_zb(params_v, optimizer.init(params_v), tokens)
+    jax.block_until_ready(new_params)
+    assert float(loss) > 0
+
 
 def _dryrun_zero_fsdp(n_devices: int) -> None:
     """ZeRO-1 and FSDP sharded-state steps (with per-block remat):

@@ -118,23 +118,20 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
             f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
             f"axis has size {mesh.shape.get(AXIS_MODEL, 1)}"
         )
-    if schedule == "interleaved":
-        if tensor_parallel > 1:
-            from tpu_dist_nn.parallel.transformer_pipeline import (
-                make_pipeline_tp_lm_interleaved_grad,
-            )
+    if schedule in ("interleaved", "zb"):
+        # Both ride the table executor on the shard_blocks_interleaved
+        # (or _tp) layout; "zb" swaps in the split-backward zero-bubble
+        # tables. schedule="zb" defaults to the classic contiguous
+        # placement unless num_virtual > 1 is requested explicitly.
+        from tpu_dist_nn.parallel import transformer_pipeline as tpl
 
-            vag = make_pipeline_tp_lm_interleaved_grad(
-                mesh, cfg, num_virtual, num_microbatches, attn
-            )
-        else:
-            from tpu_dist_nn.parallel.transformer_pipeline import (
-                make_pipeline_lm_interleaved_grad,
-            )
-
-            vag = make_pipeline_lm_interleaved_grad(
-                mesh, cfg, num_virtual, num_microbatches, attn
-            )
+        make = {
+            ("interleaved", False): tpl.make_pipeline_lm_interleaved_grad,
+            ("interleaved", True): tpl.make_pipeline_tp_lm_interleaved_grad,
+            ("zb", False): tpl.make_pipeline_lm_zb_grad,
+            ("zb", True): tpl.make_pipeline_tp_lm_zb_grad,
+        }[(schedule, tensor_parallel > 1)]
+        vag = make(mesh, cfg, num_virtual, num_microbatches, attn)
         return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule == "1f1b":
         if tensor_parallel > 1:
@@ -267,7 +264,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         )
     if step_fn is not None:
         step = step_fn(optimizer)
-    elif pipelined and schedule == "interleaved":
+    elif pipelined and schedule in ("interleaved", "zb"):
         from tpu_dist_nn.parallel.transformer_pipeline import (
             shard_blocks_interleaved,
         )
@@ -333,7 +330,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     else:
         flush(checkpoints)
     if pipelined:
-        if schedule == "interleaved":
+        if schedule in ("interleaved", "zb"):
             from tpu_dist_nn.parallel.transformer_pipeline import (
                 unshard_blocks_interleaved,
             )

@@ -84,6 +84,15 @@ def make_pipeline_train_step(
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if schedule == "zb":
+        # Silently falling through to gpipe would let a user benchmark
+        # the wrong schedule; the split-backward executor exists on the
+        # LM path only (lm_trainer.make_pipeline_lm_train_step).
+        raise ValueError(
+            "schedule='zb' (zero-bubble) is implemented for the "
+            "transformer LM pipeline only (tdn lm --schedule zb); the "
+            "dense classifier pipeline supports gpipe/1f1b/interleaved"
+        )
     if num_virtual > 1 and schedule != "interleaved":
         raise ValueError(
             f"num_virtual={num_virtual} only applies to "
