@@ -45,3 +45,23 @@ def test_centralized_experiments_on_real_digits(tmp_path):
     assert metrics["accuracy"] > 0.9  # real generalization, real data
     obj = ce.experiment_export(params, metrics, tmp_path / "m.json")
     assert obj["inference_metrics"]["accuracy"] == metrics["accuracy"]
+
+
+def test_deep_pipeline_8stage_experiment(tmp_path):
+    # BASELINE configs[2] closure (artifacts/deep_pipeline_r04): the
+    # 8-layer MLP trains THROUGH the one-layer-per-stage 8-device
+    # pipeline on real digits, exports, re-serves at three placements,
+    # and the deep placement's latency overhead tracks the tick model.
+    import deep_pipeline_8stage as dp
+
+    record = dp.run(str(tmp_path / "deep8.json"), epochs=6)
+    assert record["placement"]["num_stages"] == 8
+    assert record["held_out_accuracy"] > 0.85  # real data, short budget
+    lat = record["step_latency"]
+    assert lat["deep_8stage"]["num_stages"] == 8
+    assert lat["shallow_3stage"]["num_stages"] == 3
+    assert lat["single_chip"]["num_stages"] == 1
+    for block in lat.values():
+        assert block["p50_per_stage_s"] > 0
+    # Deeper pipeline, same model: more fill/drain ticks per step.
+    assert lat["deep_8stage"]["p50_s"] > lat["shallow_3stage"]["p50_s"]
