@@ -603,6 +603,7 @@ def cmd_lm(args) -> int:
     mesh = None
     step_fn = None
     unshard_fn = None
+    shard_fn = None  # applied to freshly-init params before training
     global_mesh = None  # the mesh cross-host batches assemble over, if any
     global_span = 1     # how many ways that mesh shards the batch axis
     global_axes = "_data_"
@@ -641,6 +642,9 @@ def cmd_lm(args) -> int:
             step_fn = lambda opt: make_moe_lm_train_step(cfg, opt, ep_mesh)  # noqa: E731
             # The EP executor always expects the ep_shard_blocks layout,
             # including the degenerate ep=1 case (leading shard dim of 1).
+            shard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=ep_shard_blocks(p["blocks"], ep)
+            )
             unshard_fn = lambda p: dict(  # noqa: E731
                 p, blocks=ep_unshard_blocks(p["blocks"])
             )
@@ -655,17 +659,56 @@ def cmd_lm(args) -> int:
                     "--zero1/--fsdp compose with --data-parallel only "
                     "(state already lives per-stage in the pipeline)"
                 )
-            if args.seq_parallel > 1:
-                raise ValueError(
-                    "--seq-parallel with --stages is not supported yet"
-                )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 
-            mesh = build_mesh(
-                MeshSpec(stage=args.stages, data=args.data_parallel)
-            )
-            global_mesh, global_span = mesh, args.data_parallel
-            global_axes = "_data_"
+            if args.seq_parallel > 1:
+                # Pipeline x sequence parallelism: blocks over `stage`,
+                # each microbatch's sequence dim over `seq` (ring/
+                # Ulysses attention inside the stage), batch over
+                # `data`. Rows carry seq_len+1 tokens (the sp loss
+                # masks position 0 instead of slicing).
+                from tpu_dist_nn.parallel.transformer_pipeline import (
+                    shard_blocks,
+                    unshard_blocks,
+                )
+                from tpu_dist_nn.train.lm_trainer import (
+                    make_pipeline_sp_lm_train_step,
+                )
+
+                if (args.seq_len + 1) % args.seq_parallel:
+                    raise ValueError(
+                        f"--seq-len+1 ({args.seq_len + 1}) must be "
+                        f"divisible by --seq-parallel {args.seq_parallel} "
+                        "(rows carry the next-token target)"
+                    )
+                if args.batch_size % (args.microbatches * args.data_parallel):
+                    raise ValueError(
+                        f"--batch-size {args.batch_size} must be divisible "
+                        f"by microbatches*data_parallel="
+                        f"{args.microbatches * args.data_parallel}"
+                    )
+                pp_sp_mesh = build_mesh(MeshSpec(
+                    stage=args.stages, seq=args.seq_parallel,
+                    data=args.data_parallel,
+                ))
+                global_mesh, global_span = pp_sp_mesh, args.data_parallel
+                global_axes = "_data_"
+                _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
+                step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
+                    pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode
+                )
+                shard_fn = lambda p: dict(  # noqa: E731
+                    p, blocks=shard_blocks(p["blocks"], _stages)
+                )
+                unshard_fn = lambda p: dict(  # noqa: E731
+                    p, blocks=unshard_blocks(p["blocks"])
+                )
+            else:
+                mesh = build_mesh(
+                    MeshSpec(stage=args.stages, data=args.data_parallel)
+                )
+                global_mesh, global_span = mesh, args.data_parallel
+                global_axes = "_data_"
         elif args.seq_parallel > 1:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
             from tpu_dist_nn.train.lm_trainer import (
@@ -786,11 +829,8 @@ def cmd_lm(args) -> int:
     # (nproc > 1 with no global mesh: train_lm logs the replicated-
     # training warning — the single funnel for that condition.)
     params = init_fn(jax.random.key(args.seed), cfg)
-    if unshard_fn is not None:  # EP mesh path: apply the shard layout
-        params = dict(
-            params,
-            blocks=ep_shard_blocks(params["blocks"], args.expert_parallel),
-        )
+    if shard_fn is not None:  # sharded-layout paths (EP, pipeline x sp)
+        params = shard_fn(params)
     log.info(
         "tiny-transformer%s: %d params, corpus=%s, %d train rows, %d eval rows",
         f" (MoE x{args.experts})" if moe else "",

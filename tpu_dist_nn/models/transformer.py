@@ -235,6 +235,16 @@ def next_token_ce(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     return -jnp.mean(ll)
 
 
+def masked_next_token_ce(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token CE on FULL (input+target) rows: score positions
+    ``0..T-2`` against targets ``1..T-1`` instead of slicing the input
+    (the shifted slice would break seq-axis divisibility). The single
+    definition of the sequence-parallel loss convention — shared by the
+    sp-only path (ring_attention) and pipeline x sp, which are
+    documented as numerically comparable BECAUSE they call this."""
+    return next_token_ce(logits[:, :-1], tokens[:, 1:])
+
+
 def lm_loss(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
             attn_fn=dot_product_attention) -> jnp.ndarray:
     """Next-token cross-entropy (mean nats/token) on ``(batch, T)`` tokens."""

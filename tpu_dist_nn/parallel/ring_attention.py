@@ -213,13 +213,11 @@ def make_seq_parallel_lm_loss(mesh, cfg: TransformerConfig, mode: str = "ring"):
     loss masks position 0 instead: feed the full sequence, score
     predictions at positions ``0..T-2`` against targets ``1..T-1``.
     """
+    from tpu_dist_nn.models.transformer import masked_next_token_ce
+
     fwd = make_seq_parallel_lm_forward(mesh, cfg, mode)
 
     def loss_fn(params, tokens):
-        logits = fwd(params, tokens)  # (B, T, V)
-        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
-        targets = tokens[:, 1:]
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return masked_next_token_ce(fwd(params, tokens), tokens)
 
     return loss_fn

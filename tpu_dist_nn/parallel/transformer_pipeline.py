@@ -55,13 +55,18 @@ def unshard_blocks(staged: dict) -> dict:
 
 def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
                              num_microbatches: int,
-                             attn_fn=dot_product_attention):
+                             attn_fn=dot_product_attention,
+                             microbatch_spec=None):
     """-> ``fn(params, tokens) -> logits`` with blocks pipelined.
 
     ``params`` is the standard transformer pytree but with
     ``params["blocks"]`` regrouped by :func:`shard_blocks`.
     ``tokens: (B, T)`` with ``B`` divisible by
-    ``num_microbatches * mesh data size``.
+    ``num_microbatches * mesh data size``. ``microbatch_spec``
+    partitions one (B/M, T, d_model) microbatch (default: batch over
+    ``data``) — the pp x sp composition passes a seq-sharded spec and
+    a seq-aware ``attn_fn`` through here rather than duplicating this
+    body.
     """
 
     apply = maybe_remat(cfg)
@@ -76,7 +81,7 @@ def make_pipeline_lm_forward(mesh, cfg: TransformerConfig, num_stages: int,
 
     gpipe = make_gpipe(
         mesh, stage_fn, num_stages, num_microbatches,
-        microbatch_spec=P(AXIS_DATA, None, None),
+        microbatch_spec=microbatch_spec or P(AXIS_DATA, None, None),
     )
 
     def fn(params, tokens):
@@ -292,6 +297,83 @@ def make_pipeline_lm_zb_grad(mesh, cfg: TransformerConfig,
     return make_pipeline_lm_interleaved_grad(
         mesh, cfg, num_virtual, num_microbatches, attn_fn, tables=tables
     )
+
+
+# ---------------------------------------------------------------------------
+# Pipeline x sequence parallelism (long context through the pipeline)
+# ---------------------------------------------------------------------------
+
+def make_pipeline_sp_lm_forward(mesh, cfg: TransformerConfig,
+                                num_stages: int, num_microbatches: int,
+                                mode: str = "ring"):
+    """-> ``fn(params, tokens) -> logits``: blocks pipelined over
+    ``stage`` with the SEQUENCE dim of every microbatch sharded over
+    ``seq`` — long-context training through the pipeline (the
+    composition ``tdn lm --stages S --seq-parallel N`` used to reject).
+
+    Inside a stage, attention runs the ring (K/V rotation) or Ulysses
+    (head-scatter all_to_all) decomposition over ``seq``
+    (:mod:`tpu_dist_nn.parallel.ring_attention`); between stages the
+    seq-sharded activation rides the same single-``ppermute`` GPipe hop
+    (each seq peer forwards its own block — no gather at stage
+    boundaries, so the wire cost per hop is T/N, not T). Legal inside
+    the schedule for the reason TP is: the schedule's step index never
+    consults ``seq``, so every seq peer of a ring hop or all_to_all
+    takes the same branch at the same step and the collectives pair
+    (one_f_one_b.make_1f1b docstring's disjoint-axis rule).
+
+    ``tokens`` are FULL (input+target) rows, as in the sp-only path:
+    the shifted ``[:, :-1]`` slice would break seq divisibility, so the
+    loss masks position 0 instead (ring_attention.make_seq_parallel_lm_loss).
+    Embedding/unembed run outside the schedule on globally-sharded
+    arrays (global positions are correct under any sharding).
+    """
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    seq_devices = mesh.shape[AXIS_SEQ]
+    # (ulysses' n_heads % seq check lives in ulysses_attention itself —
+    # one definition, raised at trace time.)
+    base = make_pipeline_lm_forward(
+        mesh, cfg, num_stages, num_microbatches, _sp_attn_fn(mode),
+        microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+    )
+
+    def fn(params, tokens):
+        T = tokens.shape[1]
+        if T % seq_devices:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis "
+                f"{seq_devices} (sp feeds full input+target rows: pick "
+                "seq_len so seq_len+1 divides)"
+            )
+        if T > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len "
+                f"{cfg.max_seq_len} (sp feeds full input+target rows: "
+                "size the table seq_len+1)"
+            )
+        return base(params, tokens)
+
+    return fn
+
+
+def make_pipeline_sp_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
+                             num_microbatches: int, mode: str = "ring"):
+    """Next-token CE through the pipelined seq-parallel forward —
+    position-0-masked, exactly the sp-only loss's convention
+    (ring_attention.make_seq_parallel_lm_loss), so the two paths are
+    numerically comparable."""
+    from tpu_dist_nn.models.transformer import masked_next_token_ce
+
+    fwd = make_pipeline_sp_lm_forward(
+        mesh, cfg, num_stages, num_microbatches, mode
+    )
+
+    def loss_fn(params, tokens):
+        return masked_next_token_ce(fwd(params, tokens), tokens)
+
+    return loss_fn
 
 
 # ---------------------------------------------------------------------------

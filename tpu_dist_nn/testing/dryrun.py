@@ -290,6 +290,27 @@ def _dryrun_transformer_sp_tp(n_devices: int) -> None:
     )
     jax.block_until_ready(g)
 
+    if n_devices % 4 == 0:
+        # Pipeline x sequence parallelism (round 4): ring attention
+        # inside pipelined stage bodies, seq-sharded wires.
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            make_pipeline_sp_lm_loss,
+            shard_blocks,
+        )
+
+        mesh_pp_sp = build_mesh(
+            MeshSpec(stage=2, seq=2, data=n_devices // 4)
+        )
+        loss_fn = make_pipeline_sp_lm_loss(mesh_pp_sp, cfg, 2, 2)
+        params_pp = dict(params, blocks=shard_blocks(params["blocks"], 2))
+        g = jax.jit(jax.grad(loss_fn))(
+            params_pp, jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (2 * (n_devices // 4), 16)),
+                jnp.int32,
+            )
+        )
+        jax.block_until_ready(g)
+
     if not _full_tier():
         return
     # Tensor-parallel decode: Megatron-sharded heads + KV cache.

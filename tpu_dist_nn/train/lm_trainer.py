@@ -164,6 +164,30 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
+def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
+                                   num_stages: int, num_microbatches: int,
+                                   optimizer, mode: str = "ring"):
+    """Pipeline x sequence-parallel train step: blocks pipelined over
+    ``stage`` (GPipe, AD through the schedule), each microbatch's
+    sequence dim sharded over ``seq`` with ring/Ulysses attention,
+    batch over ``data``. Blocks in
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`
+    layout; tokens are full (input+target) rows (the sp loss masks
+    position 0 — ring_attention.py)."""
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        make_pipeline_sp_lm_loss,
+    )
+
+    return jax.jit(
+        make_step_body(
+            make_pipeline_sp_lm_loss(
+                mesh, cfg, num_stages, num_microbatches, mode
+            ),
+            optimizer,
+        )
+    )
+
+
 def make_seq_parallel_lm_train_step(mesh, cfg: TransformerConfig, optimizer,
                                     mode: str = "ring"):
     """Sequence-parallel train step over the mesh's ``seq`` axis —
