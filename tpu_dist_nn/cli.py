@@ -523,9 +523,8 @@ def cmd_lm(args) -> int:
     )
 
     moe = args.experts > 0
-    if moe and args.stages > 1:
-        raise ValueError("--experts is not combinable with --stages "
-                         "(MoE pipelines are not implemented)")
+    # (MoE x --seq-parallel is rejected below with the other
+    # seq-parallel compatibility checks, with or without --stages.)
     if not moe and args.expert_parallel > 1:
         raise ValueError("--expert-parallel requires --experts > 0")
     if args.sample_tensor_parallel > 1 and args.sample_bytes <= 0:
@@ -628,7 +627,47 @@ def cmd_lm(args) -> int:
         )
         init_fn, eval_fn = init_moe_transformer, evaluate_moe_lm
         ep, dp = args.expert_parallel, args.data_parallel
-        if ep > 1 or dp > 1:
+        if args.stages > 1:
+            # Pipeline x expert parallelism: MoE blocks pipelined over
+            # `stage`, experts sharded over `expert` inside each stage,
+            # batch over (data, expert) — round 4, previously rejected.
+            from tpu_dist_nn.parallel.expert_parallel import (
+                shard_blocks_pp_ep,
+                unshard_blocks_pp_ep,
+            )
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.train.lm_trainer import (
+                make_pipeline_moe_lm_train_step,
+            )
+
+            if args.layers % args.stages:
+                raise ValueError(
+                    f"--layers {args.layers} must be divisible by "
+                    f"--stages {args.stages}"
+                )
+            if args.batch_size % (args.microbatches * max(ep, 1) * dp):
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible by "
+                    f"microbatches*expert_parallel*data_parallel="
+                    f"{args.microbatches * max(ep, 1) * dp}"
+                )
+            pp_ep_mesh = build_mesh(MeshSpec(
+                stage=args.stages, expert=max(ep, 1), data=dp
+            ))
+            global_mesh, global_span = pp_ep_mesh, max(ep, 1) * dp
+            global_axes = "_data_expert_"
+            _stages, _mb = args.stages, args.microbatches
+            step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
+                pp_ep_mesh, cfg, _stages, _mb, opt
+            )
+            _ep = max(ep, 1)
+            shard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=shard_blocks_pp_ep(p["blocks"], _stages, _ep)
+            )
+            unshard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=unshard_blocks_pp_ep(p["blocks"])
+            )
+        elif ep > 1 or dp > 1:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 
             if args.batch_size % (ep * dp):

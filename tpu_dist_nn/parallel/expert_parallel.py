@@ -258,6 +258,14 @@ def moe_lm_loss(params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
 #: over ``expert`` — attention runs data-parallel on that axis.
 EP_SHARDED = frozenset({"w_up", "b_up", "w_down", "b_down"})
 
+#: Every MoE block leaf — the single leaf inventory used for per-leaf
+#: sharding specs by both the flat EP executor and the pipelined
+#: composition.
+MOE_BLOCK_KEYS = (
+    "ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_o", "b_o",
+    "ln2_g", "ln2_b", "w_router", "w_up", "b_up", "w_down", "b_down",
+)
+
 
 def ep_shard_blocks(blocks: dict, n_ep: int) -> dict:
     """Expert leaves ``(L, E, ...) -> (n_ep, L, E/n_ep, ...)``."""
@@ -289,23 +297,10 @@ def ep_unshard_blocks(staged: dict) -> dict:
     return out
 
 
-def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
-                       with_loss: bool = False):
-    """-> ``fn(params_ep, tokens)`` with experts sharded over ``expert``.
-
-    ``params_ep["blocks"]`` must come from :func:`ep_shard_blocks`.
-    Batch shards over ``(data, expert)`` jointly; inside each MoE layer
-    the dispatch buffer rides ``lax.all_to_all`` over the ``expert``
-    axis so each device computes only its local experts. Returns logits
-    (or, with ``with_loss``, the scalar CE+aux loss) — numerically
-    identical to the grouped single-chip oracle with
-    ``n_groups = mesh.shape['data'] * mesh.shape['expert']`` (one
-    routing group per device shard).
-    """
-    n_ep = mesh.shape[AXIS_EXPERT]
-    E = cfg.n_experts
-    if E % n_ep:
-        raise ValueError(f"n_experts={E} not divisible by expert axis {n_ep}")
+def _make_ep_ffn(cfg: MoEConfig):
+    """THE sharded routed-FFN body (route, all_to_all dispatch, local
+    expert bank, all_to_all return) — one definition shared by the flat
+    EP executor and the pipelined composition."""
 
     def ep_ffn(block, h):
         """Sharded routed FFN on this device's token shard ``h (b, T, D)``."""
@@ -333,6 +328,29 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
         )  # back to (E, C, D), rows for this shard's tokens
         y = jnp.einsum("sec,ecd->sd", combine, out.astype(jnp.float32))
         return y.astype(h.dtype).reshape(b, T, D), aux
+
+    return ep_ffn
+
+
+def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
+                       with_loss: bool = False):
+    """-> ``fn(params_ep, tokens)`` with experts sharded over ``expert``.
+
+    ``params_ep["blocks"]`` must come from :func:`ep_shard_blocks`.
+    Batch shards over ``(data, expert)`` jointly; inside each MoE layer
+    the dispatch buffer rides ``lax.all_to_all`` over the ``expert``
+    axis so each device computes only its local experts. Returns logits
+    (or, with ``with_loss``, the scalar CE+aux loss) — numerically
+    identical to the grouped single-chip oracle with
+    ``n_groups = mesh.shape['data'] * mesh.shape['expert']`` (one
+    routing group per device shard).
+    """
+    n_ep = mesh.shape[AXIS_EXPERT]
+    E = cfg.n_experts
+    if E % n_ep:
+        raise ValueError(f"n_experts={E} not divisible by expert axis {n_ep}")
+
+    ep_ffn = _make_ep_ffn(cfg)
 
     def device_fn(embed_params, blocks_ep, tokens):
         from tpu_dist_nn.models.transformer import embed, unembed
@@ -362,9 +380,7 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
 
     blocks_specs = {
         k: (P(AXIS_EXPERT) if k in EP_SHARDED else P())
-        for k in ("ln1_g", "ln1_b", "w_qkv", "b_qkv", "w_o", "b_o",
-                  "ln2_g", "ln2_b", "w_router",
-                  "w_up", "b_up", "w_down", "b_down")
+        for k in MOE_BLOCK_KEYS
     }
     fn = jax.shard_map(
         device_fn,
@@ -386,3 +402,122 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
         return fn(embed_params, params_ep["blocks"], tokens)
 
     return forward
+
+
+# ---------------------------------------------------------------------------
+# Pipeline x expert parallelism (MoE through the pipeline)
+# ---------------------------------------------------------------------------
+
+def shard_blocks_pp_ep(blocks: dict, num_stages: int, n_ep: int) -> dict:
+    """Stacked MoE blocks -> pipeline + expert layout: EP-sharded
+    leaves ``(L, E, ...) -> (S, n_ep, L/S, E/n_ep, ...)`` (stage
+    leading, expert shard second), replicated leaves
+    ``(L, ...) -> (S, L/S, ...)``."""
+    L = blocks["w_router"].shape[0]
+    if L % num_stages:
+        raise ValueError(f"n_layers={L} not divisible by num_stages={num_stages}")
+    ep = ep_shard_blocks(blocks, n_ep)  # sharded leaves: (n_ep, L, E/n_ep, ...)
+    out = {}
+    for k, v in ep.items():
+        if k in EP_SHARDED:
+            r = v.reshape(n_ep, num_stages, L // num_stages, *v.shape[2:])
+            out[k] = jnp.swapaxes(r, 0, 1)
+        else:
+            out[k] = v.reshape(num_stages, L // num_stages, *v.shape[1:])
+    return out
+
+
+def unshard_blocks_pp_ep(staged: dict) -> dict:
+    """Inverse of :func:`shard_blocks_pp_ep`: back to stacked ``(L, ...)``."""
+    ep = {}
+    for k, v in staged.items():
+        if k in EP_SHARDED:  # (S, n_ep, L/S, ...) -> (n_ep, L, ...)
+            r = jnp.swapaxes(v, 0, 1)
+            ep[k] = r.reshape(r.shape[0], -1, *r.shape[3:])
+        else:  # (S, L/S, ...) -> (L, ...)
+            ep[k] = v.reshape(-1, *v.shape[2:])
+    return ep_unshard_blocks(ep)
+
+
+def make_pipeline_ep_lm_loss(mesh, cfg: MoEConfig, num_stages: int,
+                             num_microbatches: int,
+                             attn_fn=dot_product_attention):
+    """-> ``loss_fn(params, tokens) -> scalar``: MoE blocks pipelined
+    over ``stage`` with experts sharded over ``expert`` inside each
+    stage — the composition ``tdn lm --experts E --stages S`` used to
+    reject. Batch shards over ``(data, expert)`` jointly, exactly as in
+    the flat EP executor; each MoE layer's all_to_all dispatch runs
+    inside the stage body, which is legal inside the schedule by the
+    disjoint-axis rule (the step index never consults ``expert``;
+    one_f_one_b.make_1f1b docstring).
+
+    Numerics: identical to the grouped single-chip oracle
+    ``moe_lm_loss(..., n_groups = num_microbatches * data * expert)``
+    — each (microbatch, shard) pair is one routing group, so the
+    pipelined and oracle paths run the same grouped math
+    (parity-tested). Router aux losses ride the executor's masked aux
+    channel (:func:`~tpu_dist_nn.parallel.gpipe.make_gpipe` with_aux)
+    and are normalized to the oracle's mean-over-blocks-and-groups.
+
+    ``params["blocks"]`` must be in :func:`shard_blocks_pp_ep` layout.
+    """
+    from tpu_dist_nn.models.transformer import embed, unembed
+    from tpu_dist_nn.parallel.gpipe import make_gpipe
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
+
+    n_ep = mesh.shape[AXIS_EXPERT]
+    if cfg.n_experts % n_ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by expert axis {n_ep}"
+        )
+    S, M = num_stages, num_microbatches
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+    ep_ffn = _make_ep_ffn(cfg)
+
+    def stage_fn(stage_blocks, x):
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in stage_blocks.items()
+        }
+
+        def body(carry, block):
+            y, aux = moe_block_apply(
+                block, carry, cfg, attn_fn=attn_fn, ffn_fn=ep_ffn
+            )
+            return y, aux
+
+        y, auxs = lax.scan(body, x, blocks)
+        return y, jnp.mean(auxs)
+
+    blocks_spec = {
+        k: (P(AXIS_STAGE, AXIS_EXPERT) if k in EP_SHARDED else P(AXIS_STAGE))
+        for k in MOE_BLOCK_KEYS
+    }
+    gpipe = make_gpipe(
+        mesh, stage_fn, S, M,
+        microbatch_spec=P((AXIS_DATA, AXIS_EXPERT), None, None),
+        stage_params_spec=blocks_spec,
+        with_aux=True,
+    )
+
+    def loss_fn(params, tokens):
+        params = cfg.cast_params(params)
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        B, T = inp.shape
+        if B % (M * n_shards):
+            raise ValueError(
+                f"batch {B} not divisible by microbatches*data*expert "
+                f"shards = {M * n_shards}"
+            )
+        embed_params = {k: v for k, v in params.items() if k != "blocks"}
+        x = embed(embed_params, inp)
+        xs = x.reshape(M, B // M, T, cfg.d_model)
+        ys, aux_sum = gpipe(xs, params["blocks"])
+        logits = unembed(embed_params, ys.reshape(B, T, cfg.d_model))
+        ce = next_token_ce(logits, tgt)
+        # aux_sum carries one per-stage block-group-mean term per
+        # (stage, microbatch, shard); dividing by the term count gives
+        # the oracle's mean over blocks and groups.
+        aux = aux_sum / (S * M * n_shards)
+        return ce + cfg.router_aux_weight * aux
+
+    return loss_fn

@@ -28,12 +28,22 @@ def gpipe_device_fn(
     num_stages: int,
     num_microbatches: int,
     extra_vary_axes: tuple[str, ...] = (),
+    with_aux: bool = False,
 ):
     """Build the per-device body to run under shard_map.
 
     ``xs``: (M, *microbatch_shape) input microbatches, replicated over
     the stage axis (only stage 0 consumes them). ``stage_params``: any
     pytree whose leaves carry a leading length-1 stage-shard axis.
+
+    ``with_aux=True`` changes the stage contract to
+    ``stage_fn(params, x) -> (y, aux_scalar)`` (e.g. an MoE stage's
+    router load-balancing loss): aux values from VALID ticks only
+    (stage ``s`` computes real microbatches at ``t in [s, s+M-1]``;
+    fill/drain ticks run on zero state and must not pollute the sum)
+    are accumulated and returned psum'd over every varying axis —
+    a replicated scalar SUM over (stage, microbatch, shard) terms the
+    caller normalizes.
     """
     S, M = num_stages, num_microbatches
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
@@ -46,8 +56,10 @@ def gpipe_device_fn(
         # genuinely differs per stage/data coordinate once the schedule
         # runs).
         state0 = lax.pcast(jnp.zeros(xs.shape[1:], xs.dtype), vary_axes, to="varying")
+        aux0 = lax.pcast(jnp.zeros((), jnp.float32), vary_axes, to="varying")
 
-        def step(state, t):
+        def step(carry, t):
+            state, aux_acc = carry
             inp = lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
@@ -56,17 +68,27 @@ def gpipe_device_fn(
             # device traces (utils.profiling.capture_trace) — the trace-
             # level analogue of the reference's per-hop RPC timers.
             with jax.named_scope("gpipe_stage_compute"):
-                y = stage_fn(params, x)
+                if with_aux:
+                    y, aux = stage_fn(params, x)
+                    valid = (t >= s_idx) & (t <= s_idx + M - 1)
+                    aux_acc = aux_acc + jnp.where(
+                        valid, aux.astype(jnp.float32), 0.0
+                    )
+                else:
+                    y = stage_fn(params, x)
             with jax.named_scope("gpipe_ppermute_hop"):
                 nxt = lax.ppermute(y, AXIS_STAGE, fwd_perm) if fwd_perm else y
-            return nxt, y
+            return (nxt, aux_acc), y
 
-        _, ys = lax.scan(step, state0, jnp.arange(S + M - 1))
+        (_, aux_acc), ys = lax.scan(step, (state0, aux0), jnp.arange(S + M - 1))
         outs = ys[S - 1 :]  # microbatch m exits the tail at t = m + S - 1
         # Only the tail stage's emissions are the model output; psum
         # replicates them to every stage coordinate.
         outs = jnp.where(s_idx == S - 1, outs, jnp.zeros((), outs.dtype))
-        return lax.psum(outs, AXIS_STAGE)
+        outs = lax.psum(outs, AXIS_STAGE)
+        if with_aux:
+            return outs, lax.psum(aux_acc, vary_axes)
+        return outs
 
     return device_fn
 
@@ -79,6 +101,7 @@ def make_gpipe(
     *,
     microbatch_spec: P | None = None,
     stage_params_spec=None,
+    with_aux: bool = False,
 ):
     """shard_map the schedule over the mesh.
 
@@ -88,7 +111,10 @@ def make_gpipe(
     pytree for the stage params (default: every leaf ``P(stage)``) —
     used to compose further axes inside a stage, e.g. a tensor-parallel
     ``P(stage, model)`` layout whose model dim ``stage_fn`` strips
-    itself. Returns ``f(xs, stage_params) -> (M, *microbatch_shape)``.
+    itself. Returns ``f(xs, stage_params) -> (M, *microbatch_shape)``
+    — or, ``with_aux=True`` (stage_fn returns ``(y, aux)``),
+    ``f(...) -> (outs, aux_sum)`` with ``aux_sum`` a replicated scalar
+    (see :func:`gpipe_device_fn`).
     """
     if microbatch_spec is None:
         microbatch_spec = P(AXIS_DATA)
@@ -102,10 +128,12 @@ def make_gpipe(
         for ax in ((part,) if isinstance(part, str) else tuple(part))
         if ax != AXIS_DATA
     )
-    device_fn = gpipe_device_fn(stage_fn, num_stages, num_microbatches, extra)
+    device_fn = gpipe_device_fn(
+        stage_fn, num_stages, num_microbatches, extra, with_aux
+    )
     return jax.shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(xs_spec, stage_params_spec),
-        out_specs=xs_spec,
+        out_specs=(xs_spec, P()) if with_aux else xs_spec,
     )

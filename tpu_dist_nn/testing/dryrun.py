@@ -350,6 +350,30 @@ def _dryrun_moe_ep(n_devices: int) -> None:
     g = jax.jit(jax.grad(loss_fn))(params_ep, tokens)
     jax.block_until_ready(g)
 
+    if n_devices % 4 == 0:
+        # Pipeline x expert parallelism (round 4): MoE stage bodies
+        # with all_to_all dispatch inside the GPipe schedule.
+        from tpu_dist_nn.parallel.expert_parallel import (
+            make_pipeline_ep_lm_loss,
+            shard_blocks_pp_ep,
+        )
+
+        mesh_pp = build_mesh(
+            MeshSpec(stage=2, expert=ep, data=n_devices // (2 * ep))
+        )
+        params_pp = dict(
+            params, blocks=shard_blocks_pp_ep(params["blocks"], 2, ep)
+        )
+        loss_pp = make_pipeline_ep_lm_loss(mesh_pp, cfg, 2, 2)
+        g = jax.jit(jax.grad(loss_pp))(
+            params_pp,
+            jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (2 * n_devices, 17)),
+                jnp.int32,
+            ),
+        )
+        jax.block_until_ready(g)
+
 
 def _dryrun_pp_tp_3d(n_devices: int) -> None:
     """3D composition: pipeline x Megatron tensor x data — GPipe grad

@@ -164,6 +164,28 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
+def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
+                                    num_microbatches: int, optimizer,
+                                    attn_fn=None):
+    """Pipeline x expert-parallel MoE train step: blocks pipelined over
+    ``stage`` (GPipe, AD through the schedule), experts sharded over
+    ``expert`` inside each stage, batch over ``(data, expert)``.
+    Blocks in
+    :func:`~tpu_dist_nn.parallel.expert_parallel.shard_blocks_pp_ep`
+    layout."""
+    from tpu_dist_nn.parallel.expert_parallel import make_pipeline_ep_lm_loss
+
+    attn_fn = _resolve_attn_fn(attn_fn)
+    return jax.jit(
+        make_step_body(
+            make_pipeline_ep_lm_loss(
+                mesh, cfg, num_stages, num_microbatches, attn_fn
+            ),
+            optimizer,
+        )
+    )
+
+
 def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
                                    num_stages: int, num_microbatches: int,
                                    optimizer, mode: str = "ring"):
