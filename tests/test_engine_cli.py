@@ -212,9 +212,19 @@ def test_cli_lm_moe_single_and_expert_parallel(capsys):
     assert metrics["perplexity"] > 1
 
 
-def test_cli_lm_moe_rejects_stages():
+def test_cli_lm_moe_stages_rejects_seq_parallel():
+    # MoE x PP is implemented (round 4 — tests/test_pipeline_ep.py
+    # covers the combination end to end); the remaining rejection is
+    # MoE with --seq-parallel, stages or not.
     rc = cli_main([
-        "lm", "--experts", "2", "--stages", "2", "--steps", "1",
+        "lm", "--experts", "2", "--stages", "2", "--seq-parallel", "2",
+        "--steps", "1",
+    ])
+    assert rc != 0
+    # An indivisible layer count must fail fast, before any training.
+    rc = cli_main([
+        "lm", "--experts", "2", "--stages", "3", "--layers", "4",
+        "--steps", "1",
     ])
     assert rc != 0
 
