@@ -129,6 +129,36 @@ def test_engine_serves_quantized_pipelined(tmp_path):
     assert float(np.max(np.abs(got_dp - got))) < 1e-5  # same int8 math
 
 
+def test_engine_serves_quantized_interleaved(tmp_path):
+    # int8 x virtual stages (the last quantize composition hole,
+    # previously an explicit rejection): quantized chunk blocks under
+    # the forward-only table schedule must agree EXACTLY with the
+    # chunk-per-device quantized pipeline (same int8 arithmetic, only
+    # the placement differs) and with the f32 engine to int8 tolerance.
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.core.schema import save_model
+    from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
+
+    import jax as _jax
+
+    params = init_fcnn(_jax.random.key(0), [12, 10, 10, 10, 8])
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0.0, 1.0, (24, 12))
+    acts = ["relu", "relu", "relu", "softmax"]
+    model = spec_from_params(params, acts)
+    p = tmp_path / "m.json"
+    save_model(model, p)
+
+    ref_f32 = Engine.up(p, [1, 1, 1, 1], virtual_stages=2).infer(x)
+    ref_int8 = Engine.up(p, [1, 1, 1, 1], quantize="int8").infer(x)
+    eng = Engine.up(p, [1, 1, 1, 1], virtual_stages=2, quantize="int8")
+    assert eng.pipelined and eng._q_pp is not None and eng.virtual_stages == 2
+    got = eng.infer(x)
+    np.testing.assert_allclose(got, ref_int8, rtol=0, atol=1e-5)
+    assert float(np.max(np.abs(got - ref_f32))) < 2e-2
+    np.testing.assert_array_equal(got.argmax(-1), ref_f32.argmax(-1))
+
+
 def test_engine_serves_quantized_data_parallel(tmp_path):
     # int8 on the single-stage data-sharded placement: batch sharded
     # over the data axis, quantized chain under jit.

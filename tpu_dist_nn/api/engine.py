@@ -101,14 +101,8 @@ class Engine:
             if not model.is_dense:
                 raise InvalidArgumentError(
                     "quantize='int8' serves dense models only (conv/pool "
-                    "layers have no int8 path); it composes with pipeline "
-                    "and data-parallel placements"
-                )
-            if virtual_stages > 1:
-                raise InvalidArgumentError(
-                    "quantize='int8' does not compose with the interleaved "
-                    "(virtual-stage) placement yet; drop --virtual-stages "
-                    "or serve f32"
+                    "layers have no int8 path); it composes with pipeline, "
+                    "data-parallel, AND interleaved placements"
                 )
         self.virtual_stages = int(virtual_stages)
         # Engine.up overwrites this with the ORIGINAL request when the
@@ -225,15 +219,6 @@ class Engine:
         # the flag they already passed.
         requested_virtual = virtual_stages
         if virtual_stages > 1:
-            if quantize is not None:
-                # Checked HERE, before the device-shortage degrade can
-                # reset virtual_stages: the flag combination must fail
-                # the same way on every host size.
-                raise InvalidArgumentError(
-                    "quantize='int8' does not compose with the "
-                    "interleaved (virtual-stage) placement yet; drop "
-                    "--virtual-stages or serve f32"
-                )
             if not model.is_dense:
                 raise InvalidArgumentError(
                     "virtual_stages applies to dense pipelined models "
@@ -347,6 +332,17 @@ class Engine:
         if self.pipelined:
             from tpu_dist_nn.parallel.multihost import to_host_numpy
 
+            if self._q_pp is not None and self.virtual_stages > 1:
+                from tpu_dist_nn.parallel.pipeline import (
+                    pipeline_forward_interleaved_quantized,
+                )
+
+                out = pipeline_forward_interleaved_quantized(
+                    self.mesh, self._q_pp, self._pp.meta, x,
+                    num_virtual=self.virtual_stages,
+                    num_microbatches=self.num_microbatches,
+                )
+                return to_host_numpy(out)
             if self._q_pp is not None:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_quantized,

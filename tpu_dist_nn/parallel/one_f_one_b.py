@@ -392,8 +392,10 @@ def compiled_interleaved_dense_grad(mesh, meta: PipelineMeta, num_virtual: int,
         want_dx0=False,
     )
 
-    def regroup(a):  # (V, ...) -> (S, v, ...): chunk c at [c % S, c // S]
-        return jnp.swapaxes(a.reshape(v, S, *a.shape[1:]), 0, 1)
+    from tpu_dist_nn.parallel.pipeline import regroup_chunks
+
+    def regroup(a):
+        return regroup_chunks(a, S, v)
 
     def ungroup(a):  # inverse
         return jnp.swapaxes(a, 0, 1).reshape(V, *a.shape[2:])

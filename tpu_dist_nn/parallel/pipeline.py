@@ -233,14 +233,50 @@ def compiled_pipeline(mesh, meta: PipelineMeta, num_microbatches: int, logits: b
     return run
 
 
+def regroup_chunks(a, num_stages: int, num_virtual: int):
+    """``(V, ...) -> (S, v, ...)``: global chunk ``c`` to device
+    ``c % S``, local slot ``c // S`` — THE dense-chain form of the
+    Megatron virtual-stage placement, shared by every interleaved
+    dense executor (the stacked-transformer-blocks form is
+    ``transformer_pipeline._chunk_regroup``)."""
+    return jnp.swapaxes(
+        a.reshape(num_virtual, num_stages, *a.shape[1:]), 0, 1
+    )
+
+
+def check_chunk_count(num_chunks: int, num_stages: int, num_virtual: int):
+    """The one ``V == S * v`` validation every interleaved dense
+    executor funnels through."""
+    if num_chunks != num_stages * num_virtual:
+        raise ValueError(
+            f"meta has {num_chunks} chunks but mesh stage axis "
+            f"{num_stages} x virtual {num_virtual} = "
+            f"{num_stages * num_virtual}; build the pipeline params "
+            f"with a {num_stages * num_virtual}-entry distribution"
+        )
+
+
+def _feed_global(mesh, xs):
+    """Multi-host: assemble each process's replicated ``xs`` into one
+    globally-sharded array (no-op single-process) — the shared feed leg
+    of every pipeline_forward* wrapper."""
+    if jax.process_count() > 1:
+        from jax.sharding import PartitionSpec as _P
+
+        from tpu_dist_nn.data.feed import global_from_replicated
+
+        xs = global_from_replicated(mesh, _P(None, AXIS_DATA, None), xs)
+    return xs
+
+
 @functools.lru_cache(maxsize=64)
 def compiled_interleaved_pipeline(mesh, meta: PipelineMeta, num_virtual: int,
                                   num_microbatches: int, logits: bool, dtype):
     """Interleaved (virtual-stage) INFERENCE executor for the dense chain.
 
     ``meta`` must describe ``S * num_virtual`` chunks (a distribution of
-    that length); chunk ``c`` runs on device ``c % S`` at local slot
-    ``c // S`` — the Megatron placement the training executor uses
+    that length) in :func:`regroup_chunks`'s placement — the same one
+    the training executor uses
     (one_f_one_b.compiled_interleaved_dense_grad), now on the
     forward-only table schedule
     (interleaved.make_interleaved_forward). Engine placements select it
@@ -250,13 +286,7 @@ def compiled_interleaved_pipeline(mesh, meta: PipelineMeta, num_virtual: int,
 
     S = mesh.shape[AXIS_STAGE]
     v = num_virtual
-    V = meta.num_stages
-    if V != S * v:
-        raise ValueError(
-            f"meta has {V} chunks but mesh stage axis {S} x virtual {v} "
-            f"= {S * v}; build the pipeline params with a {S * v}-entry "
-            "distribution"
-        )
+    check_chunk_count(meta.num_stages, S, v)
 
     def stage_fn(sp, st, x):
         return _stage_apply(sp["w"], sp["b"], st["act"], st["width"], x)
@@ -266,8 +296,8 @@ def compiled_interleaved_pipeline(mesh, meta: PipelineMeta, num_virtual: int,
         microbatch_spec=P(AXIS_DATA, None),
     )
 
-    def regroup(a):  # (V, ...) -> (S, v, ...): chunk c at [c % S, c // S]
-        return jnp.swapaxes(a.reshape(v, S, *a.shape[1:]), 0, 1)
+    def regroup(a):
+        return regroup_chunks(a, S, v)
 
     act = jnp.asarray(meta.act_array(logits))
     width = jnp.asarray(meta.width_array())
@@ -298,12 +328,7 @@ def pipeline_forward_interleaved(
     xs, n = pad_batch(
         meta, x, num_microbatches, mesh.shape[AXIS_DATA], weights.w.dtype
     )
-    if jax.process_count() > 1:
-        from jax.sharding import PartitionSpec as _P
-
-        from tpu_dist_nn.data.feed import global_from_replicated
-
-        xs = global_from_replicated(mesh, _P(None, AXIS_DATA, None), xs)
+    xs = _feed_global(mesh, xs)
     run = compiled_interleaved_pipeline(
         mesh, meta, num_virtual, num_microbatches, logits, weights.w.dtype
     )
@@ -395,13 +420,78 @@ def pipeline_forward_quantized(
     xs, n = pad_batch(
         meta, x, num_microbatches, mesh.shape[AXIS_DATA], jnp.float32
     )
-    if jax.process_count() > 1:
-        from jax.sharding import PartitionSpec as _P
-
-        from tpu_dist_nn.data.feed import global_from_replicated
-
-        xs = global_from_replicated(mesh, _P(None, AXIS_DATA, None), xs)
+    xs = _feed_global(mesh, xs)
     run = compiled_pipeline_quantized(mesh, meta, num_microbatches)
+    out = run(qweights, xs)
+    return out[:n]
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_interleaved_pipeline_quantized(mesh, meta: PipelineMeta,
+                                            num_virtual: int,
+                                            num_microbatches: int):
+    """Int8 twin of :func:`compiled_interleaved_pipeline`: the
+    forward-only virtual-stage table schedule with quantized chunk
+    blocks as the chunk parameters — closing the
+    quantize x virtual-stages composition (previously rejected).
+    Identity filler slots still pass activations through EXACTLY
+    (the ``real`` mask rides the chunk static operand)."""
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_forward
+
+    S = mesh.shape[AXIS_STAGE]
+    v = num_virtual
+    check_chunk_count(meta.num_stages, S, v)
+
+    def stage_fn(sp, st, x):
+        return _stage_apply_quantized(
+            sp["wq"], sp["scale"], sp["b"],
+            st["act"], st["width"], st["real"], x,
+        )
+
+    mapped = make_interleaved_forward(
+        mesh, stage_fn, v, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None),
+    )
+
+    def regroup(a):
+        return regroup_chunks(a, S, v)
+
+    act = jnp.asarray(meta.act_array(False))
+    width = jnp.asarray(meta.width_array())
+    real = jnp.asarray(np.asarray(meta.in_width, np.int32) > 0)
+    st = {"act": regroup(act), "width": regroup(width), "real": regroup(real)}
+
+    @jax.jit
+    def run(q, xs):
+        sp = {
+            "wq": regroup(q["wq"]), "scale": regroup(q["scale"]),
+            "b": regroup(q["b"]),
+        }
+        out = mapped(xs, sp, st)
+        m, bsz, _ = out.shape
+        return out[..., : meta.final_dim].reshape(m * bsz, meta.final_dim)
+
+    return run
+
+
+def pipeline_forward_interleaved_quantized(
+    mesh,
+    qweights: dict,
+    meta: PipelineMeta,
+    x,
+    *,
+    num_virtual: int,
+    num_microbatches: int = 1,
+):
+    """:func:`pipeline_forward_interleaved`'s int8 twin (shared padding
+    + multi-host feed so the paths cannot drift)."""
+    xs, n = pad_batch(
+        meta, x, num_microbatches, mesh.shape[AXIS_DATA], jnp.float32
+    )
+    xs = _feed_global(mesh, xs)
+    run = compiled_interleaved_pipeline_quantized(
+        mesh, meta, num_virtual, num_microbatches
+    )
     out = run(qweights, xs)
     return out[:n]
 
