@@ -692,6 +692,16 @@ def cmd_lm(args) -> int:
     else:
         cfg = TransformerConfig(**common)
         init_fn, eval_fn = init_transformer, evaluate_lm
+        # Shared --zero1/--fsdp flag compatibility (one copy: the SP and
+        # plain-DP branches both shard over the data axis).
+        if args.zero1 and args.fsdp:
+            raise ValueError("--fsdp already shards the optimizer "
+                             "state; drop --zero1")
+        if (args.zero1 or args.fsdp) and args.data_parallel < 2:
+            raise ValueError(
+                ("--fsdp" if args.fsdp else "--zero1")
+                + " shards over the data axis: needs --data-parallel >= 2"
+            )
         if args.stages > 1:
             if args.zero1 or args.fsdp:
                 raise ValueError(
@@ -754,10 +764,6 @@ def cmd_lm(args) -> int:
                 make_seq_parallel_lm_train_step,
             )
 
-            if args.zero1 or args.fsdp:
-                raise ValueError(
-                    "--seq-parallel does not compose with --zero1/--fsdp yet"
-                )
             # LM rows carry seq_len+1 tokens (inputs + next-token
             # targets); the sp loss feeds the full row to the ring.
             if (args.seq_len + 1) % args.seq_parallel:
@@ -776,9 +782,23 @@ def cmd_lm(args) -> int:
             )
             global_mesh, global_span = sp_mesh, args.data_parallel
             global_axes = "_data_"
-            step_fn = lambda opt: make_seq_parallel_lm_train_step(  # noqa: E731
-                sp_mesh, cfg, opt, mode=args.sp_mode
-            )
+            if args.zero1 or args.fsdp:
+                # SP x sharded optimizer state (round 4, previously
+                # rejected): `params` is assigned below, before train_lm
+                # invokes this factory.
+                from tpu_dist_nn.parallel.zero import (
+                    make_sp_sharded_lm_train_step,
+                )
+
+                _mode, _fsdp = args.sp_mode, args.fsdp
+                step_fn = lambda opt: make_sp_sharded_lm_train_step(  # noqa: E731
+                    sp_mesh, cfg, opt, params, mode=_mode,
+                    shard_params=_fsdp,
+                )
+            else:
+                step_fn = lambda opt: make_seq_parallel_lm_train_step(  # noqa: E731
+                    sp_mesh, cfg, opt, mode=args.sp_mode
+                )
         elif args.zero1 or args.fsdp:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
             from tpu_dist_nn.parallel.zero import (
@@ -786,12 +806,6 @@ def cmd_lm(args) -> int:
                 make_zero_lm_train_step,
             )
 
-            flag = "--fsdp" if args.fsdp else "--zero1"
-            if args.zero1 and args.fsdp:
-                raise ValueError("--fsdp already shards the optimizer "
-                                 "state; drop --zero1")
-            if args.data_parallel < 2:
-                raise ValueError(f"{flag} needs --data-parallel >= 2")
             if args.batch_size % args.data_parallel:
                 raise ValueError(
                     f"--batch-size {args.batch_size} must be divisible by "

@@ -56,10 +56,15 @@ def zero_opt_shardings(opt_state_shapes, mesh, axis: str = AXIS_DATA):
     return jax.tree.map(leaf_sharding, opt_state_shapes)
 
 
-def _make_sharded_step(mesh, cfg, optimizer, params, shard_params, attn_fn):
+def _make_sharded_step(mesh, cfg, optimizer, params, shard_params, attn_fn,
+                       *, loss_fn=None, tok_spec=None):
     from tpu_dist_nn.train.lm_trainer import _resolve_attn_fn, make_step_body
 
-    attn_fn = _resolve_attn_fn(attn_fn)
+    if loss_fn is None:
+        attn_fn = _resolve_attn_fn(attn_fn)
+        loss_fn = lambda p, t: lm_loss(p, t, cfg, attn_fn)  # noqa: E731
+    if tok_spec is None:
+        tok_spec = P(AXIS_DATA, None)
     opt_shapes = jax.eval_shape(optimizer.init, params)
     opt_sh = zero_opt_shardings(opt_shapes, mesh)
     if shard_params:
@@ -67,10 +72,10 @@ def _make_sharded_step(mesh, cfg, optimizer, params, shard_params, attn_fn):
     else:
         rep = NamedSharding(mesh, P())
         p_sh = jax.tree.map(lambda _: rep, params)
-    tok_sh = NamedSharding(mesh, P(AXIS_DATA, None))
+    tok_sh = NamedSharding(mesh, tok_spec)
 
     step = jax.jit(
-        make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer),
+        make_step_body(loss_fn, optimizer),
         in_shardings=(p_sh, opt_sh, tok_sh),
         out_shardings=(p_sh, opt_sh, None),
     )
@@ -110,3 +115,32 @@ def make_fsdp_lm_train_step(mesh, cfg: TransformerConfig, optimizer, params,
     only inside the step.
     """
     return _make_sharded_step(mesh, cfg, optimizer, params, True, attn_fn)
+
+
+def make_sp_sharded_lm_train_step(mesh, cfg: TransformerConfig, optimizer,
+                                  params, mode: str = "ring",
+                                  shard_params: bool = False):
+    """Sequence parallelism x sharded optimizer state — ZeRO-1
+    (``shard_params=False``) or FSDP (``True``) over the ``data`` axis
+    of a ``(seq, data)`` mesh, with the ring/Ulysses sequence-parallel
+    loss (the composition ``--seq-parallel --zero1/--fsdp`` used to
+    reject).
+
+    Why this is just shardings: the sp loss is a ``shard_map`` over
+    ``(seq, data)`` whose params arrive replicated (``in_specs=P()``);
+    pinning the jit-level param/moment shardings over ``data`` makes
+    XLA's partitioner insert the all-gather at the shard_map boundary
+    (FSDP) and turn the grad reduction feeding the sharded update into
+    a reduce-scatter (ZeRO-1) — the same schedule as the plain
+    data-parallel case, orthogonal to the ``seq`` axis. Tokens arrive
+    ``P(data, seq)`` (full input+target rows, position-0-masked loss —
+    ring_attention.make_seq_parallel_lm_loss's convention).
+    """
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+    from tpu_dist_nn.parallel.ring_attention import make_seq_parallel_lm_loss
+
+    loss = make_seq_parallel_lm_loss(mesh, cfg, mode)
+    return _make_sharded_step(
+        mesh, cfg, optimizer, params, shard_params, None,
+        loss_fn=loss, tok_spec=P(AXIS_DATA, AXIS_SEQ),
+    )

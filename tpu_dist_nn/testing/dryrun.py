@@ -311,6 +311,20 @@ def _dryrun_transformer_sp_tp(n_devices: int) -> None:
         )
         jax.block_until_ready(g)
 
+        # SP x ZeRO-1 (round 4): sharded moments over the data axis of
+        # the (seq, data) mesh, ring loss over seq.
+        import optax
+
+        from tpu_dist_nn.parallel.zero import make_sp_sharded_lm_train_step
+
+        optimizer = optax.adam(1e-3)
+        step = make_sp_sharded_lm_train_step(mesh_sp, cfg, optimizer, params)
+        new_params, _, loss = step(
+            params, step.init_opt_state(params), tokens
+        )
+        jax.block_until_ready(new_params)
+        assert float(loss) > 0
+
     if not _full_tier():
         return
     # Tensor-parallel decode: Megatron-sharded heads + KV cache.
