@@ -576,10 +576,8 @@ def cmd_lm(args) -> int:
 
     _validate_checkpoint_flags(args)
     _validate_metrics_out(args)
-    if args.remat and moe:
-        # The MoE forward is not scan-based; a silently ignored flag is
-        # worse than an error.
-        raise ValueError("--remat supports the dense LM only")
+    # (--remat composes with MoE since round 4: every MoE scan body
+    # wraps moe_block_apply in maybe_remat.)
     if args.zero1 and moe:
         raise ValueError("--zero1 supports the dense LM only")
     if args.seq_parallel > 1 and moe:

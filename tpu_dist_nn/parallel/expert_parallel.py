@@ -226,13 +226,14 @@ def moe_forward(params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
     Block stack is a ``lax.scan`` over the stacked layer axis, aux
     losses averaged over layers.
     """
-    from tpu_dist_nn.models.transformer import embed, unembed
+    from tpu_dist_nn.models.transformer import embed, maybe_remat, unembed
 
     params = cfg.cast_params(params)
     x = embed(params, tokens)
+    apply = maybe_remat(cfg, moe_block_apply)
 
     def body(carry, block):
-        y, aux = moe_block_apply(block, carry, cfg, n_groups, attn_fn, ffn_fn)
+        y, aux = apply(block, carry, cfg, n_groups, attn_fn, ffn_fn)
         return y, aux
 
     x, auxs = lax.scan(body, x, params["blocks"])
@@ -353,7 +354,7 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
     ep_ffn = _make_ep_ffn(cfg)
 
     def device_fn(embed_params, blocks_ep, tokens):
-        from tpu_dist_nn.models.transformer import embed, unembed
+        from tpu_dist_nn.models.transformer import embed, maybe_remat, unembed
 
         # shard_map hands sharded leaves with a leading local-shard dim
         # of size 1; strip it so every leaf leads with the layer axis.
@@ -362,11 +363,10 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
         }
         inputs = tokens[:, :-1] if with_loss else tokens
         x = embed(embed_params, inputs)
+        apply = maybe_remat(cfg, moe_block_apply)
 
         def body(carry, block):
-            y, aux = moe_block_apply(
-                block, carry, cfg, attn_fn=attn_fn, ffn_fn=ep_ffn
-            )
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
             return y, aux
 
         x, auxs = lax.scan(body, x, blocks)
@@ -475,14 +475,15 @@ def make_pipeline_ep_lm_loss(mesh, cfg: MoEConfig, num_stages: int,
     ep_ffn = _make_ep_ffn(cfg)
 
     def stage_fn(stage_blocks, x):
+        from tpu_dist_nn.models.transformer import maybe_remat
+
         blocks = {
             k: (v[0] if k in EP_SHARDED else v) for k, v in stage_blocks.items()
         }
+        apply = maybe_remat(cfg, moe_block_apply)
 
         def body(carry, block):
-            y, aux = moe_block_apply(
-                block, carry, cfg, attn_fn=attn_fn, ffn_fn=ep_ffn
-            )
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
             return y, aux
 
         y, auxs = lax.scan(body, x, blocks)
