@@ -601,6 +601,7 @@ def cmd_lm(args) -> int:
     step_fn = None
     unshard_fn = None
     shard_fn = None  # applied to freshly-init params before training
+    schedule_handled = False  # a step_fn branch that consumes --schedule
     global_mesh = None  # the mesh cross-host batches assemble over, if any
     global_span = 1     # how many ways that mesh shards the batch axis
     global_axes = "_data_"
@@ -740,9 +741,27 @@ def cmd_lm(args) -> int:
                 ))
                 global_mesh, global_span = pp_sp_mesh, args.data_parallel
                 global_axes = "_data_"
+                if args.schedule not in ("gpipe", "1f1b"):
+                    raise ValueError(
+                        "--stages with --seq-parallel supports --schedule "
+                        "gpipe or 1f1b"
+                    )
+                if args.schedule == "1f1b" and args.sp_mode != "ulysses":
+                    # Eager (before corpus/params/checkpoint work): the
+                    # factory rejects ring inside the schedule anyway,
+                    # but only at step-build time.
+                    raise ValueError(
+                        "--schedule 1f1b with --seq-parallel supports "
+                        "--sp-mode ulysses only (the ring computes wrong "
+                        "values inside the schedule's switch branches; "
+                        "use --schedule gpipe for the ring)"
+                    )
+                schedule_handled = True  # pp x sp consumes --schedule itself
                 _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
+                _sched = args.schedule
                 step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
-                    pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode
+                    pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
+                    schedule=_sched,
                 )
                 shard_fn = lambda p: dict(  # noqa: E731
                     p, blocks=shard_blocks(p["blocks"], _stages)
@@ -818,7 +837,9 @@ def cmd_lm(args) -> int:
 
     # Fail fast with the other flag-compatibility checks — before corpus
     # load, param init, or checkpoint-dir creation do any work.
-    if args.schedule != "gpipe" and (args.stages <= 1 or step_fn is not None):
+    if args.schedule != "gpipe" and not schedule_handled and (
+        args.stages <= 1 or step_fn is not None
+    ):
         raise ValueError(
             f"--schedule {args.schedule} applies to the pipelined dense LM "
             "only (--stages > 1, without --experts/--seq-parallel/"
@@ -913,7 +934,11 @@ def cmd_lm(args) -> int:
         params, cfg, batches, train_cfg, mesh=mesh,
         num_stages=args.stages, num_microbatches=args.microbatches,
         checkpoints=checkpoints, step_fn=step_fn,
-        schedule=args.schedule, globalize=globalize,
+        # A step_fn branch that consumed --schedule already encodes it;
+        # train_lm's own schedule validation applies to the built-in
+        # pipelined path only.
+        schedule="gpipe" if schedule_handled else args.schedule,
+        globalize=globalize,
         num_virtual=num_virtual,
     )
     train_seconds = time.monotonic() - t0

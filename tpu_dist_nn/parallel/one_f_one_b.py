@@ -116,9 +116,24 @@ def make_1f1b(
     T = 2 * (M + S - 1)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
-    vary = (AXIS_STAGE, AXIS_DATA)
     if microbatch_spec is None:
         microbatch_spec = P(AXIS_DATA)
+    # Axes the MICROBATCH is sharded over beyond `data` (e.g. `seq` in
+    # the pipeline x sequence-parallel composition): the wires and
+    # accumulators are varying over them, and stage grads — params are
+    # replicated over these axes while each shard saw different
+    # positions — reduce over them exactly like `data`. (Axes that
+    # shard PARAMS but not activations, like Megatron's `model`, are
+    # deliberately NOT here: their grads stay per-shard.)
+    extra = tuple(
+        ax
+        for part in microbatch_spec
+        if part is not None
+        for ax in ((part,) if isinstance(part, str) else tuple(part))
+        if ax != AXIS_DATA
+    )
+    data_like = (AXIS_DATA, *extra)
+    vary = (AXIS_STAGE, *data_like)
     if stage_params_spec is None:
         stage_params_spec = P(AXIS_STAGE)
     if stage_static_spec is None:
@@ -137,7 +152,7 @@ def make_1f1b(
         # implicit psum per backward tick (a collective, which inside
         # the lax.switch branch would also break SPMD).
         sp = jax.tree.map(
-            lambda a: lax.pcast(a[0], (AXIS_DATA,), to="varying"), stage_params
+            lambda a: lax.pcast(a[0], data_like, to="varying"), stage_params
         )
         st = jax.tree.map(lambda a: a[0], stage_static)
         tp = jax.tree.map(lambda a: lax.pcast(a, vary, to="varying"), tail_params)
@@ -272,7 +287,7 @@ def make_1f1b(
         # Cross-shard reductions happen ONCE here, not per tick: data
         # shards each saw a slice of the rows; tail grads and loss live
         # only on the last stage; dx0 only on stage 0.
-        g_sp = jax.tree.map(lambda a: lax.psum(a, AXIS_DATA)[None], g_sp)
+        g_sp = jax.tree.map(lambda a: lax.psum(a, data_like)[None], g_sp)
         g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
         if want_dx0:
             dx0 = lax.psum(dx0, AXIS_STAGE)

@@ -139,17 +139,29 @@ def _lm_sched_stage_and_tail(mesh, cfg: TransformerConfig,
     return stage_fn, tail_fn
 
 
-def _lm_vag_from_mapped(mapped, cfg: TransformerConfig, num_microbatches: int):
-    """Wrap a scheduled executor (1F1B or interleaved) into the standard
-    ``(params, tokens) -> (loss, grads)``: embedding runs data-parallel
-    before the schedule and backprops from the executor's per-microbatch
-    input cotangents; the tied LM head + final LN ride the tail, so
-    head-side tok_embed grads are summed with the embed-side ones."""
+def _lm_vag_from_mapped(mapped, cfg: TransformerConfig, num_microbatches: int,
+                        prep=None):
+    """Wrap a scheduled executor (1F1B, interleaved, or zb) into the
+    standard ``(params, tokens) -> (loss, grads)``: embedding runs
+    data-parallel before the schedule and backprops from the executor's
+    per-microbatch input cotangents; the tied LM head + final LN ride
+    the tail, so head-side tok_embed grads are summed with the
+    embed-side ones.
+
+    ``prep(tokens) -> (inp, aux_arrays)`` customizes the row/target
+    convention (one wrapper definition so the schedules cannot drift):
+    the default slices shifted rows for the plain
+    ``tail_fn(tp, y, targets)``; the sp variant feeds FULL rows with
+    pre-shifted masked targets. ``aux_arrays`` arrive ``(B, T)``-shaped
+    and are microbatched here.
+    """
     M = num_microbatches
+    if prep is None:
+        prep = lambda tokens: (tokens[:, :-1], (tokens[:, 1:],))  # noqa: E731
 
     def value_and_grad_fn(params, tokens):
         params_c = cfg.cast_params(params)
-        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        inp, aux_arrays = prep(tokens)
         B, T = inp.shape
         if B % M:
             raise ValueError(f"batch {B} not divisible by microbatches {M}")
@@ -158,13 +170,13 @@ def _lm_vag_from_mapped(mapped, cfg: TransformerConfig, num_microbatches: int):
         }
         x, embed_vjp = jax.vjp(lambda p: embed(p, inp), embed_params)
         xs = x.reshape(M, B // M, T, cfg.d_model)
-        targets = tgt.reshape(M, B // M, T)
+        aux = tuple(a.reshape(M, B // M, T) for a in aux_arrays)
         tail_params = {
             "tok_embed": params_c["tok_embed"],
             "lnf_g": params_c["lnf_g"], "lnf_b": params_c["lnf_b"],
         }
         loss, g_blocks, g_tail, dx0 = mapped(
-            xs, params_c["blocks"], {}, tail_params, (targets,)
+            xs, params_c["blocks"], {}, tail_params, aux
         )
         (d_embed,) = embed_vjp(dx0.reshape(B, T, cfg.d_model))
         grads = {
@@ -356,6 +368,109 @@ def make_pipeline_sp_lm_forward(mesh, cfg: TransformerConfig,
         return base(params, tokens)
 
     return fn
+
+
+def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
+                                  num_stages: int, num_microbatches: int,
+                                  mode: str = "ulysses"):
+    """-> ``f(params, tokens) -> (loss, grads)``: 1F1B x sequence
+    parallelism — the memory-flat schedule with ring/Ulysses attention
+    in the stage bodies, the long-context combination where 1F1B's
+    O(stages) activation residency matters most (activations are
+    sequence-length-proportional, so the GPipe scan transpose's
+    M-proportional stash is exactly what long context cannot afford).
+
+    Legal by the disjoint-axis rule: the 1F1B tick predicate is
+    ``seq``-invariant, so every seq peer of a collective takes the same
+    branch at the same tick (one_f_one_b.make_1f1b docstring). The
+    executor reduces stage grads over ``seq`` like ``data`` (each seq
+    shard saw different positions of the same microbatch).
+
+    **Ulysses only.** The ring decomposition (a ``ppermute``-in-scan
+    K/V rotation) produces WRONG VALUES inside the 1F1B ``lax.switch``
+    branches on the CPU mesh — two reproducible failure modes: at
+    seq=1 (self-permute) later microbatches' activations reach the
+    tail as zeros; at seq>1 attention outputs are wrong for every
+    microbatch. Ulysses' ``all_to_all`` decomposition is exact (like
+    TP's psums), so this factory accepts ``mode="ulysses"`` and
+    rejects ``"ring"`` with a pointer at the gpipe pp x sp path (which
+    runs the ring correctly via AD through the scan). The tick
+    predicate argument says ring SHOULD be legal; until the
+    collective-in-scan-in-switch interaction is understood, rejecting
+    beats silently training on wrong gradients.
+
+    The tail runs INSIDE the schedule per (microbatch, seq shard), so
+    the position-0-masked CE convention is carried by PRE-SHIFTED
+    per-shard targets and a normalized mask built host-side: shard
+    contributions are plain masked sums that add up to exactly
+    :func:`~tpu_dist_nn.models.transformer.masked_next_token_ce` of the
+    gathered logits (parity-tested against the gpipe pp x sp path and
+    single-chip AD).
+    """
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+    from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    if mode != "ulysses":
+        raise ValueError(
+            "1F1B x sequence parallelism supports mode='ulysses' only: "
+            "the ring's ppermute-in-scan K/V rotation computes wrong "
+            "values inside the schedule's lax.switch branches (see "
+            "docstring); use --sp-mode ulysses, or schedule='gpipe' "
+            "for the ring"
+        )
+    seq_devices = mesh.shape[AXIS_SEQ]
+    attn_fn = _sp_attn_fn(mode)
+    apply = maybe_remat(cfg)
+    M = num_microbatches
+
+    def stage_fn(stage_blocks, _static, x):
+        def body(carry, block):
+            return apply(block, carry, cfg, attn_fn), None
+
+        y, _ = lax.scan(body, x, stage_blocks)
+        return y
+
+    def tail_fn(tail_params, y, tgt_f, mask_f):
+        # One (B_loc, T_loc, d) shard of one microbatch: local logits,
+        # masked-sum contribution (the mask carries the global 1/count
+        # normalization, so summing over shards/microbatches gives the
+        # global mean CE).
+        logits = unembed(tail_params, y)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, tgt_f[..., None], axis=-1)[..., 0]
+        return -(ll * mask_f).sum()
+
+    mapped = make_1f1b(
+        mesh, stage_fn, tail_fn, num_stages, M,
+        microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+        aux_spec=P(None, AXIS_DATA, AXIS_SEQ),
+    )
+
+    def prep(tokens):
+        B, T = tokens.shape
+        if T % seq_devices:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis "
+                f"{seq_devices} (sp feeds full input+target rows)"
+            )
+        if T > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        # Pre-shifted per-position targets + normalized mask: position p
+        # scores tokens[p+1]; the final position of each row is unscored
+        # (masked_next_token_ce's convention, shard-locally computable).
+        tgt = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1
+        )
+        mask = jnp.concatenate(
+            [jnp.ones((B, T - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+            axis=1,
+        ) / (B * (T - 1))
+        return tokens, (tgt, mask)
+
+    return _lm_vag_from_mapped(mapped, cfg, M, prep=prep)
 
 
 def make_pipeline_sp_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,

@@ -188,18 +188,36 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
 
 def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
                                    num_stages: int, num_microbatches: int,
-                                   optimizer, mode: str = "ring"):
+                                   optimizer, mode: str = "ring",
+                                   schedule: str = "gpipe"):
     """Pipeline x sequence-parallel train step: blocks pipelined over
-    ``stage`` (GPipe, AD through the schedule), each microbatch's
-    sequence dim sharded over ``seq`` with ring/Ulysses attention,
+    ``stage``, each microbatch's sequence dim sharded over ``seq``,
     batch over ``data``. Blocks in
     :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`
     layout; tokens are full (input+target) rows (the sp loss masks
-    position 0 — ring_attention.py)."""
+    position 0 — ring_attention.py).
+
+    ``schedule="gpipe"`` (default): AD through the forward schedule,
+    ring or Ulysses attention. ``schedule="1f1b"``: the memory-flat
+    hand-rolled schedule — O(stages) live activations, the combination
+    long context needs most — Ulysses only (the ring computes wrong
+    values inside the schedule's switch branches; see
+    transformer_pipeline.make_pipeline_sp_lm_1f1b_grad)."""
     from tpu_dist_nn.parallel.transformer_pipeline import (
+        make_pipeline_sp_lm_1f1b_grad,
         make_pipeline_sp_lm_loss,
     )
 
+    if schedule == "1f1b":
+        vag = make_pipeline_sp_lm_1f1b_grad(
+            mesh, cfg, num_stages, num_microbatches, mode
+        )
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+    if schedule != "gpipe":
+        raise ValueError(
+            f"pipeline x sequence parallelism supports schedule='gpipe' "
+            f"or '1f1b', not {schedule!r}"
+        )
     return jax.jit(
         make_step_body(
             make_pipeline_sp_lm_loss(
