@@ -1,0 +1,117 @@
+"""Minimal reproducer: ring attention computes wrong values inside the
+1F1B schedule's ``lax.switch`` branches.
+
+Context (round 4): composing the 1F1B pipeline schedule with sequence
+parallelism works exactly with the Ulysses (all_to_all) decomposition
+but NOT with the ring (ppermute-in-scan K/V rotation), even though the
+disjoint-axis rule says both should be legal — the tick predicate is
+seq-invariant, so every seq peer takes the same branch at the same
+tick, exactly the argument that makes Megatron TP psums work there
+(probe-verified, parity-tested).
+
+Two reproduced failure modes, both isolated to the ring:
+
+1. ``seq=1`` (the ring degenerates to a SELF-permute): the first
+   microbatch's activations reach the schedule's tail correctly, every
+   later microbatch's arrive as ZEROS.
+2. ``seq>1``: attention outputs are wrong for every microbatch (the
+   tail sees |y| magnitudes ~40% off).
+
+Substituting plain attention or Ulysses — same mesh, same specs, same
+schedule — gives exact results, so the executor's bookkeeping is not
+the suspect; the interaction is specific to a ``ppermute`` inside a
+``lax.scan`` inside a ``lax.switch`` branch inside the schedule's
+outer ``lax.scan`` under ``shard_map``. Until that interaction is
+understood (JAX/XLA level?), ``make_pipeline_sp_lm_1f1b_grad`` rejects
+``mode="ring"`` — rejecting beats silently training on wrong
+gradients. Run this script to reproduce both modes:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python tools/repro_ring_1f1b.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def main() -> int:
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        dot_product_attention,
+        embed,
+        init_transformer,
+        maybe_remat,
+    )
+    from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_SEQ, MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_seq_len=16,
+    )
+    rng = np.random.default_rng(12)
+    tokens = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+    params = cfg.cast_params(init_transformer(jax.random.key(11), cfg))
+    blocks = shard_blocks(params["blocks"], 2)
+    apply = maybe_remat(cfg)
+    B, T, M = 8, 16, 2
+    ep = {"tok_embed": params["tok_embed"], "pos_embed": params["pos_embed"]}
+    xs = embed(ep, tokens).reshape(M, B // M, T, cfg.d_model)
+    tgt = jnp.zeros((M, B // M, T), jnp.int32)
+    tp = {"tok_embed": params["tok_embed"], "lnf_g": params["lnf_g"],
+          "lnf_b": params["lnf_b"]}
+
+    def mk_stage(attn):
+        def stage_fn(sb, _st, x):
+            def body(c, b):
+                return apply(b, c, cfg, attn), None
+
+            return lax.scan(body, x, sb)[0]
+
+        return stage_fn
+
+    def diag_tail(_tp, y, _tgt_f, mask_f):
+        # |y| of the microbatch whose mask is live: a probe for WHAT the
+        # tail actually received, independent of loss math.
+        return jnp.abs(y).sum() * jnp.sign(mask_f.sum())
+
+    def probe(seq, attn, label):
+        mesh = build_mesh(MeshSpec(stage=2, seq=seq, data=1))
+        mapped = make_1f1b(
+            mesh, mk_stage(attn), diag_tail, 2, M,
+            microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+            aux_spec=P(None, AXIS_DATA, AXIS_SEQ),
+        )
+        vals = []
+        for i in range(M):
+            m = jnp.zeros((M, B // M, T), jnp.float32).at[i].set(1.0)
+            loss, *_ = mapped(xs, blocks, {}, tp, (tgt, m))
+            vals.append(float(loss))
+        print(f"  {label}: per-microbatch |y| at the tail = "
+              f"{[round(v, 2) for v in vals]}")
+        return np.asarray(vals)
+
+    print("expected (plain attention, any seq): ~[1231.32, 1388.74]")
+    ok = probe(1, dot_product_attention, "seq=1 plain    ")
+    probe(1, _sp_attn_fn("ring"), "seq=1 ring      (mode 1: zeros)")
+    probe(2, _sp_attn_fn("ring"), "seq=2 ring      (mode 2: wrong)")
+    uly = probe(2, _sp_attn_fn("ulysses"), "seq=2 ulysses   (exact)")
+    # Tolerance, not exact equality: reduction order varies with
+    # backend/thread configuration at float32.
+    assert np.allclose(uly, ok, rtol=1e-4), (
+        "ulysses should be exact — reproducer assumptions broken"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

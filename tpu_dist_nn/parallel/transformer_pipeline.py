@@ -397,7 +397,8 @@ def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
     runs the ring correctly via AD through the scan). The tick
     predicate argument says ring SHOULD be legal; until the
     collective-in-scan-in-switch interaction is understood, rejecting
-    beats silently training on wrong gradients.
+    beats silently training on wrong gradients. Standalone reproducer
+    with both modes and the exact controls: ``tools/repro_ring_1f1b.py``.
 
     The tail runs INSIDE the schedule per (microbatch, seq shard), so
     the position-0-masked CE convention is carried by PRE-SHIFTED
