@@ -84,9 +84,15 @@ def make_interleaved_1f1b(
     has_split = bool((tables.op >= BWD_B).any())
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [(i, (i - 1) % S) for i in range(S)]
-    vary = (AXIS_STAGE, AXIS_DATA)
     if microbatch_spec is None:
         microbatch_spec = P(AXIS_DATA)
+    # Microbatch-sharding axes beyond `data` (e.g. `seq`) make the
+    # wires/accumulators varying and the chunk grads reduce over them
+    # like `data` (one shared derivation: one_f_one_b.microbatch_axes).
+    from tpu_dist_nn.parallel.one_f_one_b import microbatch_axes
+
+    data_like = microbatch_axes(microbatch_spec)
+    vary = (AXIS_STAGE, *data_like)
     if chunk_params_spec is None:
         chunk_params_spec = P(AXIS_STAGE)
     if chunk_static_spec is None:
@@ -112,7 +118,7 @@ def make_interleaved_1f1b(
         # params data-varying so jax.vjp stays collective-free (see
         # one_f_one_b's note), tail params (stage, data)-varying.
         sp = jax.tree.map(
-            lambda a: lax.pcast(a[0], (AXIS_DATA,), to="varying"), chunk_params
+            lambda a: lax.pcast(a[0], data_like, to="varying"), chunk_params
         )
         st = jax.tree.map(lambda a: a[0], chunk_static)
         s_idx = lax.axis_index(AXIS_STAGE)
@@ -344,7 +350,7 @@ def make_interleaved_1f1b(
         (_f, _b, _a, _g, _s, _dy, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
-        g_sp = jax.tree.map(lambda a: lax.psum(a, AXIS_DATA)[None], g_sp)
+        g_sp = jax.tree.map(lambda a: lax.psum(a, data_like)[None], g_sp)
         g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
         if want_dx0:
             dx0 = lax.psum(dx0, AXIS_STAGE)

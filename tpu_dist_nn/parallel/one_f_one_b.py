@@ -57,6 +57,26 @@ def validate_schedule(schedule: str) -> str:
     return schedule
 
 
+def microbatch_axes(microbatch_spec) -> tuple[str, ...]:
+    """``(data, *extra)``: every mesh axis the MICROBATCH is sharded
+    over (e.g. ``seq`` in the pipeline x sequence-parallel
+    composition) — the scheduled executors' wires and accumulators are
+    varying over these, and stage/chunk grads reduce over them exactly
+    like ``data`` (params are replicated over them while each shard saw
+    different positions). Axes that shard PARAMS but not activations,
+    like Megatron's ``model``, are deliberately NOT here: their grads
+    stay per-shard. One definition shared by make_1f1b and the table
+    executor (interleaved/zb)."""
+    extra = tuple(
+        ax
+        for part in microbatch_spec
+        if part is not None
+        for ax in ((part,) if isinstance(part, str) else tuple(part))
+        if ax != AXIS_DATA
+    )
+    return (AXIS_DATA, *extra)
+
+
 def make_1f1b(
     mesh,
     stage_fn,
@@ -118,21 +138,7 @@ def make_1f1b(
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
     if microbatch_spec is None:
         microbatch_spec = P(AXIS_DATA)
-    # Axes the MICROBATCH is sharded over beyond `data` (e.g. `seq` in
-    # the pipeline x sequence-parallel composition): the wires and
-    # accumulators are varying over them, and stage grads — params are
-    # replicated over these axes while each shard saw different
-    # positions — reduce over them exactly like `data`. (Axes that
-    # shard PARAMS but not activations, like Megatron's `model`, are
-    # deliberately NOT here: their grads stay per-shard.)
-    extra = tuple(
-        ax
-        for part in microbatch_spec
-        if part is not None
-        for ax in ((part,) if isinstance(part, str) else tuple(part))
-        if ax != AXIS_DATA
-    )
-    data_like = (AXIS_DATA, *extra)
+    data_like = microbatch_axes(microbatch_spec)
     vary = (AXIS_STAGE, *data_like)
     if stage_params_spec is None:
         stage_params_spec = P(AXIS_STAGE)

@@ -370,6 +370,84 @@ def make_pipeline_sp_lm_forward(mesh, cfg: TransformerConfig,
     return fn
 
 
+def _reject_ring_in_schedule(mode: str, what: str):
+    """The ring's ppermute-in-scan K/V rotation computes wrong values
+    inside the scheduled executors' ``lax.switch`` branches (reproduced:
+    ``tools/repro_ring_1f1b.py``); every hand-scheduled x SP factory
+    funnels through this rejection."""
+    if mode != "ulysses":
+        raise ValueError(
+            f"{what} supports mode='ulysses' only: the ring computes "
+            "wrong values inside the schedule's lax.switch branches "
+            "(tools/repro_ring_1f1b.py); use --sp-mode ulysses, or "
+            "schedule='gpipe' for the ring"
+        )
+
+
+def _sp_sched_stage_fn(cfg: TransformerConfig, mode: str):
+    """One chunk/stage body for every scheduled x SP factory (the SP
+    row's `_lm_sched_stage_and_tail` analogue — one definition so the
+    1F1B, interleaved, and zb SP paths cannot drift numerically)."""
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    attn_fn = _sp_attn_fn(mode)
+    apply = maybe_remat(cfg)
+
+    def stage_fn(stage_blocks, _static, x):
+        def body(carry, block):
+            return apply(block, carry, cfg, attn_fn), None
+
+        y, _ = lax.scan(body, x, stage_blocks)
+        return y
+
+    return stage_fn
+
+
+def _sp_masked_tail_fn():
+    """Per-(microbatch, seq shard) masked-CE tail shared by every
+    scheduled x SP factory: a plain masked sum whose mask carries the
+    global 1/count normalization (see :func:`_sp_prep`), so shard
+    contributions add to exactly
+    :func:`~tpu_dist_nn.models.transformer.masked_next_token_ce`."""
+
+    def tail_fn(tail_params, y, tgt_f, mask_f):
+        logits = unembed(tail_params, y)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, tgt_f[..., None], axis=-1)[..., 0]
+        return -(ll * mask_f).sum()
+
+    return tail_fn
+
+
+def _sp_prep(cfg: TransformerConfig, seq_devices: int):
+    """``prep`` hook for :func:`_lm_vag_from_mapped`: full rows in,
+    pre-shifted per-position targets + normalized mask out (position p
+    scores tokens[p+1]; the final position of each row is unscored —
+    masked_next_token_ce's convention, shard-locally computable)."""
+
+    def prep(tokens):
+        B, T = tokens.shape
+        if T % seq_devices:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis "
+                f"{seq_devices} (sp feeds full input+target rows)"
+            )
+        if T > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        tgt = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1
+        )
+        mask = jnp.concatenate(
+            [jnp.ones((B, T - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+            axis=1,
+        ) / (B * (T - 1))
+        return tokens, (tgt, mask)
+
+    return prep
+
+
 def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
                                   num_stages: int, num_microbatches: int,
                                   mode: str = "ulysses"):
@@ -410,68 +488,62 @@ def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
     """
     from tpu_dist_nn.parallel.mesh import AXIS_SEQ
     from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
-    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
 
-    if mode != "ulysses":
-        raise ValueError(
-            "1F1B x sequence parallelism supports mode='ulysses' only: "
-            "the ring's ppermute-in-scan K/V rotation computes wrong "
-            "values inside the schedule's lax.switch branches (see "
-            "docstring); use --sp-mode ulysses, or schedule='gpipe' "
-            "for the ring"
-        )
+    _reject_ring_in_schedule(mode, "1F1B x sequence parallelism")
     seq_devices = mesh.shape[AXIS_SEQ]
-    attn_fn = _sp_attn_fn(mode)
-    apply = maybe_remat(cfg)
     M = num_microbatches
-
-    def stage_fn(stage_blocks, _static, x):
-        def body(carry, block):
-            return apply(block, carry, cfg, attn_fn), None
-
-        y, _ = lax.scan(body, x, stage_blocks)
-        return y
-
-    def tail_fn(tail_params, y, tgt_f, mask_f):
-        # One (B_loc, T_loc, d) shard of one microbatch: local logits,
-        # masked-sum contribution (the mask carries the global 1/count
-        # normalization, so summing over shards/microbatches gives the
-        # global mean CE).
-        logits = unembed(tail_params, y)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, tgt_f[..., None], axis=-1)[..., 0]
-        return -(ll * mask_f).sum()
-
     mapped = make_1f1b(
-        mesh, stage_fn, tail_fn, num_stages, M,
+        mesh, _sp_sched_stage_fn(cfg, mode), _sp_masked_tail_fn(),
+        num_stages, M,
         microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
         aux_spec=P(None, AXIS_DATA, AXIS_SEQ),
     )
+    return _lm_vag_from_mapped(mapped, cfg, M, prep=_sp_prep(cfg, seq_devices))
 
-    def prep(tokens):
-        B, T = tokens.shape
-        if T % seq_devices:
-            raise ValueError(
-                f"sequence length {T} not divisible by seq axis "
-                f"{seq_devices} (sp feeds full input+target rows)"
-            )
-        if T > cfg.max_seq_len:
-            raise ValueError(
-                f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}"
-            )
-        # Pre-shifted per-position targets + normalized mask: position p
-        # scores tokens[p+1]; the final position of each row is unscored
-        # (masked_next_token_ce's convention, shard-locally computable).
-        tgt = jnp.concatenate(
-            [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1
-        )
-        mask = jnp.concatenate(
-            [jnp.ones((B, T - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
-            axis=1,
-        ) / (B * (T - 1))
-        return tokens, (tgt, mask)
 
-    return _lm_vag_from_mapped(mapped, cfg, M, prep=prep)
+def make_pipeline_sp_lm_interleaved_grad(mesh, cfg: TransformerConfig,
+                                         num_virtual: int,
+                                         num_microbatches: int,
+                                         mode: str = "ulysses",
+                                         tables=None):
+    """Interleaved (virtual-stage) 1F1B x sequence parallelism —
+    Ulysses only, same scheduled-tail convention and rejection as
+    :func:`make_pipeline_sp_lm_1f1b_grad` (the table executor has the
+    same ``lax.switch`` structure the ring misbehaves in). Blocks in
+    :func:`shard_blocks_interleaved` layout. Pass ``tables`` from
+    :func:`~tpu_dist_nn.parallel.schedule_table.build_zero_bubble` for
+    the zero-bubble variant (:func:`make_pipeline_sp_lm_zb_grad`)."""
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+
+    _reject_ring_in_schedule(
+        mode, "interleaved/zb x sequence parallelism"
+    )
+    seq_devices = mesh.shape[AXIS_SEQ]
+    M = num_microbatches
+    mapped = make_interleaved_1f1b(
+        mesh, _sp_sched_stage_fn(cfg, mode), _sp_masked_tail_fn(),
+        num_virtual, M,
+        microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+        aux_spec=P(None, AXIS_DATA, AXIS_SEQ),
+        tables=tables,
+    )
+    return _lm_vag_from_mapped(mapped, cfg, M, prep=_sp_prep(cfg, seq_devices))
+
+
+def make_pipeline_sp_lm_zb_grad(mesh, cfg: TransformerConfig,
+                                num_virtual: int, num_microbatches: int,
+                                mode: str = "ulysses"):
+    """Zero-bubble (ZB-H1) x sequence parallelism: the split-backward
+    tables played back with Ulysses attention in the chunk bodies —
+    same layout and rejection rules as the interleaved variant."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
+
+    tables = build_zero_bubble(mesh.shape[_AS], num_virtual, num_microbatches)
+    return make_pipeline_sp_lm_interleaved_grad(
+        mesh, cfg, num_virtual, num_microbatches, mode, tables=tables
+    )
 
 
 def make_pipeline_sp_lm_loss(mesh, cfg: TransformerConfig, num_stages: int,
