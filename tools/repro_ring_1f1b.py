@@ -1,30 +1,55 @@
-"""Minimal reproducer: ring attention computes wrong values inside the
-1F1B schedule's ``lax.switch`` branches.
+"""Reproducer: ring attention computes wrong values inside the 1F1B
+schedule's ``lax.switch`` branches — ROOT CAUSE: ``lax.ppermute``
+lowers to collective-permute, whose rendezvous requires EVERY partition
+to execute the instruction; devices in a different branch never reach
+it.
 
 Context (round 4): composing the 1F1B pipeline schedule with sequence
 parallelism works exactly with the Ulysses (all_to_all) decomposition
-but NOT with the ring (ppermute-in-scan K/V rotation), even though the
-disjoint-axis rule says both should be legal — the tick predicate is
+but NOT with the ring (ppermute K/V rotation), even though the
+disjoint-axis rule appears to cover both — the tick predicate is
 seq-invariant, so every seq peer takes the same branch at the same
-tick, exactly the argument that makes Megatron TP psums work there
-(probe-verified, parity-tested).
+tick, exactly the argument that makes Megatron TP psums work there.
 
-Two reproduced failure modes, both isolated to the ring:
+The refinement the failure teaches: branch-safety needs BOTH same-
+branch peers AND group-local participation in the collective's
+lowering. ``psum``/``all_gather``/``all_to_all`` rendezvous only their
+replica group — peers in other branches are irrelevant — while
+collective-permute's rendezvous spans every partition in the program.
+The smallest demonstration (run separately; it ABORTS the process by
+design) is a 2x2 (stage, seq) mesh where stage 0 runs a seq-ppermute
+inside one ``lax.cond`` branch and stage 1 takes the other:
 
-1. ``seq=1`` (the ring degenerates to a SELF-permute): the first
-   microbatch's activations reach the schedule's tail correctly, every
-   later microbatch's arrive as ZEROS.
+    def device_fn(x):
+        s = lax.axis_index("stage")
+        return lax.cond(
+            s == 0,
+            lambda v: lax.ppermute(v, "seq", [(0, 1), (1, 0)]),
+            lambda v: v * 1.0,
+            x,
+        )
+    # XLA CPU aborts: "collective permute RendezvousKey{...
+    # num_local_participants=4 ...} Expected 4 threads to join the
+    # rendezvous, but only 2 of them arrived on time."
+
+In the FULL schedule the mismatch does not hang — later ticks' ring
+executions from other stages arrive at the same rendezvous — it
+silently mis-pairs and produces wrong values. Two observed modes,
+demonstrated by this script:
+
+1. ``seq=1`` (the rotation degenerates to a SELF-permute, still a
+   collective-permute instruction): the first microbatch's activations
+   reach the schedule's tail correctly, every later microbatch's
+   arrive as ZEROS. (An UNROLLED ring that skips the final rotation —
+   zero ppermutes at N=1 — is exact, isolating the collective.)
 2. ``seq>1``: attention outputs are wrong for every microbatch (the
-   tail sees |y| magnitudes ~40% off).
+   tail sees |y| magnitudes ~40% off), scan or unrolled alike.
 
-Substituting plain attention or Ulysses — same mesh, same specs, same
-schedule — gives exact results, so the executor's bookkeeping is not
-the suspect; the interaction is specific to a ``ppermute`` inside a
-``lax.scan`` inside a ``lax.switch`` branch inside the schedule's
-outer ``lax.scan`` under ``shard_map``. Until that interaction is
-understood (JAX/XLA level?), ``make_pipeline_sp_lm_1f1b_grad`` rejects
-``mode="ring"`` — rejecting beats silently training on wrong
-gradients. Run this script to reproduce both modes:
+Consequences in the framework: the scheduled executors' own stage
+wires ride unconditional ppermutes OUTSIDE the switch (by design);
+scheduled x SP factories accept Ulysses and reject ring
+(`_reject_ring_in_schedule`); a future ring variant should hoist the
+K/V rotation into the unconditional tick section. Run:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     JAX_PLATFORMS=cpu python tools/repro_ring_1f1b.py
@@ -100,18 +125,103 @@ def main() -> int:
               f"{[round(v, 2) for v in vals]}")
         return np.asarray(vals)
 
+    def ring_unrolled(q, k, v, *, causal, axis_name=AXIS_SEQ):
+        """Ring attention with a PYTHON loop instead of lax.scan, and
+        no rotation after the last block — at N=1 this issues ZERO
+        ppermutes (isolating the collective from the scan): exact. At
+        N>1 it still issues branch-local ppermutes: still wrong."""
+        out_dtype = q.dtype
+        _B, Tq, _H, Dh = q.shape
+        N = lax.psum(1, axis_name)
+        idx = lax.axis_index(axis_name)
+        scale = 1.0 / np.sqrt(Dh)
+        q32 = q.astype(jnp.float32)
+        q_pos = idx * Tq + jnp.arange(Tq)
+        ring_perm = [(i, (i + 1) % N) for i in range(N)]
+        m = jnp.swapaxes(q32[..., 0], 1, 2) * 0.0 - jnp.inf
+        l = jnp.swapaxes(q32[..., 0], 1, 2) * 0.0
+        acc = q32 * 0.0
+        k_blk, v_blk = k, v
+        for s in range(N):
+            kv_idx = (idx - s) % N
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+            ) * scale
+            if causal:
+                k_pos = kv_idx * k_blk.shape[1] + jnp.arange(k_blk.shape[1])
+                mask = k_pos[None, :] <= q_pos[:, None]
+                scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            block_m = jnp.max(scores, axis=-1)
+            new_m = jnp.maximum(m, block_m)
+            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+            p = jnp.exp(scores - safe_m[..., None])
+            corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+            l = l * corr + jnp.sum(p, axis=-1)
+            acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+            )
+            m = new_m
+            if s < N - 1:
+                k_blk = lax.ppermute(k_blk, axis_name, ring_perm)
+                v_blk = lax.ppermute(v_blk, axis_name, ring_perm)
+        return (acc / l.transpose(0, 2, 1)[..., None]).astype(out_dtype)
+
     print("expected (plain attention, any seq): ~[1231.32, 1388.74]")
     ok = probe(1, dot_product_attention, "seq=1 plain    ")
     probe(1, _sp_attn_fn("ring"), "seq=1 ring      (mode 1: zeros)")
     probe(2, _sp_attn_fn("ring"), "seq=2 ring      (mode 2: wrong)")
+    un1 = probe(1, ring_unrolled, "seq=1 UNROLLED  (0 ppermutes: exact)")
+    probe(2, ring_unrolled, "seq=2 UNROLLED  (ppermutes: still wrong)")
     uly = probe(2, _sp_attn_fn("ulysses"), "seq=2 ulysses   (exact)")
     # Tolerance, not exact equality: reduction order varies with
     # backend/thread configuration at float32.
     assert np.allclose(uly, ok, rtol=1e-4), (
         "ulysses should be exact — reproducer assumptions broken"
     )
+    assert np.allclose(un1, ok, rtol=1e-4), (
+        "unrolled N=1 (zero ppermutes) should be exact"
+    )
     return 0
 
 
+def rendezvous_proof() -> int:
+    """``--rendezvous``: the smallest demonstration of the root cause.
+
+    WARNING: this ABORTS the process by design — XLA's CPU rendezvous
+    times out waiting for the partitions that took the other branch:
+
+        collective permute RendezvousKey{... num_local_participants=4
+        ...} Expected 4 threads to join the rendezvous, but only 2 of
+        them arrived on time.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    jax.config.update("jax_platforms", "cpu")
+    mesh = jax.make_mesh((2, 2), ("stage", "seq"))
+
+    def device_fn(x):
+        s = lax.axis_index("stage")
+        return lax.cond(
+            s == 0,
+            lambda v: lax.ppermute(v, "seq", [(0, 1), (1, 0)]),
+            lambda v: v * 1.0,
+            x,
+        )
+
+    f = jax.shard_map(device_fn, mesh=mesh, in_specs=P("stage", "seq"),
+                      out_specs=P("stage", "seq"))
+    print("issuing a seq-ppermute inside a branch only stage 0 takes; "
+          "expect the rendezvous abort within ~60s ...")
+    print(f(jnp.arange(8.0).reshape(4, 2)))  # never returns cleanly
+    return 1  # pragma: no cover — reaching here would disprove the claim
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    import sys as _sys
+
+    raise SystemExit(
+        rendezvous_proof() if "--rendezvous" in _sys.argv else main()
+    )

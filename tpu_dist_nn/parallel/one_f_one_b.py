@@ -123,13 +123,27 @@ def make_1f1b(
     on which the tick predicate is INVARIANT — the predicate depends
     only on ``(t, stage index)``, so every participant of a collective
     over a disjoint axis (``model``, ``seq``, ``expert``) takes the same
-    branch at the same tick and the collective pairs correctly inside
-    the ``lax.switch``. Megatron tensor parallelism (psums over
-    ``model``, tensor_parallel.tp_block_apply) therefore composes with
-    this schedule — see transformer_pipeline.make_pipeline_tp_lm_1f1b_grad.
-    Still banned: collectives over ``stage`` or ``data`` inside the
-    bodies (the predicate varies over ``stage``, and the executor owns
-    the ``data``-axis reduction itself, once, after the scan).
+    branch at the same tick — AND whose lowering has GROUP-LOCAL
+    participation: ``psum``/``all_gather``/``all_to_all`` lower to ops
+    whose rendezvous involves only their replica group, so peers in
+    other branches are irrelevant. Megatron tensor parallelism (psums
+    over ``model``) and Ulysses sequence parallelism (all_to_all over
+    ``seq``) therefore compose with this schedule.
+
+    ``lax.ppermute`` does NOT, even over a disjoint axis: it lowers to
+    collective-permute, whose rendezvous expects EVERY partition in the
+    program to execute the instruction — devices in a different branch
+    never reach it, so the op deadlocks (proven by the minimal
+    reproducer in ``tools/repro_ring_1f1b.py``: "Expected 4 threads to
+    join the rendezvous, but only 2 arrived") or, in larger programs,
+    silently mis-pairs with a later execution and computes wrong
+    values. That is why ring attention's K/V rotation is rejected
+    inside the scheduled executors while Ulysses is exact, and why this
+    executor's own stage wires ride ONE UNCONDITIONAL ppermute pair
+    per tick outside the ``lax.switch``. Also still banned:
+    collectives over ``stage`` or ``data`` inside the bodies (the
+    predicate varies over ``stage``, and the executor owns the
+    ``data``-axis reduction itself, once, after the scan).
     """
     S, M = num_stages, num_microbatches
     K = min(S, M)

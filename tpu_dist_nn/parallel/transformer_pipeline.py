@@ -371,16 +371,23 @@ def make_pipeline_sp_lm_forward(mesh, cfg: TransformerConfig,
 
 
 def _reject_ring_in_schedule(mode: str, what: str):
-    """The ring's ppermute-in-scan K/V rotation computes wrong values
-    inside the scheduled executors' ``lax.switch`` branches (reproduced:
-    ``tools/repro_ring_1f1b.py``); every hand-scheduled x SP factory
-    funnels through this rejection."""
+    """The ring's K/V rotation cannot run inside the scheduled
+    executors' ``lax.switch`` branches — root cause (minimal
+    reproducer + rendezvous proof: ``tools/repro_ring_1f1b.py``):
+    ``lax.ppermute`` lowers to collective-permute, whose rendezvous
+    requires EVERY partition to execute the instruction, and devices
+    in a different branch never reach it — the op deadlocks or
+    silently mis-pairs with a later execution (wrong values).
+    ``psum``/``all_to_all`` participate per replica group, which is why
+    Megatron TP and Ulysses are exact in the same position. Every
+    hand-scheduled x SP factory funnels through this rejection."""
     if mode != "ulysses":
         raise ValueError(
-            f"{what} supports mode='ulysses' only: the ring computes "
-            "wrong values inside the schedule's lax.switch branches "
-            "(tools/repro_ring_1f1b.py); use --sp-mode ulysses, or "
-            "schedule='gpipe' for the ring"
+            f"{what} supports mode='ulysses' only: the ring's ppermute "
+            "lowers to a globally-participating collective-permute, "
+            "which cannot execute inside a branch not taken by every "
+            "device (tools/repro_ring_1f1b.py); use --sp-mode ulysses, "
+            "or schedule='gpipe' for the ring"
         )
 
 
@@ -464,19 +471,26 @@ def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
     executor reduces stage grads over ``seq`` like ``data`` (each seq
     shard saw different positions of the same microbatch).
 
-    **Ulysses only.** The ring decomposition (a ``ppermute``-in-scan
-    K/V rotation) produces WRONG VALUES inside the 1F1B ``lax.switch``
-    branches on the CPU mesh — two reproducible failure modes: at
-    seq=1 (self-permute) later microbatches' activations reach the
-    tail as zeros; at seq>1 attention outputs are wrong for every
-    microbatch. Ulysses' ``all_to_all`` decomposition is exact (like
-    TP's psums), so this factory accepts ``mode="ulysses"`` and
-    rejects ``"ring"`` with a pointer at the gpipe pp x sp path (which
-    runs the ring correctly via AD through the scan). The tick
-    predicate argument says ring SHOULD be legal; until the
-    collective-in-scan-in-switch interaction is understood, rejecting
-    beats silently training on wrong gradients. Standalone reproducer
-    with both modes and the exact controls: ``tools/repro_ring_1f1b.py``.
+    **Ulysses only — root cause identified.** The ring decomposition's
+    K/V rotation uses ``lax.ppermute``, which lowers to
+    collective-permute: an op whose rendezvous requires EVERY partition
+    in the program to execute the instruction. Inside a ``lax.switch``
+    branch only the scheduled stage's devices reach it, so the op
+    deadlocks (the minimal reproducer aborts with "Expected 4 threads
+    to join the rendezvous, but only 2 arrived") or, in the full
+    schedule, silently mis-pairs with a later execution — observed as
+    zeros reaching the tail for later microbatches at seq=1 and wrong
+    attention outputs at seq>1. ``psum``/``all_to_all`` participate
+    per replica group, which is why Megatron TP and Ulysses are exact
+    in the identical position, and why this executor's own stage wires
+    ride unconditional ppermutes outside the switch. This factory
+    therefore accepts ``mode="ulysses"`` and rejects ``"ring"`` with a
+    pointer at the gpipe pp x sp path (AD through an unconditional
+    scan — ring is exact there). Fix direction for a ring variant:
+    hoist the K/V rotation out of the branches into the unconditional
+    tick section, like the stage wires. Standalone reproducer with the
+    failure modes, exact controls, and the rendezvous proof:
+    ``tools/repro_ring_1f1b.py``.
 
     The tail runs INSIDE the schedule per (microbatch, seq shard), so
     the position-0-masked CE convention is carried by PRE-SHIFTED
