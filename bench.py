@@ -82,15 +82,102 @@ def probe_tpu() -> tuple[str, str] | None:
     return probed
 
 
-def _time_resident(jax, apply, params, dx, n_samples, reps=7) -> float:
-    """Min-of-``reps`` device-resident samples/sec for one apply fn."""
-    jax.block_until_ready(apply(params, dx))  # warmup / compile
+_RTT_FLOOR_CACHE: dict[int, float] = {}
+
+
+def _rtt_floor(jax, reps=5) -> float:
+    """Fixed dispatch + scalar-fetch round-trip cost of one timed call.
+
+    A trivial seeded program (nothing to compute, nothing cacheable
+    across calls) fetched the same way the timed programs are; min over
+    ``reps``. Cached per-process.
+    """
+    if 0 in _RTT_FLOOR_CACHE:
+        return _RTT_FLOOR_CACHE[0]
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(seed):
+        return seed * jnp.float32(2.0) + jnp.float32(1.0)
+
+    np.asarray(f(jnp.float32(0.5)))  # compile
     times = []
-    for _ in range(reps):
+    for i in range(reps):
+        s = jnp.float32(1000.0 + i)
         t0 = time.monotonic()
-        jax.block_until_ready(apply(params, dx))
+        np.asarray(f(s))
         times.append(time.monotonic() - t0)
-    return n_samples / min(times)
+    _RTT_FLOOR_CACHE[0] = min(times)
+    return _RTT_FLOOR_CACHE[0]
+
+
+def _time_resident(jax, apply, params, dx, n_samples, reps=3,
+                   iters=200) -> float:
+    """Device-resident samples/sec for one apply fn, timed HONESTLY.
+
+    Two platform pathologies make naive timing lie here (both proven
+    live on the tunneled axon backend, 2026-07-31):
+
+    * ``block_until_ready`` does NOT block — it returned in ~60 us
+      while the actual value fetch of the same result took 59 s
+      (draining the silently-queued backlog). Only a value readback is
+      a true barrier, so every sample ends in ``np.asarray`` of a
+      scalar output.
+    * Repeated identical executions are served from a cache (the first
+      fetch took 59 s, identical re-runs 0.23 s), so every timed call
+      carries a distinct ``seed`` input that perturbs nothing
+      numerically (``+ seed * 1e-30`` is exact identity in f32) but
+      busts any input-digest replay.
+
+    Method: ``iters`` data-dependent passes inside ONE jit (the carry
+    perturbs the next input so XLA cannot hoist or overlap), closed by
+    a scalar fetch; ``iters`` is sized so compute dominates the
+    dispatch+fetch RTT (measured separately by :func:`_rtt_floor` and
+    subtracted — observed RTT ~0.2 s with ~10 ms jitter, so
+    two-point differencing at small K drowns in that jitter; this
+    single-point form needs K * per_pass >> jitter, not >> RTT).
+    Cross-checked standalone by tools/resident_probe.py.
+    """
+    from jax import lax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(p, bx, seed):
+        def body(_, carry):
+            eps, acc = carry
+            out = apply(p, bx + eps)
+            s = out.reshape(-1)[0]
+            return s * jnp.float32(1e-30), acc + s
+
+        out0 = apply(p, bx + seed * jnp.float32(1e-30))
+        s0 = out0.reshape(-1)[0]
+        _, acc = lax.fori_loop(
+            0, iters, body, (s0 * jnp.float32(1e-30), s0)
+        )
+        return acc
+
+    seed = [float(np.random.default_rng().integers(1 << 20))]
+
+    def timed():
+        seed[0] += 1.0
+        s = jnp.float32(seed[0])
+        t0 = time.monotonic()
+        np.asarray(run(params, dx, s))  # value fetch = true barrier
+        return time.monotonic() - t0
+
+    timed()  # warmup / compile
+    best = min(timed() for _ in range(reps))
+    floor = _rtt_floor(jax)
+    if best - floor < 0.02:
+        # Signal below ~2x the observed RTT jitter: a replay-cache hit
+        # or floor mis-measurement. Refuse to emit a number — the
+        # over-reporting failure mode (commit 306efb9's 495-TFLOPS
+        # artifact) must fail loudly, not plausibly.
+        raise RuntimeError(
+            f"timing invalid: best {best:.4f}s within jitter of RTT "
+            f"floor {floor:.4f}s — raise iters"
+        )
+    return n_samples * (iters + 1) / (best - floor)
 
 
 def throughput_bench(jax, jnp, on_accel: bool) -> dict:
@@ -159,29 +246,55 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
     # 60k rows is ~30 us on a v5e vs ~29 ms for the 47 MB u8 transfer),
     # so one bulk device_put + one kernel launch beats chunked
     # prefetch: same bytes, no per-chunk dispatch overhead.
-    def run_pass():
-        dx = jax.device_put(x)
-        out = apply(params, dx)
-        jax.block_until_ready(out)
+    host_rng = np.random.default_rng()  # process-random: two bench
+    # invocations must not replay each other's uploads either
+
+    def run_pass(rep: int):
+        # Perturb a few bytes per rep with process-random values: the
+        # tunnel replays identical executions from a cache (see
+        # _time_resident), and a repeated device_put of byte-identical
+        # data may be deduped — either would fake the transfer this
+        # figure exists to measure. Deterministic perturbation (e.g.
+        # rep & 0xFF on a fixed-seed array) would be byte-identical
+        # across bench invocations, so the bytes come from OS entropy.
+        x[0, :8] = host_rng.integers(0, 256, 8, dtype=np.uint8)
+        dx_ = jax.device_put(x)
+        out = apply(params, dx_)
+        # Value fetch is the only true barrier on this platform
+        # (block_until_ready returns before execution; bench docstring).
+        np.asarray(out[0])
         return out
 
-    run_pass()  # warmup / compile
+    run_pass(255)  # warmup / compile
     # Host->device bandwidth through the harness tunnel jitters run to
-    # run; min-of-7 ~30 ms passes gives a stable throughput figure.
+    # run; min-of-7 passes gives a stable throughput figure.
     times = []
-    for _ in range(7):
+    for rep in range(7):
         t0 = time.monotonic()
-        run_pass()
+        run_pass(rep)
         times.append(time.monotonic() - t0)
     host_fed = n_samples / min(times)
 
     dx = jax.device_put(x)
     jax.block_until_ready(dx)
-    xla_res = _time_resident(jax, jit_apply, params, dx, n_samples)
-    fused_res = (
-        _time_resident(jax, fused_apply, params, dx, n_samples)
-        if fused_apply is not None else None
+    # Chained-iteration counts: 200 in-jit passes on the accelerator
+    # (~0.3 s of compute, >> the ~10 ms RTT jitter); off-accelerator 3
+    # keeps the 1-core CPU fallback inside the driver budget.
+    reps, iters = (3, 200) if on_accel else (2, 3)
+    xla_res = _time_resident(
+        jax, jit_apply, params, dx, n_samples, reps=reps, iters=iters,
     )
+    try:
+        fused_res = (
+            _time_resident(
+                jax, fused_apply, params, dx, n_samples,
+                reps=reps, iters=iters,
+            )
+            if fused_apply is not None else None
+        )
+    except RuntimeError as e:
+        print(f"# fused timing invalid ({e})", file=sys.stderr)
+        fused_res = None
     resident = fused_res if fused_res is not None else xla_res
 
     # Int8 serving path: the quantized chain on the same workload
@@ -208,7 +321,7 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
         n_int8 = n_samples if on_accel else batch
         int8_res = _time_resident(
             jax, int8_apply, qp, dx[:n_int8], n_int8,
-            reps=7 if on_accel else 3,
+            reps=reps, iters=iters,
         )
         # Per-sample throughput depends on batch size, so the ratio
         # denominator must come from the SAME slice the int8 path ran
@@ -216,7 +329,10 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
         # rather than reusing the full-60k `resident` figure.
         int8_f32_ref = (
             resident if n_int8 == n_samples
-            else _time_resident(jax, apply, params, dx[:n_int8], n_int8, reps=3)
+            else _time_resident(
+                jax, apply, params, dx[:n_int8], n_int8,
+                reps=reps, iters=iters,
+            )
         )
     except Exception as e:  # pragma: no cover - backend-specific
         print(f"# int8 path unavailable ({type(e).__name__}: {e})",
@@ -240,6 +356,7 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
         # off-accelerator it is smaller than the 60k resident pass, so
         # the raw fields are not directly comparable without this.
         "int8_bench_samples": n_int8 if int8_res is not None else None,
+        "resident_method": "chained-in-jit (data-dependent fori_loop)",
     }
 
 
@@ -420,20 +537,48 @@ def mfu_bench(jax, jnp, device_kind: str | None, on_accel: bool) -> dict:
         out = bx @ w + b
         return jnp.mean(jnp.square(out.astype(jnp.float32)))
 
-    @jax.jit
     def train_step(p, bx):
         grads = jax.grad(loss_fn)(p, bx)
         return jax.tree.map(lambda w, g: w - 1e-3 * g.astype(w.dtype), p, grads)
 
-    params = train_step(params, x)  # warmup / compile
-    jax.block_until_ready(params)
-    times = []
-    for _ in range(5):
+    # Chain optimizer steps inside ONE jit (params carry makes each
+    # step data-dependent on the last) and time with the fetch-barrier
+    # method: ``block_until_ready`` does not block on the tunneled
+    # platform and identical executions are replayed from a cache (see
+    # _time_resident) — so each timed call closes with a scalar value
+    # fetch, carries a distinct seed, and enough steps (~1.4 s of
+    # compute at peak) that the measured ~10 ms RTT jitter is <1%.
+    steps, reps = (30, 3) if on_accel else (2, 2)
+    from jax import lax
+
+    @jax.jit
+    def train_k(p, bx, seed):
+        # seed stays f32 end-to-end until the product underflows into
+        # the bf16 add: a bf16 seed would collapse (7-bit mantissa:
+        # bf16(786433) == bf16(786434)) and re-enable the replay cache
+        # the seed exists to bust.
+        bx = bx + (seed * jnp.float32(1e-30)).astype(jnp.bfloat16)
+        out = lax.fori_loop(0, steps, lambda _, q: train_step(q, bx), p)
+        return out[0][0].reshape(-1)[0].astype(jnp.float32)
+
+    seed = [float(np.random.default_rng().integers(1 << 20))]
+
+    def timed():
+        seed[0] += 1.0
+        s = jnp.float32(seed[0])
         t0 = time.monotonic()
-        params = train_step(params, x)
-        jax.block_until_ready(params)
-        times.append(time.monotonic() - t0)
-    best = min(times)
+        np.asarray(train_k(params, x, s))
+        return time.monotonic() - t0
+
+    timed()  # warmup / compile
+    best_total = min(timed() for _ in range(reps))
+    floor = _rtt_floor(jax)
+    if best_total - floor < 0.02:
+        raise RuntimeError(
+            f"mfu timing invalid: best {best_total:.4f}s within jitter "
+            f"of RTT floor {floor:.4f}s"
+        )
+    best = (best_total - floor) / steps
     mnk = batch * width * width
     flops = depth * 4 * mnk + (depth - 1) * 2 * mnk
     achieved = flops / best
@@ -531,7 +676,13 @@ def serving_main() -> int:
 def main() -> int:
     jax, jnp, backend, device_kind, on_accel = _bring_up()
     tp = throughput_bench(jax, jnp, on_accel)
-    mfu = mfu_bench(jax, jnp, device_kind, on_accel)
+    try:
+        mfu = mfu_bench(jax, jnp, device_kind, on_accel)
+    except Exception as e:  # pragma: no cover - must not cost the headline
+        print(f"# mfu bench unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        mfu = {"achieved_tflops": None, "mfu": None,
+               "mfu_metric": None, "peak_tflops": None}
     try:
         pipe = pipeline_latency_bench(jax)
     except Exception as e:  # pragma: no cover - must not cost the headline
