@@ -240,7 +240,10 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
         print(f"# fused kernel unavailable ({type(e).__name__}: {e}); "
               "using jit chain", file=sys.stderr)
         fused_apply = None
-    apply = fused_apply or jit_apply
+    # Host-fed headline rides the XLA chain: the measured default path
+    # (the f32 fused kernel is parity-at-best on hardware — see
+    # kernels/fused_dense.py and artifacts/tpu_r04/kernel_sweep.json).
+    apply = jit_apply
 
     # The pass is ~100% host->device transfer-bound (compute for all
     # 60k rows is ~30 us on a v5e vs ~29 ms for the 47 MB u8 transfer),
@@ -295,7 +298,9 @@ def throughput_bench(jax, jnp, on_accel: bool) -> dict:
     except RuntimeError as e:
         print(f"# fused timing invalid ({e})", file=sys.stderr)
         fused_res = None
-    resident = fused_res if fused_res is not None else xla_res
+    # The serving path is whichever measured faster (selection logic
+    # in the framework follows the same measurement).
+    resident = max(v for v in (fused_res, xla_res) if v is not None)
 
     # Int8 serving path: the quantized chain on the same workload
     # (fused Pallas on TPU, jnp int8 elsewhere — kernels/quantized.py

@@ -53,7 +53,9 @@ def test_pallas_chain_matches_jnp_reference_exactly():
     params, x = _params_and_x(batch=100)  # ragged vs block_b
     q = quantize_fcnn(params)
     ref = forward_quantized(q, x)
-    got = fcnn_quantized_forward(q, x, block_b=32)
+    # prefer_kernel=True: the measured-width dispatch would route these
+    # tiny layers to the jnp chain (making the comparison vacuous).
+    got = fcnn_quantized_forward(q, x, block_b=32, prefer_kernel=True)
     np.testing.assert_allclose(
         np.asarray(ref), np.asarray(got), rtol=1e-6, atol=1e-7
     )

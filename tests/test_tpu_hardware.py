@@ -102,8 +102,12 @@ def test_int8_chain_accuracy_preserving_on_device():
         np.random.default_rng(4).uniform(0, 1, (512, 784)), jnp.float32
     )
     qp = quantize_fcnn(params)
+    # prefer_kernel=True: this gate exists to prove the Pallas int8
+    # chain on hardware; the measured-width dispatch would route the
+    # flagship's tiny layers to the jnp chain.
     got = np.asarray(
-        fcnn_quantized_forward(qp, x, activations=("relu", "relu", "softmax"))
+        fcnn_quantized_forward(qp, x, activations=("relu", "relu", "softmax"),
+                               prefer_kernel=True)
     ).argmax(-1)
     want = np.asarray(forward(params, x)).argmax(-1)
     # Int8 is lossy; the serving gate is argmax agreement, not values.

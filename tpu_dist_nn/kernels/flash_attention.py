@@ -311,10 +311,33 @@ def _flash_call_bwd(scale, causal, block_q, block_k, seq_len, res, g):
 _flash_call.defvjp(_flash_call_fwd, _flash_call_bwd)
 
 
-def default_attn_fn():
-    """The attention to use on this backend: the flash kernel on TPU,
-    the jnp reference elsewhere (interpret-mode Pallas on CPU is
-    correct but slow — tests opt in explicitly)."""
+# Measured crossover on a live TPU v5 lite (artifacts/tpu_r04/
+# kernel_sweep.json, B=4 H=8 Dh=64 causal bf16): XLA's materialized
+# attention wins below this — flash 0.81x/0.89x at T=1024/2048 — and
+# collapses above it (T^2 f32 logits go HBM-bound): flash is 2.32x fwd
+# / 1.74x grad at T=4096. Shapes are static under jit, so the dispatch
+# resolves at trace time.
+FLASH_MIN_SEQ = 3072
+
+
+def select_attention(q, k, v, *, causal: bool):
+    """Shape-aware attention dispatch, resolved at trace time: the
+    flash kernel where it measures faster (T >= FLASH_MIN_SEQ, or any
+    length where the materialized T^2 score matrix would not fit), the
+    jnp reference below that."""
     from tpu_dist_nn.models.transformer import dot_product_attention
 
-    return flash_attention if jax.default_backend() == "tpu" else dot_product_attention
+    if q.shape[-3] >= FLASH_MIN_SEQ:
+        return flash_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal)
+
+
+def default_attn_fn():
+    """The attention to use on this backend: measured shape-aware
+    dispatch on TPU (:func:`select_attention` — XLA attention at short
+    sequences, flash from ``FLASH_MIN_SEQ``), the jnp reference
+    elsewhere (interpret-mode Pallas on CPU is correct but slow —
+    tests opt in explicitly)."""
+    from tpu_dist_nn.models.transformer import dot_product_attention
+
+    return select_attention if jax.default_backend() == "tpu" else dot_product_attention

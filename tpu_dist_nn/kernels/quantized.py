@@ -112,20 +112,41 @@ def quantized_chain_fits_vmem(qparams) -> bool:
 
 def fcnn_quantized_forward(qparams, x, *,
                            activations: Sequence[str] | None = None,
-                           block_b: int = 512):
+                           block_b: int = 512,
+                           prefer_kernel: bool | None = None):
     """Whole int8 chain in one Pallas kernel per batch tile.
 
     Every layer's int8 weights are VMEM-resident (4x the capacity of
     the f32 chain); activations quantize/rescale between layers without
     leaving VMEM. Falls back to the jnp path when the weights exceed
-    the VMEM budget.
+    the VMEM budget, and — by measurement — below kernel-profitable
+    widths (see below). ``prefer_kernel`` overrides the measured
+    dispatch: True forces the Pallas chain (still subject to the VMEM
+    fit), False forces the jnp chain, None selects.
     """
     if activations is None:
         activations = tuple(ACTIVATION_NAMES[int(p["act"])] for p in qparams)
     else:
         activations = tuple(activations)
+    if prefer_kernel is False:
+        return forward_quantized(qparams, x, activations)
     if not quantized_chain_fits_vmem(qparams):
         return forward_quantized(qparams, x, activations)
+    # Measured on a live TPU v5 lite (artifacts/tpu_r04/
+    # kernel_sweep.json, resident_probe.json, int8_crossover.jsonl):
+    # there is no sharp width crossover — uniform-width chains land
+    # within ~0.9-1.5x either way — but the one decisive signal is the
+    # flagship-like shape (784-128-64-10: jnp 1.9x faster; its 64/10
+    # interior dims sit below the 128-lane MXU tile). The final
+    # layer's output dim (a classifier head) measured irrelevant:
+    # 1024-1024-1024-10 still favors the kernel (1.017x). So the gate
+    # routes to jnp only when an INTERIOR dim (any input dim, or any
+    # output dim except the last layer's) is sub-tile.
+    if prefer_kernel is None:
+        interior = [p["wq"].shape[0] for p in qparams]
+        interior += [p["wq"].shape[1] for p in qparams[:-1]]
+        if min(interior) < 128:
+            return forward_quantized(qparams, x, activations)
     return _quantized_chain_call(
         tuple((p["wq"].shape, p["b"].shape) for p in qparams),
         activations,
