@@ -99,7 +99,11 @@ class HeteroPipeline:
                 h = jax.device_put(h, stage["device"])
                 h = jitted_network_forward(stage["plan"])(stage["params"], h)
                 if block_each:
-                    jax.block_until_ready(h)
+                    # Value fetch, not block_until_ready: on the
+                    # tunneled TPU the readiness signal does not block
+                    # (artifacts/tpu_r04/RECORD.json timing_forensics),
+                    # so the control arm must serialize on real values.
+                    np.asarray(h[:1, :1])
             outs.append(h)  # don't block: let later chunks overlap
         return outs
 
@@ -164,6 +168,11 @@ def measure_dispatch_overlap(hp: HeteroPipeline, x, microbatch_size: int,
       means the host never serializes on per-stage completion, i.e.
       the overlap window is real. On real multi-device hardware
       ``total_s < blocked_s`` additionally shows the wall-clock win.
+    - ``fetch_rtt_s``: measured per-value-fetch round-trip, already
+      subtracted from ``total_s``/``blocked_s`` in proportion to each
+      arm's fetch count — on a remote link the barriers are value
+      fetches, and without this correction the control arm's per-stage
+      fetches would manufacture a low ratio out of link latency.
     """
     import time
 
@@ -171,26 +180,65 @@ def measure_dispatch_overlap(hp: HeteroPipeline, x, microbatch_size: int,
     chunks = [
         x[i: i + microbatch_size] for i in range(0, len(x), microbatch_size)
     ]
-    jax.block_until_ready(hp._dispatch_chunks(chunks))  # warm compiles
+    # Warm compiles with a VALUE fetch per output — block_until_ready
+    # does not block on the tunneled TPU (artifacts/tpu_r04/RECORD.json
+    # timing_forensics), and an un-drained warm-up would pollute rep 1.
+    for o in hp._dispatch_chunks(chunks):
+        np.asarray(o[:1, :1])
 
+    # Per-fetch RTT floor: every barrier below is a value fetch, which
+    # on a remote link costs a host round-trip a local synchronous host
+    # would not pay. The control arm fetches per STAGE and the async
+    # arm per CHUNK, so without correction a high-RTT link would
+    # manufacture a low dispatch_ratio out of pure link latency. The
+    # probe output is DRAINED first (its own value fetched) so the
+    # timed fetches measure fetch cost alone, not the chunk's compute.
+    probe = hp._dispatch_chunks(chunks[:1])[0]
+    np.asarray(probe[:1, :1])  # drain: compute finishes here
+    t0 = time.monotonic()
+    for _ in range(3):
+        np.asarray(probe[:1, :1])
+    rtt = (time.monotonic() - t0) / 3
+
+    rng = np.random.default_rng()  # OS entropy: two calls must differ too
     dispatch_s, total_s, blocked_s = [], [], []
+    n_stage_fetches = len(chunks) * len(hp.stages)
     for _ in range(reps):
+        # Perturb one element per rep: the tunneled TPU replays
+        # byte-identical executions from a cache (docs/PERF.md
+        # "Remote-tunnel measurement caveats"), which would otherwise
+        # make every rep after the first a replay. chunks[0] views x,
+        # and _dispatch_chunks re-device_puts per call.
+        chunks[0][0, 0] = np.float32(rng.uniform(0.0, 1.0))
         t0 = time.monotonic()
         outs = hp._dispatch_chunks(chunks)
         dispatch_s.append(time.monotonic() - t0)
-        jax.block_until_ready(outs)
-        total_s.append(time.monotonic() - t0)
+        # One element per chunk output suffices — a buffer's values
+        # exist only after its program ran.
+        for o in outs:
+            np.asarray(o[:1, :1])
+        total_s.append(max(time.monotonic() - t0 - rtt * len(chunks), 0.0))
 
+        chunks[0][0, 0] = np.float32(rng.uniform(0.0, 1.0))
         t0 = time.monotonic()
-        jax.block_until_ready(hp._dispatch_chunks(chunks, block_each=True))
-        blocked_s.append(time.monotonic() - t0)
+        hp._dispatch_chunks(chunks, block_each=True)
+        blocked_s.append(
+            max(time.monotonic() - t0 - rtt * n_stage_fetches, 0.0)
+        )
     out = {
         "num_chunks": len(chunks),
         "num_stages": len(hp.stages),
         "dispatch_s": min(dispatch_s),
         "total_s": min(total_s),
         "blocked_s": min(blocked_s),
+        "fetch_rtt_s": rtt,
     }
+    if out["blocked_s"] <= 0.0:
+        raise RuntimeError(
+            "overlap measurement invalid: serialized arm vanished under "
+            f"the RTT correction (rtt {rtt:.4f}s x {n_stage_fetches} "
+            "fetches) — raise the workload size"
+        )
     out["dispatch_ratio"] = out["dispatch_s"] / out["blocked_s"]
     return out
 
