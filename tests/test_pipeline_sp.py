@@ -157,12 +157,21 @@ def test_cli_lm_pp_sp(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "perplexity" in out
 
-@pytest.mark.parametrize("seq,data", [(2, 2), (4, 1)])
-def test_pp_sp_1f1b_grads_match_single_chip(seq, data):
-    # 1F1B x SP (Ulysses): the memory-flat schedule with all_to_all
-    # sequence-parallel attention in the stage bodies — loss and grads
-    # must equal single-chip AD of the masked CE (the same oracle the
-    # gpipe pp x sp path is pinned to, so all three agree transitively).
+@pytest.mark.parametrize("seq,data,mode", [
+    (2, 2, "ulysses"),
+    (4, 1, "ulysses"),
+    (2, 2, "ring"),
+    (4, 1, "ring"),
+])
+def test_pp_sp_1f1b_grads_match_single_chip(seq, data, mode):
+    # 1F1B x SP: the memory-flat schedule with sequence-parallel
+    # attention in the stage bodies — loss and grads must equal
+    # single-chip AD of the masked CE (the same oracle the gpipe
+    # pp x sp path is pinned to, so all three agree transitively).
+    # Ulysses runs its all_to_alls unchanged; the ring swaps its
+    # ppermute K/V rotation for the branch-safe group-local
+    # reduce-scatter (_rotate_one_hop_group_local) — ppermute inside
+    # the switch computes wrong values (tools/repro_ring_1f1b.py).
     from tpu_dist_nn.parallel.transformer_pipeline import (
         make_pipeline_sp_lm_1f1b_grad,
     )
@@ -172,7 +181,7 @@ def test_pp_sp_1f1b_grads_match_single_chip(seq, data):
     tokens = _tokens(batch=8, seq=16, seed=12)
 
     vag = make_pipeline_sp_lm_1f1b_grad(
-        mesh, CFG, num_stages=2, num_microbatches=2, mode="ulysses"
+        mesh, CFG, num_stages=2, num_microbatches=2, mode=mode
     )
     params_pp = dict(params, blocks=shard_blocks(params["blocks"], 2))
     loss_pp, g_pp = jax.jit(vag)(params_pp, tokens)
@@ -191,18 +200,37 @@ def test_pp_sp_1f1b_grads_match_single_chip(seq, data):
         )
 
 
-def test_pp_sp_1f1b_rejects_ring():
-    # The ring's ppermute-in-scan K/V rotation computes wrong values
-    # inside the 1F1B switch branches (factory docstring documents the
-    # two reproduced failure modes) — rejecting beats silently training
-    # on wrong gradients. The gpipe pp x sp path keeps the ring.
-    from tpu_dist_nn.parallel.transformer_pipeline import (
-        make_pipeline_sp_lm_1f1b_grad,
-    )
+def test_ring_collective_rotation_matches_ppermute():
+    # The branch-safe rotation is numerically the ppermute ring: same
+    # attention outputs outside any schedule, where both are legal.
+    from jax.sharding import PartitionSpec as P
 
-    mesh = build_mesh(MeshSpec(stage=2, seq=2, data=2))
-    with pytest.raises(ValueError, match="ulysses"):
-        make_pipeline_sp_lm_1f1b_grad(mesh, CFG, 2, 2, mode="ring")
+    from tpu_dist_nn.models.transformer import dot_product_attention
+    from tpu_dist_nn.parallel.ring_attention import ring_attention
+
+    rng = np.random.default_rng(21)
+    B, T, H, Dh = 2, 16, 4, 8
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, T, H, Dh)), jnp.float32)
+        for _ in range(3)
+    )
+    ref = dot_product_attention(q, k, v, causal=True)
+    mesh = build_mesh(MeshSpec(seq=4))
+    for rotate in ("ppermute", "collective"):
+        fn = jax.jit(jax.shard_map(
+            lambda q, k, v, _r=rotate: ring_attention(
+                q, k, v, causal=True, rotate=_r
+            ),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+        ))
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(fn(q, k, v)),
+            rtol=2e-5, atol=2e-5, err_msg=rotate,
+        )
+    with pytest.raises(ValueError, match="rotate mode"):
+        ring_attention(q, k, v, causal=True, rotate="bogus")
 
 
 def test_cli_lm_pp_sp_1f1b(capsys):
@@ -217,23 +245,32 @@ def test_cli_lm_pp_sp_1f1b(capsys):
     ])
     assert rc == 0
     assert "perplexity" in capsys.readouterr().out
-    # ring + 1f1b is rejected (wrong values inside the switch branches).
+    # ring + 1f1b trains too (the in-schedule ring uses the
+    # branch-safe group-local rotation).
     rc = main([
         "--platform", "cpu", "lm", "--steps", "1", "--batch-size", "4",
         "--seq-len", "15", "--d-model", "16", "--heads", "2",
         "--layers", "2", "--stages", "2", "--seq-parallel", "2",
         "--schedule", "1f1b", "--microbatches", "2",
     ])
-    assert rc != 0
+    assert rc == 0
+    assert "perplexity" in capsys.readouterr().out
 
 
-@pytest.mark.parametrize("variant", ["interleaved", "zb"])
-def test_pp_sp_interleaved_and_zb_grads_match_single_chip(variant):
-    # The table-driven executors x SP (Ulysses): interleaved virtual
-    # stages and the zero-bubble split backward both play back with
-    # all_to_all attention in the chunk bodies — grads must equal
-    # single-chip AD of the masked CE, completing the schedule x SP row
-    # of the composition matrix.
+@pytest.mark.parametrize("variant,mode", [
+    ("interleaved", "ulysses"),
+    ("zb", "ulysses"),
+    ("interleaved", "ring"),
+    ("zb", "ring"),
+])
+def test_pp_sp_interleaved_and_zb_grads_match_single_chip(variant, mode):
+    # The table-driven executors x SP: interleaved virtual stages and
+    # the zero-bubble split backward both play back with
+    # sequence-parallel attention in the chunk bodies — grads must
+    # equal single-chip AD of the masked CE, completing the
+    # schedule x SP row of the composition matrix. The ring rows use
+    # the branch-safe group-local rotation (the table executor has the
+    # same lax.switch structure ppermute misbehaves in).
     from tpu_dist_nn.parallel.transformer_pipeline import (
         make_pipeline_sp_lm_interleaved_grad,
         make_pipeline_sp_lm_zb_grad,
@@ -250,7 +287,7 @@ def test_pp_sp_interleaved_and_zb_grads_match_single_chip(variant):
         make_pipeline_sp_lm_interleaved_grad
         if variant == "interleaved" else make_pipeline_sp_lm_zb_grad
     )
-    vag = make(mesh, CFG, num_virtual=v, num_microbatches=2)
+    vag = make(mesh, CFG, num_virtual=v, num_microbatches=2, mode=mode)
     params_v = dict(
         params, blocks=shard_blocks_interleaved(params["blocks"], S, v)
     )
@@ -270,11 +307,3 @@ def test_pp_sp_interleaved_and_zb_grads_match_single_chip(variant):
         )
 
 
-def test_pp_sp_interleaved_rejects_ring():
-    from tpu_dist_nn.parallel.transformer_pipeline import (
-        make_pipeline_sp_lm_interleaved_grad,
-    )
-
-    mesh = build_mesh(MeshSpec(stage=2, seq=2, data=2))
-    with pytest.raises(ValueError, match="ulysses"):
-        make_pipeline_sp_lm_interleaved_grad(mesh, CFG, 2, 2, mode="ring")

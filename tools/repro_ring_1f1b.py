@@ -47,9 +47,12 @@ demonstrated by this script:
 
 Consequences in the framework: the scheduled executors' own stage
 wires ride unconditional ppermutes OUTSIDE the switch (by design);
-scheduled x SP factories accept Ulysses and reject ring
-(`_reject_ring_in_schedule`); a future ring variant should hoist the
-K/V rotation into the unconditional tick section. Run:
+the in-schedule ring swaps the ppermute rotation for the GROUP-LOCAL
+reduce-scatter rotation
+(`ring_attention._rotate_one_hop_group_local` — its rendezvous covers
+only the seq peers, all in the same branch at the same tick), which
+this script demonstrates is exact in the identical position where
+ppermute mis-pairs. Run:
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     JAX_PLATFORMS=cpu python tools/repro_ring_1f1b.py
@@ -173,6 +176,12 @@ def main() -> int:
     un1 = probe(1, ring_unrolled, "seq=1 UNROLLED  (0 ppermutes: exact)")
     probe(2, ring_unrolled, "seq=2 UNROLLED  (ppermutes: still wrong)")
     uly = probe(2, _sp_attn_fn("ulysses"), "seq=2 ulysses   (exact)")
+    # THE FIX: the same ring with the group-local reduce-scatter
+    # rotation — exact in the exact position ppermute mis-pairs in.
+    safe = probe(
+        2, _sp_attn_fn("ring", in_schedule=True),
+        "seq=2 ring/GROUP-LOCAL rotation (exact — the fix)",
+    )
     # Tolerance, not exact equality: reduction order varies with
     # backend/thread configuration at float32.
     assert np.allclose(uly, ok, rtol=1e-4), (
@@ -180,6 +189,9 @@ def main() -> int:
     )
     assert np.allclose(un1, ok, rtol=1e-4), (
         "unrolled N=1 (zero ppermutes) should be exact"
+    )
+    assert np.allclose(safe, ok, rtol=1e-4), (
+        "group-local-rotation ring should be exact in-schedule"
     )
     return 0
 

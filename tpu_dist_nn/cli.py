@@ -746,16 +746,6 @@ def cmd_lm(args) -> int:
                         "--stages with --seq-parallel supports --schedule "
                         "gpipe or 1f1b"
                     )
-                if args.schedule == "1f1b" and args.sp_mode != "ulysses":
-                    # Eager (before corpus/params/checkpoint work): the
-                    # factory rejects ring inside the schedule anyway,
-                    # but only at step-build time.
-                    raise ValueError(
-                        "--schedule 1f1b with --seq-parallel supports "
-                        "--sp-mode ulysses only (the ring computes wrong "
-                        "values inside the schedule's switch branches; "
-                        "use --schedule gpipe for the ring)"
-                    )
                 schedule_handled = True  # pp x sp consumes --schedule itself
                 _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
                 _sched = args.schedule

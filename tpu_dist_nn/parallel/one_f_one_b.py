@@ -137,12 +137,15 @@ def make_1f1b(
     reproducer in ``tools/repro_ring_1f1b.py``: "Expected 4 threads to
     join the rendezvous, but only 2 arrived") or, in larger programs,
     silently mis-pairs with a later execution and computes wrong
-    values. That is why ring attention's K/V rotation is rejected
-    inside the scheduled executors while Ulysses is exact, and why this
-    executor's own stage wires ride ONE UNCONDITIONAL ppermute pair
-    per tick outside the ``lax.switch``. Also still banned:
-    collectives over ``stage`` or ``data`` inside the bodies (the
-    predicate varies over ``stage``, and the executor owns the
+    values. That is why ring attention inside the scheduled executors
+    replaces its ppermute K/V rotation with a group-local
+    reduce-scatter rotation
+    (``ring_attention._rotate_one_hop_group_local`` — exact,
+    branch-safe, ~N× the hop bandwidth) while Ulysses needs no change,
+    and why this executor's own stage wires ride ONE UNCONDITIONAL
+    ppermute pair per tick outside the ``lax.switch``. Also still
+    banned: collectives over ``stage`` or ``data`` inside the bodies
+    (the predicate varies over ``stage``, and the executor owns the
     ``data``-axis reduction itself, once, after the scan).
     """
     S, M = num_stages, num_microbatches

@@ -43,14 +43,67 @@ from tpu_dist_nn.models.transformer import (
 from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_SEQ
 
 
-def ring_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ):
+def _rotate_one_hop_group_local(blk, axis_name: str):
+    """Rotate ``blk`` one hop around the ring (device ``i`` → ``i+1``)
+    using only a GROUP-LOCAL collective — safe inside ``lax.switch``
+    branches, unlike ``lax.ppermute``.
+
+    Root cause this exists for (``tools/repro_ring_1f1b.py``):
+    ``ppermute`` lowers to collective-permute, whose rendezvous spans
+    EVERY partition in the program, so issuing it inside a branch not
+    taken by every device deadlocks or silently mis-pairs.
+    ``psum_scatter``'s rendezvous covers only its replica group (the
+    ``seq`` peers), and the scheduled executors' tick predicate is
+    seq-invariant, so every participant reaches the instruction — the
+    same argument that makes Megatron-TP psums branch-safe
+    (one_f_one_b.py's disjoint-axis rule, group-local refinement).
+
+    Mechanics: each device contributes an ``(N, ...)`` buffer whose only
+    non-zero slot ``(i+1) % N`` carries its block; the reduce-scatter
+    sums slot ``j`` across devices and hands it to device ``j``, which
+    therefore receives exactly block ``j-1``. Cost vs the ppermute
+    ring's one-block hop: ~``N`` block-sends per device AND an
+    ``(N, block)`` send temporary — i.e. O(T) transient bytes per hop,
+    giving back ring attention's O(T/N) *peak* memory during the
+    collective itself (accumulators and residents stay O(T/N)). That
+    is the price of branch safety; prefer the ppermute rotation
+    anywhere outside a schedule branch, and prefer Ulysses in-schedule
+    when heads allow (its all_to_alls move O(T/N·H) with no N× blowup).
+    Callers rotating multiple same-shaped blocks per hop should stack
+    them into one call (see :func:`ring_attention`'s K/V stacking) so
+    each tick issues one collective, not two. AD is clean (transpose
+    of reduce-scatter is all-gather, also group-local).
+    """
+    N = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    sel = (jnp.arange(N) == (idx + 1) % N).astype(blk.dtype)
+    send = sel.reshape((N,) + (1,) * blk.ndim) * blk[None]
+    out = lax.psum_scatter(send, axis_name, scatter_dimension=0, tiled=True)
+    return out.reshape(blk.shape)
+
+
+ROTATE_MODES = ("ppermute", "collective")
+
+
+def ring_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ,
+                   rotate: str = "ppermute"):
     """Blockwise ring attention for use under ``shard_map``.
 
     ``q, k, v: (B, T_local, H, Dh)`` — this device's sequence block.
     Returns ``(B, T_local, H, Dh)``, exactly
     ``dot_product_attention`` on the gathered sequence, computed
     without ever gathering it.
+
+    ``rotate`` picks the K/V hand-off: ``"ppermute"`` (default — one
+    block per hop over ICI, use anywhere the ring runs unconditionally)
+    or ``"collective"`` (:func:`_rotate_one_hop_group_local` — the
+    branch-safe rotation the scheduled executors need; ~N× the hop
+    bandwidth).
     """
+    if rotate not in ROTATE_MODES:
+        raise ValueError(
+            f"unknown rotate mode {rotate!r}: use {ROTATE_MODES}"
+        )
     out_dtype = q.dtype
     B, Tq, H, Dh = q.shape
     N = lax.psum(1, axis_name)
@@ -90,8 +143,18 @@ def ring_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ):
         acc = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
         )
-        k_blk = lax.ppermute(k_blk, axis_name, ring_perm)
-        v_blk = lax.ppermute(v_blk, axis_name, ring_perm)
+        if rotate == "ppermute":
+            k_blk = lax.ppermute(k_blk, axis_name, ring_perm)
+            v_blk = lax.ppermute(v_blk, axis_name, ring_perm)
+        else:
+            # One collective per tick, not two: rotate K and V as a
+            # single stacked block (halves the reduce-scatter count;
+            # the (N, 2, ...) temporary is the same total bytes as two
+            # separate (N, ...) sends).
+            kv = _rotate_one_hop_group_local(
+                jnp.stack([k_blk, v_blk]), axis_name
+            )
+            k_blk, v_blk = kv[0], kv[1]
         return (k_blk, v_blk, new_m, l, acc), None
 
     (k, v, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(N))
@@ -139,11 +202,23 @@ def ulysses_attention(q, k, v, *, causal: bool, axis_name: str = AXIS_SEQ):
 SP_MODES = ("ring", "ulysses")
 
 
-def _sp_attn_fn(mode: str):
+def _sp_attn_fn(mode: str, *, in_schedule: bool = False):
+    """Resolve an SP mode to its attention function.
+
+    ``in_schedule=True`` (the scheduled executors' stage bodies) swaps
+    the ring's ppermute rotation for the branch-safe group-local one —
+    ppermute's program-wide rendezvous cannot execute inside a
+    ``lax.switch`` branch (tools/repro_ring_1f1b.py). Ulysses is
+    group-local already, so the flag is a no-op for it.
+    """
     if mode not in SP_MODES:
         raise ValueError(f"unknown sequence-parallel mode {mode!r}: use {SP_MODES}")
-    fn = ring_attention if mode == "ring" else ulysses_attention
-    return functools.partial(fn, axis_name=AXIS_SEQ)
+    if mode == "ring":
+        rotate = "collective" if in_schedule else "ppermute"
+        return functools.partial(
+            ring_attention, axis_name=AXIS_SEQ, rotate=rotate
+        )
+    return functools.partial(ulysses_attention, axis_name=AXIS_SEQ)
 
 
 def make_seq_parallel_lm_forward(mesh, cfg: TransformerConfig, mode: str = "ring"):

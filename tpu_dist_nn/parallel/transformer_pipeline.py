@@ -370,34 +370,22 @@ def make_pipeline_sp_lm_forward(mesh, cfg: TransformerConfig,
     return fn
 
 
-def _reject_ring_in_schedule(mode: str, what: str):
-    """The ring's K/V rotation cannot run inside the scheduled
-    executors' ``lax.switch`` branches — root cause (minimal
-    reproducer + rendezvous proof: ``tools/repro_ring_1f1b.py``):
-    ``lax.ppermute`` lowers to collective-permute, whose rendezvous
-    requires EVERY partition to execute the instruction, and devices
-    in a different branch never reach it — the op deadlocks or
-    silently mis-pairs with a later execution (wrong values).
-    ``psum``/``all_to_all`` participate per replica group, which is why
-    Megatron TP and Ulysses are exact in the same position. Every
-    hand-scheduled x SP factory funnels through this rejection."""
-    if mode != "ulysses":
-        raise ValueError(
-            f"{what} supports mode='ulysses' only: the ring's ppermute "
-            "lowers to a globally-participating collective-permute, "
-            "which cannot execute inside a branch not taken by every "
-            "device (tools/repro_ring_1f1b.py); use --sp-mode ulysses, "
-            "or schedule='gpipe' for the ring"
-        )
-
-
 def _sp_sched_stage_fn(cfg: TransformerConfig, mode: str):
     """One chunk/stage body for every scheduled x SP factory (the SP
     row's `_lm_sched_stage_and_tail` analogue — one definition so the
-    1F1B, interleaved, and zb SP paths cannot drift numerically)."""
+    1F1B, interleaved, and zb SP paths cannot drift numerically).
+
+    ``in_schedule=True``: these bodies execute inside the executors'
+    ``lax.switch`` branches, so the ring swaps its ppermute K/V
+    rotation (program-wide rendezvous — deadlocks or silently
+    mis-pairs in a branch; root cause + reproducer:
+    ``tools/repro_ring_1f1b.py``) for the group-local reduce-scatter
+    rotation (``ring_attention._rotate_one_hop_group_local``), which
+    rendezvouses only its seq peers — all in the same branch at the
+    same tick, since the tick predicate is seq-invariant."""
     from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
 
-    attn_fn = _sp_attn_fn(mode)
+    attn_fn = _sp_attn_fn(mode, in_schedule=True)
     apply = maybe_remat(cfg)
 
     def stage_fn(stage_blocks, _static, x):
@@ -471,26 +459,26 @@ def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
     executor reduces stage grads over ``seq`` like ``data`` (each seq
     shard saw different positions of the same microbatch).
 
-    **Ulysses only — root cause identified.** The ring decomposition's
-    K/V rotation uses ``lax.ppermute``, which lowers to
-    collective-permute: an op whose rendezvous requires EVERY partition
-    in the program to execute the instruction. Inside a ``lax.switch``
-    branch only the scheduled stage's devices reach it, so the op
-    deadlocks (the minimal reproducer aborts with "Expected 4 threads
-    to join the rendezvous, but only 2 arrived") or, in the full
-    schedule, silently mis-pairs with a later execution — observed as
-    zeros reaching the tail for later microbatches at seq=1 and wrong
-    attention outputs at seq>1. ``psum``/``all_to_all`` participate
-    per replica group, which is why Megatron TP and Ulysses are exact
-    in the identical position, and why this executor's own stage wires
-    ride unconditional ppermutes outside the switch. This factory
-    therefore accepts ``mode="ulysses"`` and rejects ``"ring"`` with a
-    pointer at the gpipe pp x sp path (AD through an unconditional
-    scan — ring is exact there). Fix direction for a ring variant:
-    hoist the K/V rotation out of the branches into the unconditional
-    tick section, like the stage wires. Standalone reproducer with the
-    failure modes, exact controls, and the rendezvous proof:
-    ``tools/repro_ring_1f1b.py``.
+    **Both SP modes are supported — the ring needed a rendezvous-safe
+    rotation.** The ring's natural K/V hand-off, ``lax.ppermute``,
+    lowers to collective-permute: an op whose rendezvous requires EVERY
+    partition in the program to execute the instruction. Inside a
+    ``lax.switch`` branch only the scheduled stage's devices reach it,
+    so the op deadlocks (the minimal reproducer aborts with "Expected 4
+    threads to join the rendezvous, but only 2 arrived") or, in the
+    full schedule, silently mis-pairs with a later execution — observed
+    as zeros reaching the tail for later microbatches at seq=1 and
+    wrong attention outputs at seq>1. ``psum``/``all_to_all``/
+    ``psum_scatter`` participate per replica group, which is why
+    Megatron TP and Ulysses are exact in the identical position, and
+    why this executor's own stage wires ride unconditional ppermutes
+    outside the switch. In-schedule the ring therefore rotates K/V with
+    a group-local reduce-scatter
+    (``ring_attention._rotate_one_hop_group_local``) instead — exact,
+    branch-safe, at ~N× the hop bandwidth; the gpipe pp x sp path keeps
+    the cheaper ppermute rotation (its executor has no branches).
+    Standalone reproducer with the failure modes, exact controls, and
+    the rendezvous proof: ``tools/repro_ring_1f1b.py``.
 
     The tail runs INSIDE the schedule per (microbatch, seq shard), so
     the position-0-masked CE convention is carried by PRE-SHIFTED
@@ -503,7 +491,6 @@ def make_pipeline_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
     from tpu_dist_nn.parallel.mesh import AXIS_SEQ
     from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
 
-    _reject_ring_in_schedule(mode, "1F1B x sequence parallelism")
     seq_devices = mesh.shape[AXIS_SEQ]
     M = num_microbatches
     mapped = make_1f1b(
@@ -520,19 +507,17 @@ def make_pipeline_sp_lm_interleaved_grad(mesh, cfg: TransformerConfig,
                                          num_microbatches: int,
                                          mode: str = "ulysses",
                                          tables=None):
-    """Interleaved (virtual-stage) 1F1B x sequence parallelism —
-    Ulysses only, same scheduled-tail convention and rejection as
-    :func:`make_pipeline_sp_lm_1f1b_grad` (the table executor has the
-    same ``lax.switch`` structure the ring misbehaves in). Blocks in
+    """Interleaved (virtual-stage) 1F1B x sequence parallelism — ring
+    or Ulysses, same scheduled-tail convention and in-schedule ring
+    rotation as :func:`make_pipeline_sp_lm_1f1b_grad` (the table
+    executor has the same ``lax.switch`` structure, so the ring uses
+    the group-local rotation here too). Blocks in
     :func:`shard_blocks_interleaved` layout. Pass ``tables`` from
     :func:`~tpu_dist_nn.parallel.schedule_table.build_zero_bubble` for
     the zero-bubble variant (:func:`make_pipeline_sp_lm_zb_grad`)."""
     from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
     from tpu_dist_nn.parallel.mesh import AXIS_SEQ
 
-    _reject_ring_in_schedule(
-        mode, "interleaved/zb x sequence parallelism"
-    )
     seq_devices = mesh.shape[AXIS_SEQ]
     M = num_microbatches
     mapped = make_interleaved_1f1b(
@@ -549,8 +534,9 @@ def make_pipeline_sp_lm_zb_grad(mesh, cfg: TransformerConfig,
                                 num_virtual: int, num_microbatches: int,
                                 mode: str = "ulysses"):
     """Zero-bubble (ZB-H1) x sequence parallelism: the split-backward
-    tables played back with Ulysses attention in the chunk bodies —
-    same layout and rejection rules as the interleaved variant."""
+    tables played back with ring or Ulysses attention in the chunk
+    bodies — same layout and in-schedule rotation rules as the
+    interleaved variant."""
     from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
     from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
 

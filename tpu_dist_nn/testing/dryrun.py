@@ -311,6 +311,27 @@ def _dryrun_transformer_sp_tp(n_devices: int) -> None:
         )
         jax.block_until_ready(g)
 
+        # Ring INSIDE the 1F1B schedule (round 4 fix): the group-local
+        # reduce-scatter K/V rotation executing within lax.switch
+        # branches — the riskiest-collective representative of the
+        # scheduled x SP row (ppermute here deadlocks/mis-pairs;
+        # tools/repro_ring_1f1b.py).
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            make_pipeline_sp_lm_1f1b_grad,
+        )
+
+        vag = make_pipeline_sp_lm_1f1b_grad(
+            mesh_pp_sp, cfg, 2, 2, mode="ring"
+        )
+        loss, g = jax.jit(vag)(
+            params_pp, jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (2 * (n_devices // 4), 16)),
+                jnp.int32,
+            )
+        )
+        jax.block_until_ready(g)
+        assert float(loss) > 0
+
         # SP x ZeRO-1 (round 4): sharded moments over the data axis of
         # the (seq, data) mesh, ring loss over seq.
         import optax

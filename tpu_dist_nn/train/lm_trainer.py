@@ -200,8 +200,8 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
     ``schedule="gpipe"`` (default): AD through the forward schedule,
     ring or Ulysses attention. ``schedule="1f1b"``: the memory-flat
     hand-rolled schedule — O(stages) live activations, the combination
-    long context needs most — Ulysses only (the ring computes wrong
-    values inside the schedule's switch branches; see
+    long context needs most — ring or Ulysses; in-schedule the ring
+    rotates K/V with the branch-safe group-local collective (see
     transformer_pipeline.make_pipeline_sp_lm_1f1b_grad)."""
     from tpu_dist_nn.parallel.transformer_pipeline import (
         make_pipeline_sp_lm_1f1b_grad,
