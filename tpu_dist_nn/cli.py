@@ -717,7 +717,9 @@ def cmd_lm(args) -> int:
                 # masks position 0 instead of slicing).
                 from tpu_dist_nn.parallel.transformer_pipeline import (
                     shard_blocks,
+                    shard_blocks_interleaved,
                     unshard_blocks,
+                    unshard_blocks_interleaved,
                 )
                 from tpu_dist_nn.train.lm_trainer import (
                     make_pipeline_sp_lm_train_step,
@@ -741,24 +743,40 @@ def cmd_lm(args) -> int:
                 ))
                 global_mesh, global_span = pp_sp_mesh, args.data_parallel
                 global_axes = "_data_"
-                if args.schedule not in ("gpipe", "1f1b"):
-                    raise ValueError(
-                        "--stages with --seq-parallel supports --schedule "
-                        "gpipe or 1f1b"
-                    )
                 schedule_handled = True  # pp x sp consumes --schedule itself
                 _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
                 _sched = args.schedule
-                step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
-                    pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
-                    schedule=_sched,
-                )
-                shard_fn = lambda p: dict(  # noqa: E731
-                    p, blocks=shard_blocks(p["blocks"], _stages)
-                )
-                unshard_fn = lambda p: dict(  # noqa: E731
-                    p, blocks=unshard_blocks(p["blocks"])
-                )
+                if _sched in ("interleaved", "zb"):
+                    # Table executors x SP: virtual-stage chunk layout
+                    # (same --virtual-stages defaulting as the dense
+                    # pipelined path below).
+                    _v = getattr(args, "virtual_stages", None)
+                    if _v is None:
+                        _v = 2 if _sched == "interleaved" else 1
+                    step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
+                        pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
+                        schedule=_sched, num_virtual=_v,
+                    )
+                    shard_fn = lambda p: dict(  # noqa: E731
+                        p,
+                        blocks=shard_blocks_interleaved(
+                            p["blocks"], _stages, _v
+                        ),
+                    )
+                    unshard_fn = lambda p: dict(  # noqa: E731
+                        p, blocks=unshard_blocks_interleaved(p["blocks"])
+                    )
+                else:
+                    step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
+                        pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
+                        schedule=_sched,
+                    )
+                    shard_fn = lambda p: dict(  # noqa: E731
+                        p, blocks=shard_blocks(p["blocks"], _stages)
+                    )
+                    unshard_fn = lambda p: dict(  # noqa: E731
+                        p, blocks=unshard_blocks(p["blocks"])
+                    )
             else:
                 mesh = build_mesh(
                     MeshSpec(stage=args.stages, data=args.data_parallel)

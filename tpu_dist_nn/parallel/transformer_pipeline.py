@@ -863,3 +863,116 @@ def make_pipeline_tp_lm_zb_grad(mesh, cfg: TransformerConfig,
     return make_pipeline_tp_lm_interleaved_grad(
         mesh, cfg, num_virtual, num_microbatches, attn_fn, tables=tables
     )
+
+
+def make_pipeline_tp_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
+                                     num_stages: int, num_microbatches: int,
+                                     mode: str = "ring"):
+    """-> ``f(params, tokens) -> (loss, grads)``: 1F1B x Megatron TP x
+    sequence parallelism — the full Megatron-LM long-context deployment
+    shape (PP for depth, TP for width, SP for length, DP for batch) in
+    ONE hand-rolled schedule.
+
+    The composition is the conjunction of two already-proven arguments,
+    and they compose because they touch disjoint axes:
+
+    * TP psums over ``model`` are branch-safe because the tick
+      predicate is ``model``-invariant
+      (:func:`make_pipeline_tp_lm_1f1b_grad`).
+    * SP attention over ``seq`` is branch-safe for Ulysses
+      (group-local ``all_to_all``) and for the ring via the
+      group-local reduce-scatter rotation
+      (:func:`make_pipeline_sp_lm_1f1b_grad`).
+
+    Inside a block the two shardings are orthogonal: QKV projections
+    are position-local (seq-sharded x in, seq-sharded local heads out),
+    the SP attention runs over ``seq`` on the ``model`` shard's local
+    heads (ring works for any head count; Ulysses needs
+    ``(n_heads / model) % seq == 0``, raised at trace time), and the
+    out/MLP psums over ``model`` act position-wise on seq-sharded
+    rows. Executor mechanics: microbatches vary over ``(data, seq)``
+    (stage grads reduce over both), blocks keep the pp x tp per-leaf
+    specs, and the masked-CE tail runs per (microbatch, seq shard)
+    with pre-shifted targets exactly like the SP factory.
+
+    ``params["blocks"]`` must be in :func:`shard_blocks_pp_tp` layout;
+    tokens are FULL (input+target) rows (the sp masking convention).
+    """
+    from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    seq_devices = mesh.shape[AXIS_SEQ]
+    attn_fn = _sp_attn_fn(mode, in_schedule=True)
+    tp_stage_fn, blocks_spec = _tp_stage_fn_and_spec(mesh, cfg, attn_fn)
+
+    def stage_fn(stage_blocks, _static, x):
+        return tp_stage_fn(stage_blocks, x)
+
+    mapped = make_1f1b(
+        mesh, stage_fn, _sp_masked_tail_fn(), num_stages, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+        stage_params_spec=blocks_spec,
+        aux_spec=P(None, AXIS_DATA, AXIS_SEQ),
+    )
+    return _lm_vag_from_mapped(
+        mapped, cfg, num_microbatches, prep=_sp_prep(cfg, seq_devices)
+    )
+
+
+def make_pipeline_tp_sp_lm_interleaved_grad(mesh, cfg: TransformerConfig,
+                                            num_virtual: int,
+                                            num_microbatches: int,
+                                            mode: str = "ring",
+                                            tables=None):
+    """Interleaved (virtual-stage) 1F1B x Megatron TP x sequence
+    parallelism: the table executor playing 4D-parallel chunk bodies —
+    same disjoint-axis conjunction as
+    :func:`make_pipeline_tp_sp_lm_1f1b_grad`, same chunk layout as
+    :func:`make_pipeline_tp_lm_interleaved_grad`
+    (:func:`shard_blocks_interleaved_tp`). Pass ``tables`` from
+    ``build_zero_bubble`` for the ZB variant."""
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_MODEL, AXIS_SEQ
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+    from tpu_dist_nn.parallel.tensor_parallel import BLOCK_KEYS, TP_REPLICATED
+
+    seq_devices = mesh.shape[AXIS_SEQ]
+    attn_fn = _sp_attn_fn(mode, in_schedule=True)
+    tp_stage_fn, _ = _tp_stage_fn_and_spec(mesh, cfg, attn_fn)
+
+    def stage_fn(chunk_blocks, _static, x):
+        return tp_stage_fn(chunk_blocks, x)
+
+    blocks_spec = {
+        k: (
+            P(AXIS_STAGE)
+            if k in TP_REPLICATED
+            else P(AXIS_STAGE, None, AXIS_MODEL)
+        )
+        for k in BLOCK_KEYS
+    }
+    mapped = make_interleaved_1f1b(
+        mesh, stage_fn, _sp_masked_tail_fn(), num_virtual, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+        chunk_params_spec=blocks_spec,
+        aux_spec=P(None, AXIS_DATA, AXIS_SEQ),
+        tables=tables,
+    )
+    return _lm_vag_from_mapped(
+        mapped, cfg, num_microbatches, prep=_sp_prep(cfg, seq_devices)
+    )
+
+
+def make_pipeline_tp_sp_lm_zb_grad(mesh, cfg: TransformerConfig,
+                                   num_virtual: int, num_microbatches: int,
+                                   mode: str = "ring"):
+    """ZB-H1 x Megatron TP x sequence parallelism: the split-backward
+    zero-bubble tables played back with 4D-parallel chunk bodies."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
+
+    tables = build_zero_bubble(mesh.shape[_AS], num_virtual, num_microbatches)
+    return make_pipeline_tp_sp_lm_interleaved_grad(
+        mesh, cfg, num_virtual, num_microbatches, mode, tables=tables
+    )

@@ -332,6 +332,30 @@ def _dryrun_transformer_sp_tp(n_devices: int) -> None:
         jax.block_until_ready(g)
         assert float(loss) > 0
 
+    if n_devices % 8 == 0:
+        # PP x TP x SP (round 4): the full Megatron long-context shape
+        # in one 1F1B schedule — TP psums AND the SP ring's group-local
+        # rotation inside the same switch branches.
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            make_pipeline_tp_sp_lm_1f1b_grad,
+            shard_blocks_pp_tp,
+        )
+
+        mesh_3d = build_mesh(MeshSpec(stage=2, model=2, seq=2,
+                                      data=n_devices // 8))
+        params_3d = dict(
+            params, blocks=shard_blocks_pp_tp(params["blocks"], cfg, 2, 2)
+        )
+        vag3 = make_pipeline_tp_sp_lm_1f1b_grad(mesh_3d, cfg, 2, 2, mode="ring")
+        loss, g = jax.jit(vag3)(
+            params_3d, jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (2 * (n_devices // 8), 16)),
+                jnp.int32,
+            )
+        )
+        jax.block_until_ready(g)
+        assert float(loss) > 0
+
         # SP x ZeRO-1 (round 4): sharded moments over the data axis of
         # the (seq, data) mesh, ring loss over seq.
         import optax

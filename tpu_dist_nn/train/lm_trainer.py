@@ -189,38 +189,65 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
 def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
                                    num_stages: int, num_microbatches: int,
                                    optimizer, mode: str = "ring",
-                                   schedule: str = "gpipe"):
+                                   schedule: str = "gpipe",
+                                   num_virtual: int = 1,
+                                   tensor_parallel: int = 1):
     """Pipeline x sequence-parallel train step: blocks pipelined over
     ``stage``, each microbatch's sequence dim sharded over ``seq``,
-    batch over ``data``. Blocks in
-    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`
-    layout; tokens are full (input+target) rows (the sp loss masks
-    position 0 — ring_attention.py).
+    batch over ``data``. Tokens are full (input+target) rows (the sp
+    loss masks position 0 — ring_attention.py).
 
     ``schedule="gpipe"`` (default): AD through the forward schedule,
-    ring or Ulysses attention. ``schedule="1f1b"``: the memory-flat
-    hand-rolled schedule — O(stages) live activations, the combination
-    long context needs most — ring or Ulysses; in-schedule the ring
-    rotates K/V with the branch-safe group-local collective (see
-    transformer_pipeline.make_pipeline_sp_lm_1f1b_grad)."""
-    from tpu_dist_nn.parallel.transformer_pipeline import (
-        make_pipeline_sp_lm_1f1b_grad,
-        make_pipeline_sp_lm_loss,
-    )
+    ring or Ulysses attention; blocks in ``shard_blocks`` layout.
+    ``schedule="1f1b"``: the memory-flat hand-rolled schedule —
+    O(stages) live activations, the combination long context needs
+    most — ring or Ulysses; in-schedule the ring rotates K/V with the
+    branch-safe group-local collective (see
+    transformer_pipeline.make_pipeline_sp_lm_1f1b_grad).
+    ``schedule="interleaved"/"zb"``: the table executors with
+    ``num_virtual`` chunks per device (``shard_blocks_interleaved``
+    layout; ``_tp`` variants with TP).
 
-    if schedule == "1f1b":
-        vag = make_pipeline_sp_lm_1f1b_grad(
-            mesh, cfg, num_stages, num_microbatches, mode
-        )
-        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
-    if schedule != "gpipe":
+    ``tensor_parallel > 1`` additionally Megatron-shards each stage's
+    blocks over the mesh's ``model`` axis — PP x TP x SP (x DP), the
+    full Megatron-LM long-context deployment shape in one schedule
+    (transformer_pipeline.make_pipeline_tp_sp_lm_1f1b_grad; hand
+    schedules only — gpipe x TP x SP is not wired)."""
+    from tpu_dist_nn.parallel import transformer_pipeline as tpl
+    from tpu_dist_nn.parallel.mesh import AXIS_MODEL
+    from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
+
+    validate_schedule(schedule)
+    if tensor_parallel > 1 and mesh.shape.get(AXIS_MODEL, 1) != tensor_parallel:
         raise ValueError(
-            f"pipeline x sequence parallelism supports schedule='gpipe' "
-            f"or '1f1b', not {schedule!r}"
+            f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
+            f"axis has size {mesh.shape.get(AXIS_MODEL, 1)}"
+        )
+    if schedule in ("interleaved", "zb"):
+        make = {
+            ("interleaved", False): tpl.make_pipeline_sp_lm_interleaved_grad,
+            ("interleaved", True): tpl.make_pipeline_tp_sp_lm_interleaved_grad,
+            ("zb", False): tpl.make_pipeline_sp_lm_zb_grad,
+            ("zb", True): tpl.make_pipeline_tp_sp_lm_zb_grad,
+        }[(schedule, tensor_parallel > 1)]
+        vag = make(mesh, cfg, num_virtual, num_microbatches, mode)
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+    if schedule == "1f1b":
+        make = (
+            tpl.make_pipeline_tp_sp_lm_1f1b_grad
+            if tensor_parallel > 1 else tpl.make_pipeline_sp_lm_1f1b_grad
+        )
+        vag = make(mesh, cfg, num_stages, num_microbatches, mode)
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+    if tensor_parallel > 1:
+        raise ValueError(
+            "pp x tp x sp is wired for the hand schedules only: use "
+            "schedule='1f1b', 'interleaved', or 'zb' (gpipe composes "
+            "pairwise with each axis but has no 3-way factory)"
         )
     return jax.jit(
         make_step_body(
-            make_pipeline_sp_lm_loss(
+            tpl.make_pipeline_sp_lm_loss(
                 mesh, cfg, num_stages, num_microbatches, mode
             ),
             optimizer,
