@@ -124,12 +124,37 @@ def test_pp_tp_sp_train_step_updates():
         np.asarray(new_params["blocks"]["w_qkv"]),
         np.asarray(params_v["blocks"]["w_qkv"]),
     )
-    # TP on the gpipe schedule has no 3-way factory — explicit rejection
-    # beats silently dropping an axis.
-    with pytest.raises(ValueError, match="hand schedules"):
-        make_pipeline_sp_lm_train_step(
-            mesh, CFG, 2, 2, optimizer, schedule="gpipe", tensor_parallel=2
-        )
+    # gpipe 3-way (AD through the forward schedule) trains too.
+    step_g = make_pipeline_sp_lm_train_step(
+        mesh, CFG, 2, 2, optimizer, mode="ring", schedule="gpipe",
+        tensor_parallel=2,
+    )
+    new_params_g, _, loss_g = step_g(
+        params_v, optimizer.init(params_v), tokens
+    )
+    assert np.isfinite(float(loss_g)) and float(loss_g) > 0
+
+
+def test_pp_tp_sp_gpipe_loss_matches_single_chip():
+    # The gpipe member of the 3-way family shares the masked-CE oracle:
+    # loss and grads through AD must match single-chip AD.
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        make_pipeline_tp_sp_lm_loss,
+    )
+
+    mesh = build_mesh(MeshSpec(stage=2, model=2, seq=2))
+    params = init_transformer(jax.random.key(29), CFG)
+    tokens = _tokens(batch=4, seq=16, seed=30)
+
+    loss_fn = make_pipeline_tp_sp_lm_loss(
+        mesh, CFG, num_stages=2, num_microbatches=2, mode="ring"
+    )
+    params_v = dict(
+        params, blocks=shard_blocks_pp_tp(params["blocks"], CFG, 2, 2)
+    )
+    loss_v, g_v = jax.jit(jax.value_and_grad(loss_fn))(params_v, tokens)
+    g_blocks = unshard_blocks_pp_tp(g_v["blocks"], CFG)
+    _check(loss_v, g_v, g_blocks, params, tokens)
 
 
 def test_cli_lm_pp_sp_zb(capsys):

@@ -865,6 +865,69 @@ def make_pipeline_tp_lm_zb_grad(mesh, cfg: TransformerConfig,
     )
 
 
+def make_pipeline_tp_sp_lm_forward(mesh, cfg: TransformerConfig,
+                                   num_stages: int, num_microbatches: int,
+                                   mode: str = "ring"):
+    """-> ``fn(params, tokens) -> logits``: GPipe x Megatron TP x
+    sequence parallelism — the forward-schedule member of the 3-way
+    family (AD provides the backward; the hand-scheduled members are
+    :func:`make_pipeline_tp_sp_lm_1f1b_grad` and friends). The GPipe
+    executor has no branches, so the ring keeps its cheap ppermute
+    rotation here. ``params["blocks"]`` in :func:`shard_blocks_pp_tp`
+    layout; tokens FULL (input+target) rows."""
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    seq_devices = mesh.shape[AXIS_SEQ]
+    stage_fn, blocks_spec = _tp_stage_fn_and_spec(
+        mesh, cfg, _sp_attn_fn(mode)
+    )
+    gpipe = make_gpipe(
+        mesh, stage_fn, num_stages, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, AXIS_SEQ, None),
+        stage_params_spec=blocks_spec,
+    )
+
+    def fn(params, tokens):
+        params = cfg.cast_params(params)
+        B, T = tokens.shape
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by microbatches {M}")
+        if T % seq_devices:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis "
+                f"{seq_devices} (sp feeds full input+target rows)"
+            )
+        if T > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        x = embed(params, tokens)
+        xs = x.reshape(M, B // M, T, cfg.d_model)
+        ys = gpipe(xs, params["blocks"])
+        return unembed(params, ys.reshape(B, T, cfg.d_model))
+
+    return fn
+
+
+def make_pipeline_tp_sp_lm_loss(mesh, cfg: TransformerConfig,
+                                num_stages: int, num_microbatches: int,
+                                mode: str = "ring"):
+    """Masked next-token CE through the GPipe x TP x SP forward — the
+    sp masking convention, so all 3-way members share one oracle."""
+    from tpu_dist_nn.models.transformer import masked_next_token_ce
+
+    fwd = make_pipeline_tp_sp_lm_forward(
+        mesh, cfg, num_stages, num_microbatches, mode
+    )
+
+    def loss_fn(params, tokens):
+        return masked_next_token_ce(fwd(params, tokens), tokens)
+
+    return loss_fn
+
+
 def make_pipeline_tp_sp_lm_1f1b_grad(mesh, cfg: TransformerConfig,
                                      num_stages: int, num_microbatches: int,
                                      mode: str = "ring"):

@@ -210,9 +210,9 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
 
     ``tensor_parallel > 1`` additionally Megatron-shards each stage's
     blocks over the mesh's ``model`` axis — PP x TP x SP (x DP), the
-    full Megatron-LM long-context deployment shape in one schedule
-    (transformer_pipeline.make_pipeline_tp_sp_lm_1f1b_grad; hand
-    schedules only — gpipe x TP x SP is not wired)."""
+    full Megatron-LM long-context deployment shape, on every schedule
+    (gpipe: AD through make_pipeline_tp_sp_lm_loss; hand schedules:
+    transformer_pipeline.make_pipeline_tp_sp_lm_1f1b_grad etc.)."""
     from tpu_dist_nn.parallel import transformer_pipeline as tpl
     from tpu_dist_nn.parallel.mesh import AXIS_MODEL
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
@@ -239,20 +239,16 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
         )
         vag = make(mesh, cfg, num_stages, num_microbatches, mode)
         return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
-    if tensor_parallel > 1:
-        raise ValueError(
-            "pp x tp x sp is wired for the hand schedules only: use "
-            "schedule='1f1b', 'interleaved', or 'zb' (gpipe composes "
-            "pairwise with each axis but has no 3-way factory)"
+    loss_fn = (
+        tpl.make_pipeline_tp_sp_lm_loss(
+            mesh, cfg, num_stages, num_microbatches, mode
         )
-    return jax.jit(
-        make_step_body(
-            tpl.make_pipeline_sp_lm_loss(
-                mesh, cfg, num_stages, num_microbatches, mode
-            ),
-            optimizer,
+        if tensor_parallel > 1
+        else tpl.make_pipeline_sp_lm_loss(
+            mesh, cfg, num_stages, num_microbatches, mode
         )
     )
+    return jax.jit(make_step_body(loss_fn, optimizer))
 
 
 def make_seq_parallel_lm_train_step(mesh, cfg: TransformerConfig, optimizer,
