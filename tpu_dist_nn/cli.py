@@ -655,9 +655,16 @@ def cmd_lm(args) -> int:
             ))
             global_mesh, global_span = pp_ep_mesh, max(ep, 1) * dp
             global_axes = "_data_expert_"
-            _stages, _mb = args.stages, args.microbatches
+            if args.schedule not in ("gpipe", "1f1b"):
+                raise ValueError(
+                    "--experts with --stages supports --schedule gpipe "
+                    "or 1f1b (the table executors carry no router-aux "
+                    "channel)"
+                )
+            schedule_handled = True  # MoE x pp consumes --schedule itself
+            _stages, _mb, _sched = args.stages, args.microbatches, args.schedule
             step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
-                pp_ep_mesh, cfg, _stages, _mb, opt
+                pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched
             )
             _ep = max(ep, 1)
             shard_fn = lambda p: dict(  # noqa: E731

@@ -522,3 +522,79 @@ def make_pipeline_ep_lm_loss(mesh, cfg: MoEConfig, num_stages: int,
         return ce + cfg.router_aux_weight * aux
 
     return loss_fn
+
+
+def make_pipeline_ep_lm_1f1b_grad(mesh, cfg: MoEConfig, num_stages: int,
+                                  num_microbatches: int,
+                                  attn_fn=dot_product_attention):
+    """-> ``f(params, tokens) -> (loss, grads)``: 1F1B x expert
+    parallelism — MoE through the MEMORY-FLAT hand-rolled schedule
+    (the gpipe EP path's AD transpose stashes activations
+    M-proportionally; this one stays O(stages), which is what makes
+    large-M MoE pipelines affordable).
+
+    Legality inside the ``lax.switch`` branches is the group-local
+    refinement of the disjoint-axis rule
+    (:func:`~tpu_dist_nn.parallel.one_f_one_b.make_1f1b` docstring):
+    the tick predicate never consults ``expert``, so every expert peer
+    of each MoE layer's ``all_to_all`` takes the same branch at the
+    same tick, and ``all_to_all`` rendezvouses per replica group — the
+    same two-part argument that admits Megatron psums and the
+    sequence-parallel collectives.
+
+    Numerics: identical to the grouped single-chip oracle
+    ``moe_lm_loss(..., n_groups = M * data * expert)`` and to the
+    gpipe EP path (shared stage math); router aux losses use the
+    executor's ``with_aux`` channel with contributions PRE-SCALED by
+    ``router_aux_weight / (S * M * n_shards)``, reproducing the
+    oracle's weighted mean over blocks and groups. ``params["blocks"]``
+    in :func:`shard_blocks_pp_ep` layout; grads come back in it.
+    """
+    from tpu_dist_nn.models.transformer import maybe_remat, unembed
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
+    from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
+    from tpu_dist_nn.parallel.transformer_pipeline import _lm_vag_from_mapped
+
+    n_ep = mesh.shape[AXIS_EXPERT]
+    if cfg.n_experts % n_ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by expert axis {n_ep}"
+        )
+    S, M = num_stages, num_microbatches
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+    ep_ffn = _make_ep_ffn(cfg)
+    aux_scale = cfg.router_aux_weight / (S * M * n_shards)
+
+    def stage_fn(stage_blocks, _static, x):
+        # The executor stripped the stage dim; EP-sharded leaves still
+        # carry their length-1 expert-shard dim.
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in stage_blocks.items()
+        }
+        apply = maybe_remat(cfg, moe_block_apply)
+
+        def body(carry, block):
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
+            return y, aux
+
+        y, auxs = lax.scan(body, x, blocks)
+        return y, jnp.mean(auxs) * aux_scale
+
+    def tail_fn(tail_params, y, targets_f):
+        # Per-(microbatch, shard) CE contribution; shards cover
+        # (data, expert) jointly, so the global token mean divides by
+        # M * n_shards.
+        return next_token_ce(unembed(tail_params, y), targets_f) / (M * n_shards)
+
+    blocks_spec = {
+        k: (P(AXIS_STAGE, AXIS_EXPERT) if k in EP_SHARDED else P(AXIS_STAGE))
+        for k in MOE_BLOCK_KEYS
+    }
+    mapped = make_1f1b(
+        mesh, stage_fn, tail_fn, S, M,
+        microbatch_spec=P((AXIS_DATA, AXIS_EXPERT), None, None),
+        stage_params_spec=blocks_spec,
+        aux_spec=P(None, (AXIS_DATA, AXIS_EXPERT), None),
+        with_aux=True,
+    )
+    return _lm_vag_from_mapped(mapped, cfg, M)

@@ -89,8 +89,20 @@ def make_1f1b(
     stage_static_spec=None,
     aux_spec=None,
     want_dx0: bool = True,
+    with_aux: bool = False,
 ):
     """Generic 1F1B executor over the ``(stage, data)`` mesh axes.
+
+    ``with_aux=True`` changes the stage contract to
+    ``stage_fn(params, static, x) -> (y, aux_contribution)`` (e.g. an
+    MoE stage's router load-balancing loss): the executor adds each
+    backward tick's recomputed ``aux_contribution`` into the returned
+    loss and backpropagates cotangent 1.0 through it, so contributions
+    must arrive PRE-SCALED (fold the aux weight and any
+    1/(stages*microbatches*shards) normalization in before returning —
+    the same pre-scaled convention as ``tail_fn``). The forward tick
+    discards the aux value (the backward recomputes it), and the
+    summed contributions ride the same end-of-scan loss psum.
 
     Model-agnostic counterpart of :func:`tpu_dist_nn.parallel.gpipe.make_gpipe`
     for the backward pass:
@@ -169,23 +181,6 @@ def make_1f1b(
     xs_spec = P(None, *microbatch_spec)
 
     def device_fn(xs, stage_params, stage_static, tail_params, aux):
-        # Strip the length-1 stage-shard axis; mark all differentiated
-        # params varying over `data` (and tail over `stage` too): see
-        # compiled_1f1b_grad's note — otherwise jax.vjp inserts an
-        # implicit psum per backward tick (a collective, which inside
-        # the lax.switch branch would also break SPMD).
-        sp = jax.tree.map(
-            lambda a: lax.pcast(a[0], data_like, to="varying"), stage_params
-        )
-        st = jax.tree.map(lambda a: a[0], stage_static)
-        tp = jax.tree.map(lambda a: lax.pcast(a, vary, to="varying"), tail_params)
-        s_idx = lax.axis_index(AXIS_STAGE)
-        mb_shape = xs.shape[1:]
-        dt = xs.dtype
-
-        def fwd_only(p, x):
-            return stage_fn(p, st, x)
-
         def mark_varying(z, axes):
             # Idempotent "mark varying over `axes`": zeros_like of an
             # already-varying tracer is itself varying, and pcast
@@ -196,6 +191,31 @@ def make_1f1b(
 
         def vcast(z):
             return mark_varying(z, vary)
+
+        # Strip the length-1 stage-shard axis; mark all differentiated
+        # params varying over the microbatch axes (and tail over
+        # `stage` too): see compiled_1f1b_grad's note — otherwise
+        # jax.vjp inserts an implicit psum per backward tick (a
+        # collective, which inside the lax.switch branch would also
+        # break SPMD). Marking must be idempotent: a leaf can already
+        # be VARYING over a microbatch axis when that axis shards the
+        # params too (expert parallelism's (data, expert) batch with
+        # expert-sharded FFN banks) — and such a leaf's grads must NOT
+        # be reduced over that axis at the end (each shard owns its
+        # slice), so remember every leaf's own pre-mark sharding.
+        sp0 = jax.tree.map(lambda a: a[0], stage_params)
+        sp_shard_axes = jax.tree.map(
+            lambda a: getattr(jax.typeof(a), "vma", frozenset()), sp0
+        )
+        sp = jax.tree.map(lambda a: mark_varying(a, data_like), sp0)
+        st = jax.tree.map(lambda a: a[0], stage_static)
+        tp = jax.tree.map(lambda a: mark_varying(a, vary), tail_params)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        mb_shape = xs.shape[1:]
+        dt = xs.dtype
+
+        def fwd_only(p, x):
+            return stage_fn(p, st, x)
 
         def zeros_like_vma(ref):
             # Grad accumulators must carry the PRIMAL leaf's varying
@@ -240,12 +260,18 @@ def make_1f1b(
                 new_stash = lax.dynamic_update_index_in_dim(
                     stash, x_in, f_f % K, 0
                 )
-                y = fwd_only(sp, x_in)
+                out = fwd_only(sp, x_in)
+                # with_aux: the aux value is discarded here — the
+                # backward tick recomputes it (and its gradient).
+                y = out[0] if with_aux else out
                 return y, zeros_wire, new_stash, g_sp, g_tp, dx0, loss_acc
 
             def bwd(_):
                 x_in = lax.dynamic_index_in_dim(stash, f_b % K, 0, keepdims=False)
-                y, svjp = jax.vjp(fwd_only, sp, x_in)
+                if with_aux:
+                    (y, aux_v), svjp = jax.vjp(fwd_only, sp, x_in)
+                else:
+                    y, svjp = jax.vjp(fwd_only, sp, x_in)
                 aux_f = jax.tree.map(
                     lambda a: lax.dynamic_index_in_dim(a, f_b, 0, keepdims=False),
                     aux,
@@ -268,7 +294,13 @@ def make_1f1b(
                 # Only the last stage pays the tail (head/loss) FLOPs.
                 loss_f, dy_tail, d_tp = lax.cond(is_last, tail_live, tail_skip, 0)
                 dy = jnp.where(is_last, dy_tail, bwd_wire)
-                d_sp, dx = svjp(dy)
+                if with_aux:
+                    # Pre-scaled aux contract: cotangent 1.0, value
+                    # summed into the loss.
+                    d_sp, dx = svjp((dy, vcast(jnp.ones((), aux_v.dtype))))
+                    loss_f = loss_f + aux_v.astype(jnp.float32)
+                else:
+                    d_sp, dx = svjp(dy)
                 if want_dx0:
                     new_dx0 = jnp.where(
                         s_idx == 0,
@@ -309,8 +341,18 @@ def make_1f1b(
         )
         # Cross-shard reductions happen ONCE here, not per tick: data
         # shards each saw a slice of the rows; tail grads and loss live
-        # only on the last stage; dx0 only on stage 0.
-        g_sp = jax.tree.map(lambda a: lax.psum(a, data_like)[None], g_sp)
+        # only on the last stage; dx0 only on stage 0. Per leaf, reduce
+        # only over microbatch axes the PRIMAL leaf was replicated on —
+        # a leaf sharded over one of them (EP's expert-sharded banks)
+        # keeps per-shard grads there.
+        g_sp = jax.tree.map(
+            lambda a, sh: (
+                lax.psum(a, axes)[None]
+                if (axes := tuple(ax for ax in data_like if ax not in sh))
+                else a[None]
+            ),
+            g_sp, sp_shard_axes,
+        )
         g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
         if want_dx0:
             dx0 = lax.psum(dx0, AXIS_STAGE)

@@ -166,16 +166,34 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
 
 def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
                                     num_microbatches: int, optimizer,
-                                    attn_fn=None):
+                                    attn_fn=None, schedule: str = "gpipe"):
     """Pipeline x expert-parallel MoE train step: blocks pipelined over
-    ``stage`` (GPipe, AD through the schedule), experts sharded over
-    ``expert`` inside each stage, batch over ``(data, expert)``.
-    Blocks in
+    ``stage``, experts sharded over ``expert`` inside each stage, batch
+    over ``(data, expert)``. Blocks in
     :func:`~tpu_dist_nn.parallel.expert_parallel.shard_blocks_pp_ep`
-    layout."""
-    from tpu_dist_nn.parallel.expert_parallel import make_pipeline_ep_lm_loss
+    layout.
+
+    ``schedule="gpipe"`` (default): AD through the forward schedule.
+    ``schedule="1f1b"``: the memory-flat hand-rolled schedule — router
+    aux losses ride the executor's ``with_aux`` channel
+    (expert_parallel.make_pipeline_ep_lm_1f1b_grad). The table
+    schedules (interleaved/zb) do not carry the aux channel yet."""
+    from tpu_dist_nn.parallel.expert_parallel import (
+        make_pipeline_ep_lm_1f1b_grad,
+        make_pipeline_ep_lm_loss,
+    )
 
     attn_fn = _resolve_attn_fn(attn_fn)
+    if schedule == "1f1b":
+        vag = make_pipeline_ep_lm_1f1b_grad(
+            mesh, cfg, num_stages, num_microbatches, attn_fn
+        )
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+    if schedule != "gpipe":
+        raise ValueError(
+            "MoE x pipeline supports schedule='gpipe' or '1f1b' (the "
+            f"table executors have no aux channel), not {schedule!r}"
+        )
     return jax.jit(
         make_step_body(
             make_pipeline_ep_lm_loss(
