@@ -631,7 +631,9 @@ def cmd_lm(args) -> int:
             # `stage`, experts sharded over `expert` inside each stage,
             # batch over (data, expert) — round 4, previously rejected.
             from tpu_dist_nn.parallel.expert_parallel import (
+                shard_blocks_interleaved_ep,
                 shard_blocks_pp_ep,
+                unshard_blocks_interleaved_ep,
                 unshard_blocks_pp_ep,
             )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
@@ -655,24 +657,36 @@ def cmd_lm(args) -> int:
             ))
             global_mesh, global_span = pp_ep_mesh, max(ep, 1) * dp
             global_axes = "_data_expert_"
-            if args.schedule not in ("gpipe", "1f1b"):
-                raise ValueError(
-                    "--experts with --stages supports --schedule gpipe "
-                    "or 1f1b (the table executors carry no router-aux "
-                    "channel)"
-                )
             schedule_handled = True  # MoE x pp consumes --schedule itself
             _stages, _mb, _sched = args.stages, args.microbatches, args.schedule
-            step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
-                pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched
-            )
             _ep = max(ep, 1)
-            shard_fn = lambda p: dict(  # noqa: E731
-                p, blocks=shard_blocks_pp_ep(p["blocks"], _stages, _ep)
-            )
-            unshard_fn = lambda p: dict(  # noqa: E731
-                p, blocks=unshard_blocks_pp_ep(p["blocks"])
-            )
+            if _sched in ("interleaved", "zb"):
+                _v = getattr(args, "virtual_stages", None)
+                if _v is None:
+                    _v = 2 if _sched == "interleaved" else 1
+                step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
+                    pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched,
+                    num_virtual=_v,
+                )
+                shard_fn = lambda p: dict(  # noqa: E731
+                    p,
+                    blocks=shard_blocks_interleaved_ep(
+                        p["blocks"], _stages, _v, _ep
+                    ),
+                )
+                unshard_fn = lambda p: dict(  # noqa: E731
+                    p, blocks=unshard_blocks_interleaved_ep(p["blocks"])
+                )
+            else:
+                step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
+                    pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched
+                )
+                shard_fn = lambda p: dict(  # noqa: E731
+                    p, blocks=shard_blocks_pp_ep(p["blocks"], _stages, _ep)
+                )
+                unshard_fn = lambda p: dict(  # noqa: E731
+                    p, blocks=unshard_blocks_pp_ep(p["blocks"])
+                )
         elif ep > 1 or dp > 1:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 

@@ -598,3 +598,125 @@ def make_pipeline_ep_lm_1f1b_grad(mesh, cfg: MoEConfig, num_stages: int,
         with_aux=True,
     )
     return _lm_vag_from_mapped(mapped, cfg, M)
+
+
+def shard_blocks_interleaved_ep(blocks: dict, num_stages: int,
+                                num_virtual: int, n_ep: int) -> dict:
+    """Stacked MoE blocks -> interleaved chunk layout with expert
+    sharding: EP-sharded leaves become ``(S, v, n_ep, L/V, E/n_ep,
+    ...)`` (stage leading, local chunk slot second, expert shard
+    third), replicated leaves ``(S, v, L/V, ...)`` — the Megatron
+    virtual-stage placement applied per expert shard
+    (transformer_pipeline.shard_blocks_interleaved_tp's pattern)."""
+    from tpu_dist_nn.parallel.transformer_pipeline import _chunk_regroup
+
+    S, v = num_stages, num_virtual
+    V = S * v
+    L = blocks["w_router"].shape[0]
+    if L % V:
+        raise ValueError(f"n_layers={L} not divisible by S*v={V}")
+
+    regroup = lambda a: _chunk_regroup(a, S, v)  # noqa: E731 — vmapped below
+    ep = ep_shard_blocks(blocks, n_ep)  # sharded leaves: (n_ep, L, ...)
+    out = {}
+    for k, val in ep.items():
+        if k in EP_SHARDED:  # (n_ep, L, ...) -> (S, v, n_ep, L/V, ...)
+            out[k] = jnp.moveaxis(jax.vmap(regroup)(val), 0, 2)
+        else:  # (L, ...) -> (S, v, L/V, ...)
+            out[k] = regroup(val)
+    return out
+
+
+def unshard_blocks_interleaved_ep(staged: dict) -> dict:
+    """Inverse of :func:`shard_blocks_interleaved_ep`."""
+    from tpu_dist_nn.parallel.transformer_pipeline import _chunk_ungroup
+
+    ep = {}
+    for k, val in staged.items():
+        if k in EP_SHARDED:  # (S, v, n_ep, L/V, ...) -> (n_ep, L, ...)
+            ep[k] = jax.vmap(_chunk_ungroup)(jnp.moveaxis(val, 2, 0))
+        else:
+            ep[k] = _chunk_ungroup(val)
+    return ep_unshard_blocks(ep)
+
+
+def make_pipeline_ep_lm_interleaved_grad(mesh, cfg: MoEConfig,
+                                         num_virtual: int,
+                                         num_microbatches: int,
+                                         attn_fn=dot_product_attention,
+                                         tables=None):
+    """Interleaved (virtual-stage) 1F1B x expert parallelism — MoE on
+    the table executor, router aux losses on its ``with_aux`` channel
+    (same pre-scaled contract as :func:`make_pipeline_ep_lm_1f1b_grad`,
+    with the per-chunk mean scaled by ``1/(S*v)`` so chunk
+    contributions sum to the oracle's mean over all blocks). Pass
+    ``tables`` from ``build_zero_bubble`` for the ZB variant (the
+    split backward routes the aux's input grad through BWD_B and its
+    weight grad through BWD_W — interleaved.make_interleaved_1f1b).
+    ``params["blocks"]`` in :func:`shard_blocks_interleaved_ep` layout.
+    """
+    from tpu_dist_nn.models.transformer import maybe_remat, unembed
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
+    from tpu_dist_nn.parallel.transformer_pipeline import _lm_vag_from_mapped
+
+    n_ep = mesh.shape[AXIS_EXPERT]
+    if cfg.n_experts % n_ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by expert axis {n_ep}"
+        )
+    S = mesh.shape[AXIS_STAGE]
+    V, M = S * num_virtual, num_microbatches
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+    ep_ffn = _make_ep_ffn(cfg)
+    aux_scale = cfg.router_aux_weight / (V * M * n_shards)
+
+    def stage_fn(chunk_blocks, _static, x):
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in chunk_blocks.items()
+        }
+        apply = maybe_remat(cfg, moe_block_apply)
+
+        def body(carry, block):
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
+            return y, aux
+
+        y, auxs = lax.scan(body, x, blocks)
+        return y, jnp.mean(auxs) * aux_scale
+
+    def tail_fn(tail_params, y, targets_f):
+        return next_token_ce(unembed(tail_params, y), targets_f) / (M * n_shards)
+
+    blocks_spec = {
+        k: (
+            P(AXIS_STAGE, None, AXIS_EXPERT)
+            if k in EP_SHARDED
+            else P(AXIS_STAGE)
+        )
+        for k in MOE_BLOCK_KEYS
+    }
+    mapped = make_interleaved_1f1b(
+        mesh, stage_fn, tail_fn, num_virtual, M,
+        microbatch_spec=P((AXIS_DATA, AXIS_EXPERT), None, None),
+        chunk_params_spec=blocks_spec,
+        aux_spec=P(None, (AXIS_DATA, AXIS_EXPERT), None),
+        with_aux=True,
+        tables=tables,
+    )
+    return _lm_vag_from_mapped(mapped, cfg, M)
+
+
+def make_pipeline_ep_lm_zb_grad(mesh, cfg: MoEConfig, num_virtual: int,
+                                num_microbatches: int,
+                                attn_fn=dot_product_attention):
+    """ZB-H1 x expert parallelism: zero-bubble split-backward tables
+    played back with MoE chunk bodies and the aux channel."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
+    from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
+
+    tables = build_zero_bubble(
+        mesh.shape[AXIS_STAGE], num_virtual, num_microbatches
+    )
+    return make_pipeline_ep_lm_interleaved_grad(
+        mesh, cfg, num_virtual, num_microbatches, attn_fn, tables=tables
+    )

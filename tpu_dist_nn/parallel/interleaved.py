@@ -52,9 +52,18 @@ def make_interleaved_1f1b(
     aux_spec=None,
     want_dx0: bool = True,
     tables: ScheduleTables | None = None,
+    with_aux: bool = False,
 ):
     """Interleaved counterpart of
     :func:`tpu_dist_nn.parallel.one_f_one_b.make_1f1b`.
+
+    ``with_aux=True``: same contract as make_1f1b's —
+    ``stage_fn -> (y, aux_contribution)`` with contributions
+    PRE-SCALED; the backward recomputation adds the value to the loss
+    and backpropagates cotangent 1.0. Under the zero-bubble split the
+    aux's input gradient rides BWD_B and its weight gradient BWD_W
+    (both phases pass the unit cotangent through their shared vjp);
+    the value is counted once, in BWD_B.
 
     * ``stage_fn(chunk_params, chunk_static, x) -> y`` — ONE chunk's
       compute; ``chunk_params``/``chunk_static`` pytrees arrive with
@@ -114,22 +123,28 @@ def make_interleaved_1f1b(
     tb["dy_stash"] = jnp.asarray(tables.dy_stash_or_empty())
 
     def device_fn(xs, chunk_params, chunk_static, tail_params, aux):
-        # Strip the length-1 stage-shard axis -> (v, ...) leaves; mark
-        # params data-varying so jax.vjp stays collective-free (see
-        # one_f_one_b's note), tail params (stage, data)-varying.
-        sp = jax.tree.map(
-            lambda a: lax.pcast(a[0], data_like, to="varying"), chunk_params
-        )
-        st = jax.tree.map(lambda a: a[0], chunk_static)
-        s_idx = lax.axis_index(AXIS_STAGE)
-        mb_shape = xs.shape[1:]
-        dt = xs.dtype
-
         def mark_varying(z, axes):
             # Idempotent "mark varying over `axes`" (one_f_one_b.py).
             have = getattr(jax.typeof(z), "vma", frozenset())
             need = tuple(a for a in axes if a not in have)
             return lax.pcast(z, need, to="varying") if need else z
+
+        # Strip the length-1 stage-shard axis -> (v, ...) leaves; mark
+        # params data-varying so jax.vjp stays collective-free (see
+        # one_f_one_b's note), tail params (stage, data)-varying.
+        # Marking is idempotent and each leaf's own pre-mark sharding
+        # is remembered for the end-of-scan grad reduction (a leaf can
+        # be sharded over a batch axis — EP's expert-sharded banks;
+        # one_f_one_b.py's note).
+        sp0 = jax.tree.map(lambda a: a[0], chunk_params)
+        sp_shard_axes = jax.tree.map(
+            lambda a: getattr(jax.typeof(a), "vma", frozenset()), sp0
+        )
+        sp = jax.tree.map(lambda a: mark_varying(a, data_like), sp0)
+        st = jax.tree.map(lambda a: a[0], chunk_static)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        mb_shape = xs.shape[1:]
+        dt = xs.dtype
 
         def vcast(z):
             return mark_varying(z, vary)
@@ -217,9 +232,21 @@ def make_interleaved_1f1b(
                 )
                 x_in = jnp.where(ar < 0, feed, buf)
                 new_stash = lax.dynamic_update_index_in_dim(stash, x_in, k_slot, 0)
-                y = chunk_fwd_g(pc, x_in)
+                out = chunk_fwd_g(pc, x_in)
+                y = out[0] if with_aux else out  # bwd recomputes the aux
                 return (y, zeros_wire, new_stash, dybuf, g_sp, g_tp,
                         dx0, loss_acc)
+
+            def split_vjp(x_in):
+                """vjp of the chunk; with_aux folds the unit aux
+                cotangent in so both backward phases see it."""
+                if with_aux:
+                    (y, aux_v), svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                    return y, aux_v.astype(jnp.float32), (
+                        lambda dy: svjp((dy, vcast(jnp.ones((), aux_v.dtype))))
+                    )
+                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                return y, vcast(jnp.zeros((), jnp.float32)), svjp
 
             def resolve_dy(y):
                 """This op's cotangent: the loss tail (last chunk) or
@@ -274,7 +301,7 @@ def make_interleaved_1f1b(
 
             def bwd(_):
                 x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
-                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                y, aux_v, svjp = split_vjp(x_in)
                 dy, loss_f, d_tp = resolve_dy(y)
                 d_pc, dx = svjp(dy)
                 return (
@@ -285,7 +312,7 @@ def make_interleaved_1f1b(
                     accumulate_g_sp(d_pc),
                     jax.tree.map(jnp.add, g_tp, d_tp),
                     record_dx0(dx),
-                    loss_acc + loss_f,
+                    loss_acc + loss_f + aux_v,
                 )
 
             def bwd_b(_):
@@ -293,8 +320,10 @@ def make_interleaved_1f1b(
                 # The consumed dy is parked in the cotangent stash for
                 # the matching BWD_W tick; d_pc is unused, so XLA's DCE
                 # trims the weight-grad computation from this branch.
+                # The aux value is counted HERE (once); its weight
+                # grads ride the matching BWD_W's shared vjp.
                 x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
-                y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                y, aux_v, svjp = split_vjp(x_in)
                 dy, loss_f, d_tp = resolve_dy(y)
                 _d_pc, dx = svjp(dy)
                 dslot = jnp.clip(row["dy_stash"][t], 0, D - 1)
@@ -307,7 +336,7 @@ def make_interleaved_1f1b(
                     g_sp,
                     jax.tree.map(jnp.add, g_tp, d_tp),
                     record_dx0(dx),
-                    loss_acc + loss_f,
+                    loss_acc + loss_f + aux_v,
                 )
 
             def bwd_w(_):
@@ -319,7 +348,7 @@ def make_interleaved_1f1b(
                     dybuf, jnp.clip(row["dy_stash"][t], 0, D - 1), 0,
                     keepdims=False,
                 )
-                _y, svjp = jax.vjp(chunk_fwd_g, pc, x_in)
+                _y, _aux_v, svjp = split_vjp(x_in)
                 d_pc, _dx = svjp(dy)
                 return (
                     zeros_wire,
@@ -350,7 +379,17 @@ def make_interleaved_1f1b(
         (_f, _b, _a, _g, _s, _dy, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
-        g_sp = jax.tree.map(lambda a: lax.psum(a, data_like)[None], g_sp)
+        # Per-leaf reduction: only over microbatch axes the primal leaf
+        # was replicated on (one_f_one_b.py's note — EP's
+        # expert-sharded banks keep per-shard grads).
+        g_sp = jax.tree.map(
+            lambda a, sh: (
+                lax.psum(a, axes)[None]
+                if (axes := tuple(ax for ax in data_like if ax not in sh))
+                else a[None]
+            ),
+            g_sp, sp_shard_axes,
+        )
         g_tp = jax.tree.map(lambda a: lax.psum(a, vary), g_tp)
         if want_dx0:
             dx0 = lax.psum(dx0, AXIS_STAGE)

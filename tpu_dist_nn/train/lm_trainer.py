@@ -166,7 +166,8 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
 
 def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
                                     num_microbatches: int, optimizer,
-                                    attn_fn=None, schedule: str = "gpipe"):
+                                    attn_fn=None, schedule: str = "gpipe",
+                                    num_virtual: int = 1):
     """Pipeline x expert-parallel MoE train step: blocks pipelined over
     ``stage``, experts sharded over ``expert`` inside each stage, batch
     over ``(data, expert)``. Blocks in
@@ -174,26 +175,34 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
     layout.
 
     ``schedule="gpipe"`` (default): AD through the forward schedule.
-    ``schedule="1f1b"``: the memory-flat hand-rolled schedule — router
-    aux losses ride the executor's ``with_aux`` channel
-    (expert_parallel.make_pipeline_ep_lm_1f1b_grad). The table
-    schedules (interleaved/zb) do not carry the aux channel yet."""
+    ``schedule="1f1b"``: the memory-flat hand-rolled schedule.
+    ``schedule="interleaved"/"zb"``: the table executors with
+    ``num_virtual`` chunks per device
+    (:func:`~tpu_dist_nn.parallel.expert_parallel.shard_blocks_interleaved_ep`
+    layout). On every hand schedule the router aux losses ride the
+    executor's ``with_aux`` channel (pre-scaled contract)."""
     from tpu_dist_nn.parallel.expert_parallel import (
         make_pipeline_ep_lm_1f1b_grad,
+        make_pipeline_ep_lm_interleaved_grad,
         make_pipeline_ep_lm_loss,
+        make_pipeline_ep_lm_zb_grad,
     )
+    from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
+    validate_schedule(schedule)
     attn_fn = _resolve_attn_fn(attn_fn)
+    if schedule in ("interleaved", "zb"):
+        make = (
+            make_pipeline_ep_lm_interleaved_grad
+            if schedule == "interleaved" else make_pipeline_ep_lm_zb_grad
+        )
+        vag = make(mesh, cfg, num_virtual, num_microbatches, attn_fn)
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule == "1f1b":
         vag = make_pipeline_ep_lm_1f1b_grad(
             mesh, cfg, num_stages, num_microbatches, attn_fn
         )
         return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
-    if schedule != "gpipe":
-        raise ValueError(
-            "MoE x pipeline supports schedule='gpipe' or '1f1b' (the "
-            f"table executors have no aux channel), not {schedule!r}"
-        )
     return jax.jit(
         make_step_body(
             make_pipeline_ep_lm_loss(
