@@ -157,6 +157,40 @@ def test_pp_tp_sp_gpipe_loss_matches_single_chip():
     _check(loss_v, g_v, g_blocks, params, tokens)
 
 
+def test_cli_lm_tensor_parallel(capsys):
+    # --tensor-parallel as a flag: pp x tp (gpipe) and the full
+    # PP x TP x SP 1F1B through the CLI; eager rejections for the
+    # unsupported shapes.
+    from tpu_dist_nn.cli import main
+
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "16", "--d-model", "16", "--heads", "2",
+        "--layers", "2", "--stages", "2", "--tensor-parallel", "2",
+        "--microbatches", "2",
+    ])
+    assert rc == 0
+    assert "perplexity" in capsys.readouterr().out
+
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "15", "--d-model", "16", "--heads", "2",
+        "--layers", "2", "--stages", "2", "--tensor-parallel", "2",
+        "--seq-parallel", "2", "--schedule", "1f1b", "--microbatches", "2",
+    ])
+    assert rc == 0
+    assert "perplexity" in capsys.readouterr().out
+
+    # Eager rejections: no stages; heads not divisible.
+    assert main([
+        "--platform", "cpu", "lm", "--steps", "1", "--tensor-parallel", "2",
+    ]) != 0
+    assert main([
+        "--platform", "cpu", "lm", "--steps", "1", "--stages", "2",
+        "--tensor-parallel", "2", "--heads", "3",
+    ]) != 0
+
+
 def test_cli_lm_pp_sp_zb(capsys):
     # The table schedules through the CLI's pp x sp path (previously
     # "gpipe or 1f1b" only): zb trains end to end on real text.

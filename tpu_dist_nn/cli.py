@@ -527,6 +527,25 @@ def cmd_lm(args) -> int:
     # seq-parallel compatibility checks, with or without --stages.)
     if not moe and args.expert_parallel > 1:
         raise ValueError("--expert-parallel requires --experts > 0")
+    if args.tensor_parallel > 1:
+        if args.stages <= 1:
+            raise ValueError(
+                "--tensor-parallel shards each pipeline stage's blocks: "
+                "it requires --stages > 1 (use "
+                "--sample-tensor-parallel for sharded decode)"
+            )
+        if moe:
+            raise ValueError(
+                "--tensor-parallel does not compose with --experts "
+                "(expert FFN banks are already sharded over the "
+                "expert axis)"
+            )
+        if args.heads % args.tensor_parallel:
+            raise ValueError(
+                f"--heads {args.heads} must be divisible by "
+                f"--tensor-parallel {args.tensor_parallel} "
+                "(Megatron shards attention head-wise)"
+            )
     if args.sample_tensor_parallel > 1 and args.sample_bytes <= 0:
         raise ValueError(
             "--sample-tensor-parallel requires --sample-bytes > 0 "
@@ -739,8 +758,12 @@ def cmd_lm(args) -> int:
                 from tpu_dist_nn.parallel.transformer_pipeline import (
                     shard_blocks,
                     shard_blocks_interleaved,
+                    shard_blocks_interleaved_tp,
+                    shard_blocks_pp_tp,
                     unshard_blocks,
                     unshard_blocks_interleaved,
+                    unshard_blocks_interleaved_tp,
+                    unshard_blocks_pp_tp,
                 )
                 from tpu_dist_nn.train.lm_trainer import (
                     make_pipeline_sp_lm_train_step,
@@ -760,13 +783,13 @@ def cmd_lm(args) -> int:
                     )
                 pp_sp_mesh = build_mesh(MeshSpec(
                     stage=args.stages, seq=args.seq_parallel,
-                    data=args.data_parallel,
+                    model=args.tensor_parallel, data=args.data_parallel,
                 ))
                 global_mesh, global_span = pp_sp_mesh, args.data_parallel
                 global_axes = "_data_"
                 schedule_handled = True  # pp x sp consumes --schedule itself
                 _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
-                _sched = args.schedule
+                _sched, _tp = args.schedule, args.tensor_parallel
                 if _sched in ("interleaved", "zb"):
                     # Table executors x SP: virtual-stage chunk layout
                     # (same --virtual-stages defaulting as the dense
@@ -776,27 +799,111 @@ def cmd_lm(args) -> int:
                         _v = 2 if _sched == "interleaved" else 1
                     step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
                         pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
-                        schedule=_sched, num_virtual=_v,
+                        schedule=_sched, num_virtual=_v, tensor_parallel=_tp,
                     )
-                    shard_fn = lambda p: dict(  # noqa: E731
-                        p,
-                        blocks=shard_blocks_interleaved(
-                            p["blocks"], _stages, _v
-                        ),
-                    )
-                    unshard_fn = lambda p: dict(  # noqa: E731
-                        p, blocks=unshard_blocks_interleaved(p["blocks"])
-                    )
+                    if _tp > 1:
+                        shard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=shard_blocks_interleaved_tp(
+                                p["blocks"], cfg, _stages, _v, _tp
+                            ),
+                        )
+                        unshard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=unshard_blocks_interleaved_tp(
+                                p["blocks"], cfg
+                            ),
+                        )
+                    else:
+                        shard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=shard_blocks_interleaved(
+                                p["blocks"], _stages, _v
+                            ),
+                        )
+                        unshard_fn = lambda p: dict(  # noqa: E731
+                            p, blocks=unshard_blocks_interleaved(p["blocks"])
+                        )
                 else:
                     step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
                         pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
-                        schedule=_sched,
+                        schedule=_sched, tensor_parallel=_tp,
                     )
+                    if _tp > 1:
+                        shard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=shard_blocks_pp_tp(
+                                p["blocks"], cfg, _stages, _tp
+                            ),
+                        )
+                        unshard_fn = lambda p: dict(  # noqa: E731
+                            p, blocks=unshard_blocks_pp_tp(p["blocks"], cfg)
+                        )
+                    else:
+                        shard_fn = lambda p: dict(  # noqa: E731
+                            p, blocks=shard_blocks(p["blocks"], _stages)
+                        )
+                        unshard_fn = lambda p: dict(  # noqa: E731
+                            p, blocks=unshard_blocks(p["blocks"])
+                        )
+            elif args.tensor_parallel > 1:
+                # Pipeline x Megatron TP (x DP): previously library-only
+                # (make_pipeline_lm_train_step(tensor_parallel=)), now a
+                # flag. Layouts per schedule as in the pp x sp branch.
+                from tpu_dist_nn.parallel.transformer_pipeline import (
+                    shard_blocks_interleaved_tp,
+                    shard_blocks_pp_tp,
+                    unshard_blocks_interleaved_tp,
+                    unshard_blocks_pp_tp,
+                )
+                from tpu_dist_nn.train.lm_trainer import (
+                    make_pipeline_lm_train_step,
+                )
+
+                if args.batch_size % (args.microbatches * args.data_parallel):
+                    raise ValueError(
+                        f"--batch-size {args.batch_size} must be divisible "
+                        f"by microbatches*data_parallel="
+                        f"{args.microbatches * args.data_parallel}"
+                    )
+                pp_tp_mesh = build_mesh(MeshSpec(
+                    stage=args.stages, model=args.tensor_parallel,
+                    data=args.data_parallel,
+                ))
+                global_mesh, global_span = pp_tp_mesh, args.data_parallel
+                global_axes = "_data_"
+                schedule_handled = True  # pp x tp consumes --schedule itself
+                _stages, _mb, _tp = (
+                    args.stages, args.microbatches, args.tensor_parallel
+                )
+                _sched = args.schedule
+                _v = getattr(args, "virtual_stages", None)
+                if _v is None:
+                    _v = 2 if _sched == "interleaved" else 1
+                step_fn = lambda opt: make_pipeline_lm_train_step(  # noqa: E731
+                    pp_tp_mesh, cfg, _stages, _mb, opt, schedule=_sched,
+                    num_virtual=_v, tensor_parallel=_tp,
+                )
+                if _sched in ("interleaved", "zb"):
                     shard_fn = lambda p: dict(  # noqa: E731
-                        p, blocks=shard_blocks(p["blocks"], _stages)
+                        p,
+                        blocks=shard_blocks_interleaved_tp(
+                            p["blocks"], cfg, _stages, _v, _tp
+                        ),
                     )
                     unshard_fn = lambda p: dict(  # noqa: E731
-                        p, blocks=unshard_blocks(p["blocks"])
+                        p,
+                        blocks=unshard_blocks_interleaved_tp(p["blocks"], cfg),
+                    )
+                else:
+                    shard_fn = lambda p: dict(  # noqa: E731
+                        p,
+                        blocks=shard_blocks_pp_tp(
+                            p["blocks"], cfg, _stages, _tp
+                        ),
+                    )
+                    unshard_fn = lambda p: dict(  # noqa: E731
+                        p, blocks=unshard_blocks_pp_tp(p["blocks"], cfg)
                     )
             else:
                 mesh = build_mesh(
@@ -1441,6 +1548,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="shard the sequence axis over N devices "
                         "for long-context training (see --sp-mode)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="Megatron-shard each stage's blocks over N "
+                        "devices (requires --stages > 1; composes with "
+                        "--seq-parallel on every --schedule — the full "
+                        "PP x TP x SP x DP deployment shape)")
     p.add_argument("--sample-tensor-parallel", type=int, default=1,
                    help="decode --sample-bytes with heads + KV cache "
                         "Megatron-sharded over N devices")
