@@ -599,8 +599,11 @@ def cmd_lm(args) -> int:
     # wraps moe_block_apply in maybe_remat.)
     if args.zero1 and moe:
         raise ValueError("--zero1 supports the dense LM only")
-    if args.seq_parallel > 1 and moe:
-        raise ValueError("--seq-parallel supports the dense LM only")
+    if args.seq_parallel > 1 and moe and args.stages > 1:
+        raise ValueError(
+            "--seq-parallel with --experts does not compose with "
+            "--stages (long-context MoE is the flat sp x ep mesh)"
+        )
     if args.fsdp and moe:
         raise ValueError("--fsdp supports the dense LM only")
     common = dict(
@@ -706,6 +709,44 @@ def cmd_lm(args) -> int:
                 unshard_fn = lambda p: dict(  # noqa: E731
                     p, blocks=unshard_blocks_pp_ep(p["blocks"])
                 )
+        elif args.seq_parallel > 1:
+            # Long-context MoE (round 4, previously "dense LM only"):
+            # sequence parallelism x expert parallelism on the flat
+            # (seq, expert, data) mesh — ring/Ulysses attention over
+            # `seq`, all_to_all dispatch over `expert`, full
+            # (input+target) rows with the sp masking convention.
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.train.lm_trainer import (
+                make_sp_moe_lm_train_step,
+            )
+
+            if (args.seq_len + 1) % args.seq_parallel:
+                raise ValueError(
+                    f"--seq-len+1 ({args.seq_len + 1}) must be divisible "
+                    f"by --seq-parallel {args.seq_parallel} (rows carry "
+                    "the next-token target)"
+                )
+            if args.batch_size % (max(ep, 1) * dp):
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible "
+                    f"by expert_parallel*data_parallel={max(ep, 1) * dp}"
+                )
+            sp_ep_mesh = build_mesh(MeshSpec(
+                seq=args.seq_parallel, expert=max(ep, 1), data=dp
+            ))
+            global_mesh, global_span = sp_ep_mesh, max(ep, 1) * dp
+            global_axes = "_data_expert_"
+            _mode = args.sp_mode
+            step_fn = lambda opt: make_sp_moe_lm_train_step(  # noqa: E731
+                sp_ep_mesh, cfg, opt, mode=_mode
+            )
+            _ep = max(ep, 1)
+            shard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=ep_shard_blocks(p["blocks"], _ep)
+            )
+            unshard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=ep_unshard_blocks(p["blocks"])
+            )
         elif ep > 1 or dp > 1:
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
 

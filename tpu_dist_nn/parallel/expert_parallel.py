@@ -170,20 +170,52 @@ def _expert_ffn(w_up, b_up, w_down, b_down, buf):
 
 
 def moe_ffn_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
-                  n_groups: int = 1):
+                  n_groups: int = 1, n_seq_groups: int = 1):
     """Single-chip MoE FFN oracle: ``x (B, T, D) -> (y, aux_loss)``.
 
     Routes within ``n_groups`` fixed token groups — with ``n_groups``
     equal to the EP degree this computes exactly what the sharded path
     computes, making it the parity oracle for
     :func:`make_ep_lm_forward`.
+
+    ``n_seq_groups > 1`` additionally splits the SEQUENCE dim, so a
+    group is (batch slice × seq slice) — the grouping the
+    sequence-parallel MoE path (:func:`make_sp_ep_lm_forward`)
+    produces, where each (data, expert, seq) device shard routes its
+    own contiguous token block. Within-group token order is row-major
+    (row, position), matching the device shard's flatten.
     """
     B, T, D = x.shape
     S = B * T
-    if S % n_groups:
-        raise ValueError(f"{S} tokens not divisible into {n_groups} groups")
-    cap = cfg.capacity(S // n_groups)
-    xg = x.reshape(n_groups, S // n_groups, D)
+    n_total = n_groups * n_seq_groups
+    if n_seq_groups == 1:
+        # Original flat grouping: contiguous slices of the flattened
+        # (B, T) token stream (need not split on row boundaries).
+        if S % n_groups:
+            raise ValueError(
+                f"{S} tokens not divisible into {n_groups} groups"
+            )
+        cap = cfg.capacity(S // n_groups)
+        xg = x.reshape(n_groups, S // n_groups, D)
+    else:
+        if B % n_groups:
+            raise ValueError(
+                f"batch {B} not divisible into {n_groups} groups"
+            )
+        if T % n_seq_groups:
+            raise ValueError(
+                f"seq {T} not divisible into {n_seq_groups} seq groups"
+            )
+        cap = cfg.capacity(S // n_total)
+        # (B, T, D) -> (nb, B/nb, nt, T/nt, D) -> (nb, nt, B/nb, T/nt, D)
+        # -> (nb*nt, (B/nb)*(T/nt), D): each group is one (batch slice,
+        # seq slice) block, row-major within.
+        xg = (
+            x.reshape(n_groups, B // n_groups, n_seq_groups,
+                      T // n_seq_groups, D)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(n_total, S // n_total, D)
+        )
 
     def per_group(xf):
         dispatch, combine, aux = route_topk(
@@ -198,7 +230,15 @@ def moe_ffn_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
         return y.astype(x.dtype), aux
 
     ys, auxs = jax.vmap(per_group)(xg)
-    return ys.reshape(B, T, D), jnp.mean(auxs)
+    if n_seq_groups == 1:
+        return ys.reshape(B, T, D), jnp.mean(auxs)
+    y = (
+        ys.reshape(n_groups, n_seq_groups, B // n_groups,
+                   T // n_seq_groups, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, T, D)
+    )
+    return y, jnp.mean(auxs)
 
 
 def moe_block_apply(block: dict, x: jnp.ndarray, cfg: MoEConfig,
@@ -402,6 +442,102 @@ def make_ep_lm_forward(mesh, cfg: MoEConfig, attn_fn=dot_product_attention,
         return fn(embed_params, params_ep["blocks"], tokens)
 
     return forward
+
+
+def make_sp_ep_lm_loss(mesh, cfg: MoEConfig, mode: str = "ring"):
+    """-> ``loss_fn(params_ep, tokens) -> scalar``: LONG-CONTEXT MoE —
+    sequence parallelism × expert parallelism (previously a documented
+    non-composition).
+
+    Axes are orthogonal inside a block: attention runs the ring or
+    Ulysses decomposition over ``seq`` (position dim sharded, all heads
+    local — this is the flat unconditional path, so the ring keeps its
+    cheap ppermute rotation), and the routed FFN is position-local, so
+    each ``(data, expert, seq)`` shard routes its own contiguous
+    (batch slice × seq slice) token block and dispatches over
+    ``expert`` with the usual ``all_to_all``. Numerically identical to
+    the grouped oracle with ``n_groups = data*expert`` ×
+    ``n_seq_groups = seq`` (:func:`moe_ffn_apply`), with the
+    sp masking convention for the CE (full input+target rows, position
+    0 masked — ring_attention.make_seq_parallel_lm_loss).
+
+    ``params_ep["blocks"]`` in :func:`ep_shard_blocks` layout.
+    """
+    from tpu_dist_nn.models.transformer import (
+        masked_next_token_ce,
+        maybe_remat,
+    )
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    n_ep = mesh.shape[AXIS_EXPERT]
+    n_seq = mesh.shape[AXIS_SEQ]
+    if cfg.n_experts % n_ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by expert axis {n_ep}"
+        )
+    ep_ffn = _make_ep_ffn(cfg)
+    attn_fn = _sp_attn_fn(mode)
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+
+    def device_fn(embed_params, blocks_ep, tokens):
+        # tokens: (B_local, T_local) — this shard's rows × seq slice.
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in blocks_ep.items()
+        }
+        idx = lax.axis_index(AXIS_SEQ)
+        T_loc = tokens.shape[1]
+        pos = idx * T_loc + jnp.arange(T_loc)
+        x = embed_params["tok_embed"][tokens] + embed_params["pos_embed"][pos]
+        apply = maybe_remat(cfg, moe_block_apply)
+
+        def body(carry, block):
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
+            return y, aux
+
+        x, auxs = lax.scan(body, x, blocks)
+        x = layer_norm(x, embed_params["lnf_g"], embed_params["lnf_b"])
+        logits = x @ embed_params["tok_embed"].T
+        aux = jnp.mean(auxs)
+        for ax in (AXIS_DATA, AXIS_EXPERT, AXIS_SEQ):
+            aux = lax.pmean(aux, ax)
+        return logits, aux
+
+    blocks_specs = {
+        k: (P(AXIS_EXPERT) if k in EP_SHARDED else P())
+        for k in MOE_BLOCK_KEYS
+    }
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), blocks_specs, P((AXIS_DATA, AXIS_EXPERT), AXIS_SEQ)),
+        out_specs=(P((AXIS_DATA, AXIS_EXPERT), AXIS_SEQ, None), P()),
+    )
+
+    def loss_fn(params_ep, tokens):
+        B, T = tokens.shape
+        if B % n_shards:
+            raise ValueError(
+                f"batch {B} not divisible by data*expert shards {n_shards}"
+            )
+        if T % n_seq:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis {n_seq} "
+                "(sp feeds full input+target rows)"
+            )
+        if T > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        params_ep = cfg.cast_params(params_ep)
+        embed_params = {k: v for k, v in params_ep.items() if k != "blocks"}
+        logits, aux = fn(embed_params, params_ep["blocks"], tokens)
+        return (
+            masked_next_token_ce(logits, tokens)
+            + cfg.router_aux_weight * aux
+        )
+
+    return loss_fn
 
 
 # ---------------------------------------------------------------------------

@@ -313,6 +313,19 @@ def make_moe_lm_train_step(cfg, optimizer, mesh=None, attn_fn=None):
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
+def make_sp_moe_lm_train_step(mesh, cfg, optimizer, mode: str = "ring"):
+    """Long-context MoE train step: sequence parallelism (ring/Ulysses
+    attention over ``seq``) × expert parallelism (all_to_all dispatch
+    over ``expert``), batch over ``(data, expert)`` — tokens are full
+    (input+target) rows (the sp masking convention).
+    ``params["blocks"]`` in ep_shard_blocks layout."""
+    from tpu_dist_nn.parallel.expert_parallel import make_sp_ep_lm_loss
+
+    return jax.jit(
+        make_step_body(make_sp_ep_lm_loss(mesh, cfg, mode), optimizer)
+    )
+
+
 def evaluate_moe_lm(params, cfg, rows: np.ndarray,
                     batch_size: int = 16) -> dict:
     """MoE eval: CE only (router aux excluded) so perplexity/bits-per-
