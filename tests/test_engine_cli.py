@@ -302,8 +302,12 @@ def test_cli_lm_seq_parallel(capsys):
 
 
 def test_cli_lm_seq_parallel_rejections(capsys):
-    assert cli_main(["lm", "--experts", "2", "--seq-parallel", "2"]) == 2
-    assert "dense LM only" in capsys.readouterr().err
+    # MoE x SP is now supported FLAT (test_expert_parallel.py); the
+    # remaining rejection is the three-axis MoE x SP x PP shape.
+    assert cli_main([
+        "lm", "--experts", "2", "--seq-parallel", "2", "--stages", "2",
+    ]) == 2
+    assert "--stages" in capsys.readouterr().err
     assert cli_main([
         "lm", "--seq-parallel", "2", "--seq-len", "16", "--steps", "1",
     ]) == 2

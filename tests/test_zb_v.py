@@ -1,0 +1,180 @@
+"""ZB-V: zero-bubble on the V-shape placement.
+
+The placement claim the executor design makes — "any future schedule
+is a new table builder" — is stressed here harder than by ZB-H1: the
+V placement's second leg sends FORWARD activations on the reverse
+ring, its apex hand-off is device-LOCAL (the self loopback channel),
+and one device can receive on multiple physical channels in one tick
+(the channel-major receive tables). Structure is verified by the
+symbolic replay at build time (which models all three channels);
+these tests add the bubble accounting vs the same-granularity
+alternatives, placement properties, grad parity vs single-chip AD,
+and the trainer/CLI wiring.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    lm_loss,
+)
+from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+from tpu_dist_nn.parallel.schedule_table import (
+    BWD_B,
+    BWD_W,
+    FWD,
+    build_interleaved_1f1b,
+    build_zb_v,
+    build_zero_bubble,
+)
+from tpu_dist_nn.parallel.transformer_pipeline import (
+    make_pipeline_lm_zb_v_grad,
+    shard_blocks_vshape,
+    unshard_blocks_vshape,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=8, d_ff=64, max_seq_len=16
+)
+
+
+def _tokens(batch, seq, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.asarray(rng.integers(0, CFG.vocab_size, (batch, seq)), np.int32)
+
+
+@pytest.mark.parametrize("S,M", [(2, 2), (2, 4), (4, 4), (4, 8), (3, 5), (8, 8)])
+def test_zb_v_tables_build_and_verify(S, M):
+    tb = build_zb_v(S, M)  # symbolic replay runs inside
+    V = 2 * S
+    assert tb.placement == "vshape"
+    assert tb.num_chunks == V
+    # Split accounting: 3 ops per (chunk, microbatch).
+    assert int((tb.op != 0).sum()) == 3 * V * M
+    assert int((tb.op == BWD_B).sum()) == int((tb.op == BWD_W).sum())
+
+
+def test_zb_v_beats_same_granularity_schedules():
+    """The headline measurement, at the SAME chunk granularity (v=2 —
+    every schedule here runs 2S chunks of L/(2S) layers, so a tick
+    costs the same wall time): ZB-V's bubble is S-1 chunk-ticks
+    INDEPENDENT of M, always < interleaved 1F1B's 2(S-1), and <=
+    ZB-H1's everywhere — strictly smaller in the small-M regime
+    (M = S: H1 pays 2S-3) where H1 hasn't amortized its warmup, equal
+    once M grows past it. Measured, not asserted from the paper."""
+    for S, M, h1_strict in [(2, 4, False), (4, 4, True), (8, 8, True),
+                            (4, 8, False)]:
+        vshape = build_zb_v(S, M)
+        h1 = build_zero_bubble(S, 2, M)
+        il = build_interleaved_1f1b(S, 2, M)
+        assert vshape.bubble_ticks == S - 1, (S, M, vshape.bubble_ticks)
+        assert vshape.bubble_ticks <= h1.bubble_ticks, (
+            S, M, vshape.bubble_ticks, h1.bubble_ticks,
+        )
+        if h1_strict:
+            assert vshape.bubble_ticks < h1.bubble_ticks, (
+                S, M, vshape.bubble_ticks, h1.bubble_ticks,
+            )
+        assert vshape.bubble_ticks < il.bubble_ticks, (
+            S, M, vshape.bubble_ticks, il.bubble_ticks,
+        )
+        # ...at comparable memory: same-order stash footprint.
+        assert vshape.stash_slots <= h1.stash_slots + S
+
+
+def test_zb_v_placement_properties():
+    """What the V buys structurally: chunk 0 (input feed) and chunk
+    V-1 (loss tail) are co-located on device 0, and the apex hand-off
+    (chunk S-1 -> S) crosses no wire (self loopback)."""
+    S, M = 4, 4
+    tb = build_zb_v(S, M)
+    assert tb.dev_of_chunk(0) == 0
+    assert tb.dev_of_chunk(2 * S - 1) == 0
+    assert tb.dev_of_chunk(S - 1) == S - 1 and tb.dev_of_chunk(S) == S - 1
+    # The self channel is actually used (the apex FWD hand-off) and
+    # feed/tail sit on device 0's rows.
+    assert (tb.selfch_dst >= 0).any()
+    feeds = (tb.op == FWD) & (tb.abuf_read == -1)
+    assert feeds[0].any() and not feeds[1:].any()
+    tails = ((tb.op == BWD_B)) & (tb.gbuf_read == -1)
+    assert tails[0].any() and not tails[1:].any()
+
+
+def test_zb_v_shard_roundtrip():
+    params = init_transformer(jax.random.key(0), CFG)
+    staged = shard_blocks_vshape(params["blocks"], 2)
+    # L=8, S=2: (S, 2, L/(2S)=2, ...)
+    assert staged["w_qkv"].shape[:3] == (2, 2, 2)
+    back = unshard_blocks_vshape(staged)
+    for k, v in params["blocks"].items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(back[k]))
+    with pytest.raises(ValueError, match="divisible"):
+        shard_blocks_vshape(params["blocks"], 3)
+
+
+@pytest.mark.parametrize("S,M,data", [(2, 2, 2), (4, 4, 2)])
+def test_zb_v_grads_match_single_chip(S, M, data):
+    mesh = build_mesh(MeshSpec(stage=S, data=data))
+    params = init_transformer(jax.random.key(1), CFG)
+    tokens = _tokens(batch=M * 2 * max(1, data // 2), seq=16, seed=2)
+
+    vag = make_pipeline_lm_zb_v_grad(mesh, CFG, num_microbatches=M)
+    params_v = dict(params, blocks=shard_blocks_vshape(params["blocks"], S))
+    loss_v, g = jax.jit(vag)(params_v, tokens)
+    loss_ref, gref = jax.jit(
+        jax.value_and_grad(lm_loss), static_argnums=2
+    )(params, tokens, CFG)
+    np.testing.assert_allclose(float(loss_ref), float(loss_v), rtol=1e-5)
+    g_blocks = unshard_blocks_vshape(g["blocks"])
+    for k in gref["blocks"]:
+        np.testing.assert_allclose(
+            np.asarray(gref["blocks"][k]), np.asarray(g_blocks[k]),
+            rtol=5e-4, atol=1e-5, err_msg=k,
+        )
+    for k in ("tok_embed", "pos_embed", "lnf_g", "lnf_b"):
+        np.testing.assert_allclose(
+            np.asarray(gref[k]), np.asarray(g[k]), rtol=5e-4, atol=1e-5,
+        )
+
+
+def test_zb_v_train_step_and_cli(capsys):
+    import optax
+
+    from tpu_dist_nn.cli import main
+    from tpu_dist_nn.train.lm_trainer import make_pipeline_lm_train_step
+
+    mesh = build_mesh(MeshSpec(stage=2, data=2))
+    params = init_transformer(jax.random.key(3), CFG)
+    params_v = dict(params, blocks=shard_blocks_vshape(params["blocks"], 2))
+    optimizer = optax.adam(1e-2)
+    step = make_pipeline_lm_train_step(
+        mesh, CFG, 2, 2, optimizer, schedule="zb-v"
+    )
+    tokens = _tokens(batch=8, seq=16, seed=4)
+    new_params, _, loss = step(params_v, optimizer.init(params_v), tokens)
+    assert np.isfinite(float(loss)) and float(loss) > 0
+    assert not np.allclose(
+        np.asarray(new_params["blocks"]["w_qkv"]),
+        np.asarray(params_v["blocks"]["w_qkv"]),
+    )
+    # Unwired compositions reject rather than silently degrade (on a
+    # mesh that HAS the model axis, so the zb-v-specific rejection —
+    # not the generic axis-size check — is what fires).
+    mesh_tp = build_mesh(MeshSpec(stage=2, model=2, data=2))
+    with pytest.raises(ValueError, match="tensor-parallel layout"):
+        make_pipeline_lm_train_step(
+            mesh_tp, CFG, 2, 2, optimizer, schedule="zb-v", tensor_parallel=2
+        )
+    # End to end: tdn lm --schedule zb-v (8 layers over 2 stages x 2
+    # legs); the trained params come back unsharded.
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "2", "--batch-size", "4",
+        "--seq-len", "16", "--d-model", "16", "--heads", "2",
+        "--layers", "8", "--stages", "2", "--microbatches", "2",
+        "--schedule", "zb-v",
+    ])
+    assert rc == 0
+    assert "perplexity" in capsys.readouterr().out

@@ -519,9 +519,9 @@ class Engine:
         from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
         validate_schedule(schedule)
-        if schedule == "zb":
+        if schedule in ("zb", "zb-v"):
             raise ValueError(
-                "schedule='zb' (zero-bubble) is implemented for the "
+                "zero-bubble schedules are implemented for the "
                 "transformer LM pipeline only (tdn lm --schedule zb); "
                 "the classifier engine supports gpipe/1f1b/interleaved"
             )

@@ -1574,12 +1574,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
-    p.add_argument("--schedule", choices=["gpipe", "1f1b", "interleaved", "zb"],
+    p.add_argument("--schedule",
+                   choices=["gpipe", "1f1b", "interleaved", "zb", "zb-v"],
                    default="gpipe",
                    help="pipeline training schedule when --stages > 1 "
                         "(interleaved = Megatron virtual stages, see "
                         "--virtual-stages; zb = zero-bubble ZB-H1 split "
-                        "backward, half the 1F1B bubble)")
+                        "backward, half the 1F1B bubble; zb-v = zero "
+                        "bubble on the V-shape placement — bubble S-1 "
+                        "chunk-ticks independent of M (zb needs larger "
+                        "M to match), embedding+loss co-located)")
     p.add_argument("--virtual-stages", type=int, default=None,
                    help="model chunks per device for --schedule "
                         "interleaved/zb (bubble shrinks ~v-fold under "

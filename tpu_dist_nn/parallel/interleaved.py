@@ -117,10 +117,18 @@ def make_interleaved_1f1b(
         name: jnp.asarray(getattr(tables, name))
         for name in (
             "op", "chunk", "mb", "stash",
-            "abuf_read", "gbuf_read", "abuf_write", "gbuf_write", "is_c0",
+            "abuf_read", "gbuf_read", "is_c0",
         )
     }
     tb["dy_stash"] = jnp.asarray(tables.dy_stash_or_empty())
+    # Routing: sender-side ring choice + channel-major receives (a
+    # device can receive up to three payloads per tick — fwd ring, bwd
+    # ring, self loopback — on non-monotone placements like ZB-V's
+    # V-shape; classic schedules derive the fwd→abuf / bwd→gbuf
+    # defaults).
+    tb["send_rev"] = jnp.asarray(tables.send_rev_or_default())
+    for name, arr in tables.channel_tables().items():
+        tb[name] = jnp.asarray(arr)
 
     def device_fn(xs, chunk_params, chunk_static, tail_params, aux):
         def mark_varying(z, axes):
@@ -172,6 +180,7 @@ def make_interleaved_1f1b(
         carry0 = (
             zeros_wire,                                  # fwd ring payload
             zeros_wire,                                  # bwd ring payload
+            zeros_wire,                                  # self loopback
             vcast(jnp.zeros((A, *mb_shape), dt)),        # activation recv buf
             vcast(jnp.zeros((G, *mb_shape), dt)),        # cotangent recv buf
             vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
@@ -185,26 +194,32 @@ def make_interleaved_1f1b(
         )
 
         def tick(carry, t):
-            (fwd_wire, bwd_wire, abuf, gbuf, stash, dybuf, g_sp, g_tp,
-             dx0, loss_acc) = carry
-            # Receive phase: store last tick's ring payloads into their
-            # scheduled slots (-1 = not for us / discard).
-            aw = row["abuf_write"][t]
-            abuf = jnp.where(
-                aw >= 0,
-                lax.dynamic_update_index_in_dim(
-                    abuf, fwd_wire, jnp.clip(aw, 0, A - 1), 0
-                ),
-                abuf,
-            )
-            gw = row["gbuf_write"][t]
-            gbuf = jnp.where(
-                gw >= 0,
-                lax.dynamic_update_index_in_dim(
-                    gbuf, bwd_wire, jnp.clip(gw, 0, G - 1), 0
-                ),
-                gbuf,
-            )
+            (fwd_wire, bwd_wire, self_wire, abuf, gbuf, stash, dybuf,
+             g_sp, g_tp, dx0, loss_acc) = carry
+            # Receive phase, channel-major: each physical channel (fwd
+            # ring, bwd ring, self loopback) can carry one payload per
+            # tick, stored into abuf (dst 0) or gbuf (dst 1) at its
+            # scheduled slot (-1 = nothing on that channel).
+            for name, wire in (
+                ("fwdch", fwd_wire), ("bwdch", bwd_wire),
+                ("selfch", self_wire),
+            ):
+                dst = row[f"{name}_dst"][t]
+                slot = row[f"{name}_slot"][t]
+                abuf = jnp.where(
+                    dst == 0,
+                    lax.dynamic_update_index_in_dim(
+                        abuf, wire, jnp.clip(slot, 0, A - 1), 0
+                    ),
+                    abuf,
+                )
+                gbuf = jnp.where(
+                    dst == 1,
+                    lax.dynamic_update_index_in_dim(
+                        gbuf, wire, jnp.clip(slot, 0, G - 1), 0
+                    ),
+                    gbuf,
+                )
             g_slot = row["chunk"][t]
             f = row["mb"][t]
             k_slot = row["stash"][t]
@@ -364,19 +379,29 @@ def make_interleaved_1f1b(
             branches = [idle, fwd, bwd] + ([bwd_b, bwd_w] if has_split else [])
             (send_y, send_dx, stash, dybuf, g_sp, g_tp, dx0,
              loss_acc) = lax.switch(row["op"][t], branches, 0)
+            # Sender-side routing: 0 = natural ring (fwd op -> fwd
+            # ring, bwd op -> bwd ring), 1 = the opposite ring (the
+            # V placement's second leg), 2 = self loopback (the V's
+            # apex — no wire at all). Only one of send_y/send_dx is
+            # non-zero per tick, so swapping both is the clean "ride
+            # the other ring".
+            sr = row["send_rev"][t]
+            ring_y = lax.select_n(sr, send_y, send_dx, zeros_wire)
+            ring_dx = lax.select_n(sr, send_dx, send_y, zeros_wire)
+            nxt_self = send_y + send_dx  # one is zeros; read iff sr==2
             with jax.named_scope("interleaved_ring_hop"):
                 nxt_fwd = (
-                    lax.ppermute(send_y, AXIS_STAGE, fwd_perm) if S > 1 else send_y
+                    lax.ppermute(ring_y, AXIS_STAGE, fwd_perm) if S > 1 else ring_y
                 )
                 nxt_bwd = (
-                    lax.ppermute(send_dx, AXIS_STAGE, bwd_perm) if S > 1 else send_dx
+                    lax.ppermute(ring_dx, AXIS_STAGE, bwd_perm) if S > 1 else ring_dx
                 )
             return (
-                nxt_fwd, nxt_bwd, abuf, gbuf, stash, dybuf, g_sp, g_tp,
-                dx0, loss_acc
+                nxt_fwd, nxt_bwd, nxt_self, abuf, gbuf, stash, dybuf,
+                g_sp, g_tp, dx0, loss_acc
             ), None
 
-        (_f, _b, _a, _g, _s, _dy, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
+        (_f, _b, _sf, _a, _g, _s, _dy, g_sp, g_tp, dx0, loss_acc), _ = lax.scan(
             tick, carry0, jnp.arange(T)
         )
         # Per-leaf reduction: only over microbatch axes the primal leaf

@@ -58,6 +58,22 @@ class ScheduleTables:
     per microbatch). ``dy_stash``: cotangent-stash slot bridging a
     split backward — BWD_B writes the dy it consumed there, the
     matching BWD_W reads and frees it (-1 for non-split ops).
+
+    **Routing** (V-shape and other non-monotone placements; ``None`` =
+    the classic defaults): ``send_rev`` — 0: this tick's op sends on
+    its NATURAL ring (fwd ops on the s→s+1 ring, bwd ops on s→s-1);
+    1: the opposite ring; 2: the self loopback (producer == consumer
+    device). Receives are CHANNEL-MAJOR — a device can receive up to
+    three payloads in one tick (one per physical channel: fwd ring,
+    bwd ring, self loopback), so each channel carries its own
+    ``{fwd,bwd,self}ch_dst`` (-1 = nothing, 0 = store to abuf,
+    1 = store to gbuf) and ``..ch_slot`` tables. The legacy
+    ``abuf_write``/``gbuf_write`` destination view stays accurate for
+    classic monotone schedules (and is what the forward-only executor
+    reads); channel tables are the complete truth. ``placement`` —
+    global chunk ``c`` lives on: "megatron": device ``c % S``, slot
+    ``c // S``; "vshape" (v=2): device ``c`` for ``c < S`` else
+    ``2S-1-c``, slot ``c // S``.
     """
 
     num_devices: int
@@ -78,6 +94,14 @@ class ScheduleTables:
     is_c0: np.ndarray
     dybuf_slots: int = 1
     dy_stash: np.ndarray | None = None
+    send_rev: np.ndarray | None = None
+    fwdch_dst: np.ndarray | None = None
+    fwdch_slot: np.ndarray | None = None
+    bwdch_dst: np.ndarray | None = None
+    bwdch_slot: np.ndarray | None = None
+    selfch_dst: np.ndarray | None = None
+    selfch_slot: np.ndarray | None = None
+    placement: str = "megatron"
 
     def dy_stash_or_empty(self) -> np.ndarray:
         return (
@@ -85,6 +109,49 @@ class ScheduleTables:
             if self.dy_stash is not None
             else np.full_like(self.op, -1)
         )
+
+    def send_rev_or_default(self) -> np.ndarray:
+        return (
+            self.send_rev
+            if self.send_rev is not None
+            else np.zeros_like(self.op)
+        )
+
+    def channel_tables(self) -> dict:
+        """The six channel-major receive tables, deriving the classic
+        defaults (fwd ring → abuf, bwd ring → gbuf, no self channel)
+        from the legacy destination view when absent."""
+        if self.fwdch_dst is not None:
+            return {
+                "fwdch_dst": self.fwdch_dst, "fwdch_slot": self.fwdch_slot,
+                "bwdch_dst": self.bwdch_dst, "bwdch_slot": self.bwdch_slot,
+                "selfch_dst": self.selfch_dst, "selfch_slot": self.selfch_slot,
+            }
+        none = np.full_like(self.op, -1)
+        return {
+            "fwdch_dst": np.where(self.abuf_write >= 0, 0, -1).astype(np.int32),
+            "fwdch_slot": self.abuf_write,
+            "bwdch_dst": np.where(self.gbuf_write >= 0, 1, -1).astype(np.int32),
+            "bwdch_slot": self.gbuf_write,
+            "selfch_dst": none, "selfch_slot": none,
+        }
+
+    def dev_of_chunk(self, c: int) -> int:
+        S = self.num_devices
+        if self.placement == "megatron":
+            return c % S
+        if self.placement == "vshape":
+            return c if c < S else 2 * S - 1 - c
+        raise ValueError(f"unknown placement {self.placement!r}")
+
+    def global_chunk(self, s: int, slot: int) -> int:
+        """Inverse of (dev_of_chunk, slot): the global chunk index."""
+        S = self.num_devices
+        if self.placement == "megatron":
+            return slot * S + s
+        if self.placement == "vshape":
+            return s if slot == 0 else 2 * S - 1 - s
+        raise ValueError(f"unknown placement {self.placement!r}")
 
     @property
     def bubble_ticks(self) -> int:
@@ -113,7 +180,22 @@ class _SlotPool:
         self.free.append(slot)
 
 
-def _emit_tables(cols: list, S: int) -> dict:
+def _route(S: int, d_from: int, d_to: int) -> int:
+    """Physical channel for a one-hop send: 0 = fwd ring (s→s+1),
+    1 = bwd ring (s→s-1), 2 = self loopback. Non-neighbor hops are a
+    placement bug — the wire model has no such channel."""
+    if d_to == d_from:
+        return 2
+    if d_to == (d_from + 1) % S:
+        return 0
+    if d_to == (d_from - 1) % S:
+        return 1
+    raise ValueError(
+        f"placement requires a non-neighbor hop {d_from}->{d_to} (S={S})"
+    )
+
+
+def _emit_tables(cols: list, S: int, dev_fn=None) -> dict:
     """THE dense-table emission pass, shared by every builder: convert
     the scheduler's per-tick op records into the ``[S, T]`` int32
     arrays (one definition, so a table-layout change cannot land in
@@ -122,11 +204,19 @@ def _emit_tables(cols: list, S: int) -> dict:
     Record contract: ``op`` + (non-idle) ``c``/``f``; op-specific keys
     ``stash``, ``abuf_read``/``send_abuf_slot`` (FWD),
     ``gbuf_read``/``is_c0``/``send_gbuf_slot`` (BWD/BWD_B),
-    ``dy_stash`` (BWD_B write / BWD_W read). Ring sends land in the
+    ``dy_stash`` (BWD_B write / BWD_W read). Sends land in the
     receiver's ``*_write`` column at tick ``t+1`` (a send at the final
     tick cannot exist: its receive would fall off the table, and every
     schedule ends with an op that sends nothing).
+
+    ``dev_fn`` maps global chunk -> device (default: Megatron
+    ``c % S``). Non-monotone placements (V-shape) produce hops on the
+    opposite ring or to self; the routing lands in ``send_rev`` (sender
+    side: 0 natural ring / 1 opposite / 2 self) and
+    ``abuf_src``/``gbuf_src`` (receiver side: physical channel 0/1/2).
     """
+    if dev_fn is None:
+        dev_fn = lambda c: c % S  # noqa: E731
     T = len(cols)
     tables = {
         name: np.full((S, T), fill, dtype=np.int32)
@@ -134,9 +224,32 @@ def _emit_tables(cols: list, S: int) -> dict:
             ("op", IDLE), ("chunk", 0), ("mb", 0), ("stash", 0),
             ("abuf_read", -1), ("gbuf_read", -1),
             ("abuf_write", -1), ("gbuf_write", -1), ("is_c0", 0),
-            ("dy_stash", -1),
+            ("dy_stash", -1), ("send_rev", 0),
+            ("fwdch_dst", -1), ("fwdch_slot", -1),
+            ("bwdch_dst", -1), ("bwdch_slot", -1),
+            ("selfch_dst", -1), ("selfch_slot", -1),
         ]
     }
+
+    def book(ch: int, sender: int, rs: int, t_recv: int, dst: int, slot: int):
+        """Record an arrival on a physical channel; each channel cell
+        has a single upstream device, so a double booking is a bug."""
+        name = ("fwdch", "bwdch", "selfch")[ch]
+        at = rs if ch != 2 else sender
+        if tables[f"{name}_dst"][at, t_recv] != -1:
+            raise ValueError(
+                f"channel {name} into device {at} double-booked at "
+                f"tick {t_recv}"
+            )
+        tables[f"{name}_dst"][at, t_recv] = dst
+        tables[f"{name}_slot"][at, t_recv] = slot
+        # Legacy destination view (accurate for classic monotone
+        # schedules; the forward-only executor reads abuf_write).
+        if dst == 0 and ch == 0:
+            tables["abuf_write"][at, t_recv] = slot
+        if dst == 1 and ch == 1:
+            tables["gbuf_write"][at, t_recv] = slot
+
     for t_i, col in enumerate(cols):
         for s in range(S):
             rec = col[s]
@@ -151,14 +264,27 @@ def _emit_tables(cols: list, S: int) -> dict:
             if op == FWD:
                 tables["abuf_read"][s, t_i] = rec.get("abuf_read", -1)
                 if "send_abuf_slot" in rec:
-                    tables["abuf_write"][(c + 1) % S, t_i + 1] = rec["send_abuf_slot"]
+                    rs = dev_fn(c + 1)
+                    ch = _route(S, s, rs)
+                    # sender: natural ring for FWD is fwd (0) — rev if
+                    # the hop actually rides the bwd ring.
+                    tables["send_rev"][s, t_i] = (
+                        2 if ch == 2 else (1 if ch == 1 else 0)
+                    )
+                    book(ch, s, rs, t_i + 1, 0, rec["send_abuf_slot"])
             elif op in (BWD, BWD_B):
                 tables["gbuf_read"][s, t_i] = rec.get("gbuf_read", -1)
                 tables["is_c0"][s, t_i] = rec.get("is_c0", 0)
                 if op == BWD_B:
                     tables["dy_stash"][s, t_i] = rec["dy_stash"]
                 if "send_gbuf_slot" in rec:
-                    tables["gbuf_write"][(c - 1) % S, t_i + 1] = rec["send_gbuf_slot"]
+                    rs = dev_fn(c - 1)
+                    ch = _route(S, s, rs)
+                    # natural ring for BWD is bwd (1) — rev if fwd.
+                    tables["send_rev"][s, t_i] = (
+                        2 if ch == 2 else (1 if ch == 0 else 0)
+                    )
+                    book(ch, s, rs, t_i + 1, 1, rec["send_gbuf_slot"])
             else:  # BWD_W
                 tables["dy_stash"][s, t_i] = rec["dy_stash"]
     return tables
@@ -606,6 +732,171 @@ def build_zero_bubble(
     return out
 
 
+def build_zb_v(
+    num_devices: int,
+    num_microbatches: int,
+) -> ScheduleTables:
+    """Compile a zero-bubble schedule on the V-SHAPE placement (ZB-V,
+    Qi et al.): ``V = 2S`` chunks, chunk ``c`` on device ``c`` for
+    ``c < S`` and ``2S-1-c`` after the apex — the forward path runs
+    down the device line and back up, so devices see a V.
+
+    What the placement buys over ZB-H1's Megatron placement:
+
+    * the APEX hand-off (chunk ``S-1`` → ``S``) is device-LOCAL (no
+      wire), and the second leg's hops ride the opposite ring
+      direction — exercising the executor's routing tables
+      (``send_rev``/``abuf_src``/``gbuf_src``);
+    * chunk 0 (the input feed/embedding) and chunk ``V-1`` (the loss
+      tail) are CO-LOCATED on device 0 — the tied-embedding LM's two
+      uses of ``tok_embed`` live on one device;
+    * the first backward (chunk ``V-1``, device 0) becomes ready
+      immediately after that device's own last forward — the drain
+      starts at the bottom of the V instead of crossing the pipe.
+
+    Scheduling is the same greedy B > F > W with the O(S) W-backlog
+    cap as :func:`build_zero_bubble`; the result is verified by the
+    same symbolic replay (which models the three physical channels)
+    and measured by `bubble_ticks` — the claim rests on the
+    measurement, not the paper's name.
+    """
+    S, M = num_devices, num_microbatches
+    if S < 1 or M < 1:
+        raise ValueError(f"need S,M >= 1, got {S},{M}")
+    V = 2 * S
+
+    def dev(c: int) -> int:
+        return c if c < S else 2 * S - 1 - c
+
+    chunks_desc = [[2 * S - 1 - s, s] for s in range(S)]  # deepest first
+    chunks_asc = [[s, 2 * S - 1 - s] for s in range(S)]
+
+    fwd_done = np.full((V, M), -1, dtype=np.int64)
+    b_done = np.full((V, M), -1, dtype=np.int64)
+    abuf_pool = [_SlotPool() for _ in range(S)]
+    gbuf_pool = [_SlotPool() for _ in range(S)]
+    stash_pool = [_SlotPool() for _ in range(S)]
+    dybuf_pool = [_SlotPool() for _ in range(S)]
+    abuf_slot: dict[tuple[int, int], int] = {}
+    gbuf_slot: dict[tuple[int, int], int] = {}
+    stash_slot: dict[tuple[int, int], int] = {}
+    dybuf_slot: dict[tuple[int, int], int] = {}
+
+    cols: list[dict] = []
+    next_fwd = [0] * V
+    next_b = [0] * V
+    w_queue: list[list[tuple[int, int]]] = [[] for _ in range(S)]
+    done_ops = 0
+    t = 0
+    max_ticks = 6 * (2 * M + V) + 16  # 3 ops x (v=2) chunks per mb
+    while done_ops < 3 * V * M:
+        if t > max_ticks:
+            raise RuntimeError(
+                f"zb-v schedule did not converge (S={S}, M={M})"
+            )
+        col = [dict(op=IDLE) for _ in range(S)]
+        for s in range(S):
+            chosen = None
+            # B first (critical path), deepest chunk first.
+            for c in chunks_desc[s]:
+                f = next_b[c]
+                if f >= M or f >= next_fwd[c]:
+                    continue
+                if fwd_done[c, f] < 0 or fwd_done[c, f] >= t:
+                    continue
+                if c < V - 1 and (b_done[c + 1, f] < 0 or b_done[c + 1, f] + 1 > t):
+                    continue
+                chosen = dict(op=BWD_B, c=c, f=f)
+                break
+            if chosen is None and len(w_queue[s]) >= S:
+                c, f = w_queue[s][0]
+                chosen = dict(op=BWD_W, c=c, f=f)
+            if chosen is None:
+                best = None
+                for c in chunks_asc[s]:
+                    f = next_fwd[c]
+                    if f >= M:
+                        continue
+                    if c > 0 and (fwd_done[c - 1, f] < 0 or fwd_done[c - 1, f] + 1 > t):
+                        continue
+                    key = (f, -c)
+                    if best is None or key < best[0]:
+                        best = (key, c, f)
+                if best is not None:
+                    chosen = dict(op=FWD, c=best[1], f=best[2])
+            if chosen is None and w_queue[s]:
+                c, f = w_queue[s][0]
+                chosen = dict(op=BWD_W, c=c, f=f)
+            if chosen is not None:
+                col[s] = chosen
+        # Commit effects (receivers via the V placement's dev map).
+        for s in range(S):
+            rec = col[s]
+            if rec["op"] == FWD:
+                c, f = rec["c"], rec["f"]
+                slot = stash_pool[s].acquire()
+                stash_slot[(c, f)] = slot
+                rec["stash"] = slot
+                if c > 0:
+                    rslot = abuf_slot.pop((c, f))
+                    rec["abuf_read"] = rslot
+                    abuf_pool[s].release(rslot)
+                fwd_done[c, f] = t
+                next_fwd[c] = f + 1
+                done_ops += 1
+                if c < V - 1:
+                    rs = dev(c + 1)
+                    wslot = abuf_pool[rs].acquire()
+                    abuf_slot[(c + 1, f)] = wslot
+                    rec["send_abuf_slot"] = wslot
+            elif rec["op"] == BWD_B:
+                c, f = rec["c"], rec["f"]
+                rec["stash"] = stash_slot[(c, f)]
+                dslot = dybuf_pool[s].acquire()
+                dybuf_slot[(c, f)] = dslot
+                rec["dy_stash"] = dslot
+                if c < V - 1:
+                    rslot = gbuf_slot.pop((c + 1, f))
+                    rec["gbuf_read"] = rslot
+                    gbuf_pool[s].release(rslot)
+                b_done[c, f] = t
+                next_b[c] = f + 1
+                w_queue[s].append((c, f))
+                done_ops += 1
+                rec["is_c0"] = int(c == 0)
+                if c > 0:
+                    rs = dev(c - 1)
+                    wslot = gbuf_pool[rs].acquire()
+                    gbuf_slot[(c, f)] = wslot
+                    rec["send_gbuf_slot"] = wslot
+            elif rec["op"] == BWD_W:
+                c, f = rec["c"], rec["f"]
+                w_queue[s].remove((c, f))
+                slot = stash_slot.pop((c, f))
+                rec["stash"] = slot
+                stash_pool[s].release(slot)
+                dslot = dybuf_slot.pop((c, f))
+                rec["dy_stash"] = dslot
+                dybuf_pool[s].release(dslot)
+                done_ops += 1
+        cols.append(col)
+        t += 1
+
+    A = max(p.high for p in abuf_pool) or 1
+    G = max(p.high for p in gbuf_pool) or 1
+    K = max(p.high for p in stash_pool) or 1
+    D = max(p.high for p in dybuf_pool) or 1
+
+    out = ScheduleTables(
+        num_devices=S, num_chunks=V, num_microbatches=M, ticks=len(cols),
+        abuf_slots=A, gbuf_slots=G, stash_slots=K, dybuf_slots=D,
+        placement="vshape",
+        **_emit_tables(cols, S, dev_fn=dev),
+    )
+    verify_tables(out)
+    return out
+
+
 def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
     """Replay the tables with symbolic values; raise on any flaw.
 
@@ -617,44 +908,73 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
     S, V, M, T = tb.num_devices, tb.num_chunks, tb.num_microbatches, tb.ticks
     v = V // S
     dy_stash_tb = tb.dy_stash_or_empty()
+    send_rev_tb = tb.send_rev_or_default()
+    chtb = tb.channel_tables()
     abuf = [dict() for _ in range(S)]   # slot -> symbolic value
     gbuf = [dict() for _ in range(S)]
     stash = [dict() for _ in range(S)]
     dybuf = [dict() for _ in range(S)]  # BWD_B -> BWD_W cotangent bridge
-    fwd_sent: list = [None] * S  # payload in flight on the fwd ring
+    # Three physical channels, payloads keyed by RECEIVER: the fwd ring
+    # (s -> s+1), the bwd ring (s -> s-1), and the self loopback.
+    fwd_sent: list = [None] * S
     bwd_sent: list = [None] * S
+    self_sent: list = [None] * S
     fwd_count = np.zeros((V, M), dtype=int)
     bwd_count = np.zeros((V, M), dtype=int)
     b_count = np.zeros((V, M), dtype=int)
     w_count = np.zeros((V, M), dtype=int)
 
     for t in range(T):
-        # Start of tick: receive last tick's payloads.
+        # Start of tick: receive last tick's payloads, channel-major —
+        # up to three arrivals per device per tick.
         for s in range(S):
-            w = tb.abuf_write[s, t]
-            incoming = fwd_sent[s]  # payloads keyed by RECEIVER
-            if w >= 0:
+            for name, sent in (
+                ("fwdch", fwd_sent), ("bwdch", bwd_sent),
+                ("selfch", self_sent),
+            ):
+                dst = int(chtb[f"{name}_dst"][s, t])
+                if dst < 0:
+                    continue
+                slot = int(chtb[f"{name}_slot"][s, t])
+                incoming = sent[s]
                 if incoming is None:
-                    raise AssertionError(f"t={t} s={s}: abuf write with no payload")
-                if w in abuf[s]:
-                    raise AssertionError(f"t={t} s={s}: abuf slot {w} clobbered")
-                abuf[s][int(w)] = incoming
-            w = tb.gbuf_write[s, t]
-            incoming = bwd_sent[s]
-            if w >= 0:
-                if incoming is None:
-                    raise AssertionError(f"t={t} s={s}: gbuf write with no payload")
-                if w in gbuf[s]:
-                    raise AssertionError(f"t={t} s={s}: gbuf slot {w} clobbered")
-                gbuf[s][int(w)] = incoming
+                    raise AssertionError(
+                        f"t={t} s={s}: {name} write with no payload"
+                    )
+                buf = abuf if dst == 0 else gbuf
+                if slot in buf[s]:
+                    raise AssertionError(
+                        f"t={t} s={s}: {name}->buf{dst} slot {slot} clobbered"
+                    )
+                buf[s][slot] = incoming
         new_fwd_sent: list = [None] * S
         new_bwd_sent: list = [None] * S
+        new_self_sent: list = [None] * S
+
+        def place(s, c_to, payload, natural, t=t):
+            """Model a send: route to dev(c_to), check the emitted
+            send_rev agrees, put the payload on the physical channel."""
+            rs = tb.dev_of_chunk(c_to)
+            ch = _route(S, s, rs)
+            expect_rev = 2 if ch == 2 else (0 if ch == natural else 1)
+            if int(send_rev_tb[s, t]) != expect_rev:
+                raise AssertionError(
+                    f"t={t} s={s}: send_rev {int(send_rev_tb[s, t])} != "
+                    f"expected {expect_rev} for hop {s}->{rs}"
+                )
+            chans = (new_fwd_sent, new_bwd_sent, new_self_sent)
+            if chans[ch][rs if ch != 2 else s] is not None:
+                raise AssertionError(
+                    f"t={t}: channel {ch} to {rs} double-booked"
+                )
+            chans[ch][rs if ch != 2 else s] = payload
+
         for s in range(S):
             op = tb.op[s, t]
             if op == IDLE:
                 continue
             g, f = int(tb.chunk[s, t]), int(tb.mb[s, t])
-            c = g * S + s
+            c = tb.global_chunk(s, g)
             if op == FWD:
                 if c == 0:
                     x = ("x", 0, f)
@@ -670,7 +990,8 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
                         )
                 if not forward_only:
                     stash[s][int(tb.stash[s, t])] = ("x", c, f)
-                new_fwd_sent[ (c + 1) % S ] = ("act", c, f) if c < V - 1 else None
+                if c < V - 1:
+                    place(s, c + 1, ("act", c, f), natural=0)
                 fwd_count[c, f] += 1
             elif op in (BWD, BWD_B):
                 slot = int(tb.stash[s, t])
@@ -709,7 +1030,8 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
                     b_count[c, f] += 1
                 else:
                     bwd_count[c, f] += 1
-                new_bwd_sent[ (c - 1) % S ] = ("grad", c, f) if c > 0 else None
+                if c > 0:
+                    place(s, c - 1, ("grad", c, f), natural=1)
             else:  # BWD_W
                 slot = int(tb.stash[s, t])
                 x = stash[s].pop(slot, None)
@@ -728,7 +1050,9 @@ def verify_tables(tb: ScheduleTables, forward_only: bool = False) -> None:
                         f"t={t} s={s}: W({c},{f}) ran before its B"
                     )
                 w_count[c, f] += 1
-        fwd_sent, bwd_sent = new_fwd_sent, new_bwd_sent
+        fwd_sent, bwd_sent, self_sent = (
+            new_fwd_sent, new_bwd_sent, new_self_sent
+        )
 
     if not (fwd_count == 1).all():
         raise AssertionError(

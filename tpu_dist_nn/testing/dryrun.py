@@ -221,6 +221,29 @@ def _dryrun_lm_1f1b(n_devices: int) -> None:
     jax.block_until_ready(new_params)
     assert float(loss) > 0
 
+    # ZB-V: zero bubble on the V-shape placement — the second leg's
+    # forward rides the REVERSE ring and the apex uses the self
+    # loopback (round 4: channel-major receive tables). Needs
+    # n_layers % 2S == 0, so a 4-layer twin config.
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        shard_blocks_vshape,
+    )
+
+    cfg_v = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=4, d_ff=32,
+        max_seq_len=16,
+    )
+    params_vv = _init(jax.random.key(2), cfg_v)
+    params_vv = dict(
+        params_vv, blocks=shard_blocks_vshape(params_vv["blocks"], stage)
+    )
+    step_zbv = make_pipeline_lm_train_step(
+        mesh, cfg_v, stage, 2, optimizer, schedule="zb-v"
+    )
+    new_params, _, loss = step_zbv(params_vv, optimizer.init(params_vv), tokens)
+    jax.block_until_ready(new_params)
+    assert float(loss) > 0
+
 
 def _dryrun_zero_fsdp(n_devices: int) -> None:
     """ZeRO-1 and FSDP sharded-state steps (with per-block remat):

@@ -118,6 +118,21 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
             f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
             f"axis has size {mesh.shape.get(AXIS_MODEL, 1)}"
         )
+    if schedule == "zb-v":
+        # Zero-bubble on the V-shape placement: v=2 fixed by the
+        # placement; blocks in shard_blocks_vshape layout. TP/SP
+        # compositions are not wired for this placement yet.
+        from tpu_dist_nn.parallel import transformer_pipeline as tpl
+
+        if tensor_parallel > 1:
+            raise ValueError(
+                "schedule='zb-v' has no tensor-parallel layout yet: "
+                "use schedule='zb' for ZB x TP"
+            )
+        vag = tpl.make_pipeline_lm_zb_v_grad(
+            mesh, cfg, num_microbatches, attn
+        )
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule in ("interleaved", "zb"):
         # Both ride the table executor on the shard_blocks_interleaved
         # (or _tp) layout; "zb" swaps in the split-backward zero-bubble
@@ -190,6 +205,11 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if schedule == "zb-v":
+        raise ValueError(
+            "schedule='zb-v' has no expert-parallel composition yet: "
+            "use schedule='zb' for ZB x EP"
+        )
     attn_fn = _resolve_attn_fn(attn_fn)
     if schedule in ("interleaved", "zb"):
         make = (
@@ -245,6 +265,11 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if schedule == "zb-v":
+        raise ValueError(
+            "schedule='zb-v' has no sequence-parallel composition yet: "
+            "use schedule='zb' for ZB x SP"
+        )
     if tensor_parallel > 1 and mesh.shape.get(AXIS_MODEL, 1) != tensor_parallel:
         raise ValueError(
             f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
@@ -391,6 +416,18 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         )
     if step_fn is not None:
         step = step_fn(optimizer)
+    elif pipelined and schedule == "zb-v":
+        from tpu_dist_nn.parallel.transformer_pipeline import (
+            shard_blocks_vshape,
+        )
+
+        params = dict(
+            params, blocks=shard_blocks_vshape(params["blocks"], num_stages)
+        )
+        step = make_pipeline_lm_train_step(
+            mesh, cfg, num_stages, num_microbatches, optimizer,
+            schedule=schedule,
+        )
     elif pipelined and schedule in ("interleaved", "zb"):
         from tpu_dist_nn.parallel.transformer_pipeline import (
             shard_blocks_interleaved,
@@ -457,7 +494,15 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     else:
         flush(checkpoints)
     if pipelined:
-        if schedule in ("interleaved", "zb"):
+        if schedule == "zb-v":
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                unshard_blocks_vshape,
+            )
+
+            params = dict(
+                params, blocks=unshard_blocks_vshape(params["blocks"])
+            )
+        elif schedule in ("interleaved", "zb"):
             from tpu_dist_nn.parallel.transformer_pipeline import (
                 unshard_blocks_interleaved,
             )

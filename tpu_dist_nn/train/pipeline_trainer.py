@@ -84,12 +84,12 @@ def make_pipeline_train_step(
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
-    if schedule == "zb":
+    if schedule in ("zb", "zb-v"):
         # Silently falling through to gpipe would let a user benchmark
         # the wrong schedule; the split-backward executor exists on the
         # LM path only (lm_trainer.make_pipeline_lm_train_step).
         raise ValueError(
-            "schedule='zb' (zero-bubble) is implemented for the "
+            "zero-bubble schedules are implemented for the "
             "transformer LM pipeline only (tdn lm --schedule zb); the "
             "dense classifier pipeline supports gpipe/1f1b/interleaved"
         )
