@@ -140,6 +140,127 @@ def test_zb_v_grads_match_single_chip(S, M, data):
         )
 
 
+def _masked_ce(params, tokens):
+    from tpu_dist_nn.models.transformer import forward
+
+    logits = forward(params, tokens, CFG)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(np.float32), axis=-1)
+    targets = tokens[:, 1:]
+    import jax.numpy as jnp
+
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+@pytest.mark.parametrize("composition", ["tp", "sp", "tp_sp"])
+def test_zb_v_compositions_match_single_chip(composition):
+    # The V-placement tables at 2/3/4D: TP psums, the SP ring's
+    # group-local rotation, and their conjunction all execute inside
+    # the V schedule's switch branches — same disjoint-axis arguments
+    # as the other schedules, now on cross-ring/self wires. Grad
+    # parity vs single-chip AD through the shared oracles.
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        make_pipeline_sp_lm_zb_v_grad,
+        make_pipeline_tp_lm_zb_v_grad,
+        make_pipeline_tp_sp_lm_zb_v_grad,
+        shard_blocks_vshape_tp,
+        unshard_blocks_vshape_tp,
+    )
+
+    params = init_transformer(jax.random.key(5), CFG)
+    tokens = np.asarray(_tokens(batch=4, seq=16, seed=6))
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(tokens)
+    if composition == "tp":
+        mesh = build_mesh(MeshSpec(stage=2, model=2, data=2))
+        vag = make_pipeline_tp_lm_zb_v_grad(mesh, CFG, num_microbatches=2)
+        params_v = dict(
+            params, blocks=shard_blocks_vshape_tp(params["blocks"], CFG, 2, 2)
+        )
+        loss_ref, g_ref = jax.jit(jax.value_and_grad(lm_loss), static_argnums=2)(
+            params, tokens, CFG
+        )
+        unshard = lambda b: unshard_blocks_vshape_tp(b, CFG)  # noqa: E731
+    elif composition == "sp":
+        mesh = build_mesh(MeshSpec(stage=2, seq=2, data=2))
+        vag = make_pipeline_sp_lm_zb_v_grad(
+            mesh, CFG, num_microbatches=2, mode="ring"
+        )
+        params_v = dict(params, blocks=shard_blocks_vshape(params["blocks"], 2))
+        loss_ref, g_ref = jax.jit(jax.value_and_grad(_masked_ce))(params, tokens)
+        unshard = unshard_blocks_vshape
+    else:
+        mesh = build_mesh(MeshSpec(stage=2, model=2, seq=2))
+        vag = make_pipeline_tp_sp_lm_zb_v_grad(
+            mesh, CFG, num_microbatches=2, mode="ring"
+        )
+        params_v = dict(
+            params, blocks=shard_blocks_vshape_tp(params["blocks"], CFG, 2, 2)
+        )
+        loss_ref, g_ref = jax.jit(jax.value_and_grad(_masked_ce))(params, tokens)
+        unshard = lambda b: unshard_blocks_vshape_tp(b, CFG)  # noqa: E731
+
+    loss_v, g_v = jax.jit(vag)(params_v, tokens)
+    np.testing.assert_allclose(float(loss_ref), float(loss_v), rtol=1e-5)
+    g_blocks = unshard(g_v["blocks"])
+    for k in g_ref["blocks"]:
+        np.testing.assert_allclose(
+            np.asarray(g_ref["blocks"][k]), np.asarray(g_blocks[k]),
+            rtol=5e-4, atol=1e-5, err_msg=k,
+        )
+    for k in ("tok_embed", "pos_embed", "lnf_g", "lnf_b"):
+        np.testing.assert_allclose(
+            np.asarray(g_ref[k]), np.asarray(g_v[k]), rtol=5e-4, atol=1e-5,
+            err_msg=k,
+        )
+
+
+def test_zb_v_ep_matches_grouped_oracle():
+    # ZB-V x expert parallelism: the aux channel on the V tables.
+    from tpu_dist_nn.parallel.expert_parallel import (
+        MoEConfig,
+        init_moe_transformer,
+        make_pipeline_ep_lm_zb_v_grad,
+        moe_lm_loss,
+        shard_blocks_vshape_ep,
+        unshard_blocks_vshape_ep,
+    )
+
+    ECFG = MoEConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_seq_len=32, n_experts=4, router_top_k=1,
+    )
+    S, expert, M = 2, 2, 2
+    mesh = build_mesh(MeshSpec(stage=S, expert=expert, data=1))
+    params = init_moe_transformer(jax.random.key(7), ECFG)
+    n_groups = M * expert
+    rng = np.random.default_rng(8)
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(
+        rng.integers(0, ECFG.vocab_size, (2 * n_groups, 17)), jnp.int32
+    )
+
+    vag = make_pipeline_ep_lm_zb_v_grad(mesh, ECFG, num_microbatches=M)
+    params_v = dict(
+        params, blocks=shard_blocks_vshape_ep(params["blocks"], S, expert)
+    )
+    v_pp, g_pp = jax.jit(vag)(params_v, tokens)
+    v_ref, g_ref = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: moe_lm_loss(p, t, ECFG, n_groups=n_groups)
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(float(v_ref), float(v_pp), rtol=1e-5)
+    g_blocks = unshard_blocks_vshape_ep(g_pp["blocks"])
+    for k in g_ref["blocks"]:
+        np.testing.assert_allclose(
+            np.asarray(g_ref["blocks"][k]), np.asarray(g_blocks[k]),
+            rtol=5e-4, atol=1e-5, err_msg=k,
+        )
+
+
 def test_zb_v_train_step_and_cli(capsys):
     import optax
 
@@ -160,14 +281,20 @@ def test_zb_v_train_step_and_cli(capsys):
         np.asarray(new_params["blocks"]["w_qkv"]),
         np.asarray(params_v["blocks"]["w_qkv"]),
     )
-    # Unwired compositions reject rather than silently degrade (on a
-    # mesh that HAS the model axis, so the zb-v-specific rejection —
-    # not the generic axis-size check — is what fires).
+    # ZB-V x TP trains through the trainer API too.
+    from tpu_dist_nn.parallel.transformer_pipeline import (
+        shard_blocks_vshape_tp,
+    )
+
     mesh_tp = build_mesh(MeshSpec(stage=2, model=2, data=2))
-    with pytest.raises(ValueError, match="tensor-parallel layout"):
-        make_pipeline_lm_train_step(
-            mesh_tp, CFG, 2, 2, optimizer, schedule="zb-v", tensor_parallel=2
-        )
+    step_tp = make_pipeline_lm_train_step(
+        mesh_tp, CFG, 2, 2, optimizer, schedule="zb-v", tensor_parallel=2
+    )
+    params_tp = dict(
+        params, blocks=shard_blocks_vshape_tp(params["blocks"], CFG, 2, 2)
+    )
+    _, _, loss_tp = step_tp(params_tp, optimizer.init(params_tp), tokens)
+    assert np.isfinite(float(loss_tp)) and float(loss_tp) > 0
     # End to end: tdn lm --schedule zb-v (8 layers over 2 stages x 2
     # legs); the trained params come back unsharded.
     rc = main([

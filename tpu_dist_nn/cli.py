@@ -655,8 +655,10 @@ def cmd_lm(args) -> int:
             from tpu_dist_nn.parallel.expert_parallel import (
                 shard_blocks_interleaved_ep,
                 shard_blocks_pp_ep,
+                shard_blocks_vshape_ep,
                 unshard_blocks_interleaved_ep,
                 unshard_blocks_pp_ep,
+                unshard_blocks_vshape_ep,
             )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
             from tpu_dist_nn.train.lm_trainer import (
@@ -682,7 +684,18 @@ def cmd_lm(args) -> int:
             schedule_handled = True  # MoE x pp consumes --schedule itself
             _stages, _mb, _sched = args.stages, args.microbatches, args.schedule
             _ep = max(ep, 1)
-            if _sched in ("interleaved", "zb"):
+            if _sched == "zb-v":
+                step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
+                    pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched
+                )
+                shard_fn = lambda p: dict(  # noqa: E731
+                    p,
+                    blocks=shard_blocks_vshape_ep(p["blocks"], _stages, _ep),
+                )
+                unshard_fn = lambda p: dict(  # noqa: E731
+                    p, blocks=unshard_blocks_vshape_ep(p["blocks"])
+                )
+            elif _sched in ("interleaved", "zb"):
                 _v = getattr(args, "virtual_stages", None)
                 if _v is None:
                     _v = 2 if _sched == "interleaved" else 1
@@ -801,10 +814,14 @@ def cmd_lm(args) -> int:
                     shard_blocks_interleaved,
                     shard_blocks_interleaved_tp,
                     shard_blocks_pp_tp,
+                    shard_blocks_vshape,
+                    shard_blocks_vshape_tp,
                     unshard_blocks,
                     unshard_blocks_interleaved,
                     unshard_blocks_interleaved_tp,
                     unshard_blocks_pp_tp,
+                    unshard_blocks_vshape,
+                    unshard_blocks_vshape_tp,
                 )
                 from tpu_dist_nn.train.lm_trainer import (
                     make_pipeline_sp_lm_train_step,
@@ -831,7 +848,31 @@ def cmd_lm(args) -> int:
                 schedule_handled = True  # pp x sp consumes --schedule itself
                 _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
                 _sched, _tp = args.schedule, args.tensor_parallel
-                if _sched in ("interleaved", "zb"):
+                if _sched == "zb-v":
+                    step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
+                        pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
+                        schedule=_sched, tensor_parallel=_tp,
+                    )
+                    if _tp > 1:
+                        shard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=shard_blocks_vshape_tp(
+                                p["blocks"], cfg, _stages, _tp
+                            ),
+                        )
+                        unshard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=unshard_blocks_vshape_tp(p["blocks"], cfg),
+                        )
+                    else:
+                        shard_fn = lambda p: dict(  # noqa: E731
+                            p,
+                            blocks=shard_blocks_vshape(p["blocks"], _stages),
+                        )
+                        unshard_fn = lambda p: dict(  # noqa: E731
+                            p, blocks=unshard_blocks_vshape(p["blocks"])
+                        )
+                elif _sched in ("interleaved", "zb"):
                     # Table executors x SP: virtual-stage chunk layout
                     # (same --virtual-stages defaulting as the dense
                     # pipelined path below).
@@ -925,7 +966,22 @@ def cmd_lm(args) -> int:
                     pp_tp_mesh, cfg, _stages, _mb, opt, schedule=_sched,
                     num_virtual=_v, tensor_parallel=_tp,
                 )
-                if _sched in ("interleaved", "zb"):
+                if _sched == "zb-v":
+                    from tpu_dist_nn.parallel.transformer_pipeline import (
+                        shard_blocks_vshape_tp,
+                        unshard_blocks_vshape_tp,
+                    )
+
+                    shard_fn = lambda p: dict(  # noqa: E731
+                        p,
+                        blocks=shard_blocks_vshape_tp(
+                            p["blocks"], cfg, _stages, _tp
+                        ),
+                    )
+                    unshard_fn = lambda p: dict(  # noqa: E731
+                        p, blocks=unshard_blocks_vshape_tp(p["blocks"], cfg)
+                    )
+                elif _sched in ("interleaved", "zb"):
                     shard_fn = lambda p: dict(  # noqa: E731
                         p,
                         blocks=shard_blocks_interleaved_tp(

@@ -856,3 +856,51 @@ def make_pipeline_ep_lm_zb_grad(mesh, cfg: MoEConfig, num_virtual: int,
     return make_pipeline_ep_lm_interleaved_grad(
         mesh, cfg, num_virtual, num_microbatches, attn_fn, tables=tables
     )
+
+
+def shard_blocks_vshape_ep(blocks: dict, num_stages: int, n_ep: int) -> dict:
+    """V-shape chunk layout with expert sharding: EP-sharded leaves
+    ``(S, 2, n_ep, L/(2S), E/n_ep, ...)``, replicated
+    ``(S, 2, L/(2S), ...)`` — :func:`shard_blocks_interleaved_ep`'s
+    pattern on the ZB-V placement."""
+    from tpu_dist_nn.parallel.transformer_pipeline import _vshape_regroup
+
+    ep = ep_shard_blocks(blocks, n_ep)  # sharded leaves: (n_ep, L, ...)
+    out = {}
+    for k, val in ep.items():
+        if k in EP_SHARDED:
+            out[k] = jnp.moveaxis(
+                jax.vmap(lambda a: _vshape_regroup(a, num_stages))(val), 0, 2
+            )
+        else:
+            out[k] = _vshape_regroup(val, num_stages)
+    return out
+
+
+def unshard_blocks_vshape_ep(staged: dict) -> dict:
+    """Inverse of :func:`shard_blocks_vshape_ep`."""
+    from tpu_dist_nn.parallel.transformer_pipeline import _vshape_ungroup
+
+    ep = {}
+    for k, val in staged.items():
+        if k in EP_SHARDED:
+            ep[k] = jax.vmap(_vshape_ungroup)(jnp.moveaxis(val, 2, 0))
+        else:
+            ep[k] = _vshape_ungroup(val)
+    return ep_unshard_blocks(ep)
+
+
+def make_pipeline_ep_lm_zb_v_grad(mesh, cfg: MoEConfig,
+                                  num_microbatches: int,
+                                  attn_fn=dot_product_attention):
+    """ZB-V x expert parallelism: the V-placement zero-bubble tables
+    with MoE chunk bodies and the aux channel (the aux's input grad
+    rides BWD_B, weight grad BWD_W — interleaved.make_interleaved_1f1b).
+    ``params["blocks"]`` in :func:`shard_blocks_vshape_ep` layout."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE
+    from tpu_dist_nn.parallel.schedule_table import build_zb_v
+
+    tables = build_zb_v(mesh.shape[AXIS_STAGE], num_microbatches)
+    return make_pipeline_ep_lm_interleaved_grad(
+        mesh, cfg, 2, num_microbatches, attn_fn, tables=tables
+    )

@@ -287,6 +287,25 @@ def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
     return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
 
 
+def _vshape_regroup(a, num_stages: int):
+    """``(L, ...) -> (S, 2, L/(2S), ...)``: THE V-shape placement —
+    device ``s`` holds chunk ``s`` (slot 0, descending leg) and chunk
+    ``2S-1-s`` (slot 1, ascending leg)."""
+    S = num_stages
+    V = 2 * S
+    L = a.shape[0]
+    if L % V:
+        raise ValueError(f"n_layers={L} not divisible by 2*stages={V}")
+    ch = a.reshape(V, L // V, *a.shape[1:])
+    return jnp.stack([ch[:S], ch[S:][::-1]], axis=1)
+
+
+def _vshape_ungroup(a):
+    """Inverse of :func:`_vshape_regroup`."""
+    first, second = a[:, 0], a[:, 1][::-1]
+    return jnp.concatenate([first, second], axis=0).reshape(-1, *a.shape[3:])
+
+
 def shard_blocks_vshape(blocks: dict, num_stages: int) -> dict:
     """Stacked blocks ``(L, ...)`` -> the ZB-V V-SHAPE chunk layout
     ``(S, 2, L/(2S), ...)``: device ``s`` holds chunk ``s`` (slot 0,
@@ -294,30 +313,96 @@ def shard_blocks_vshape(blocks: dict, num_stages: int) -> dict:
     leg) — the forward runs down the device line and back up, so the
     input feed (chunk 0) and the loss tail (chunk 2S-1) are
     CO-LOCATED on device 0 (schedule_table.build_zb_v)."""
-    S = num_stages
-    V = 2 * S
-    L = jax.tree.leaves(blocks)[0].shape[0]
-    if L % V:
-        raise ValueError(f"n_layers={L} not divisible by 2*stages={V}")
-
-    def regroup(a):
-        ch = a.reshape(V, L // V, *a.shape[1:])
-        return jnp.stack([ch[:S], ch[S:][::-1]], axis=1)
-
-    return jax.tree.map(regroup, blocks)
+    return jax.tree.map(lambda a: _vshape_regroup(a, num_stages), blocks)
 
 
 def unshard_blocks_vshape(staged: dict) -> dict:
     """Inverse of :func:`shard_blocks_vshape`: back to ``(L, ...)``."""
+    return jax.tree.map(_vshape_ungroup, staged)
 
-    def ungroup(a):
-        S = a.shape[0]
-        first, second = a[:, 0], a[:, 1][::-1]
-        return jnp.concatenate([first, second], axis=0).reshape(
-            -1, *a.shape[3:]
-        )
 
-    return jax.tree.map(ungroup, staged)
+def shard_blocks_vshape_tp(blocks: dict, cfg: TransformerConfig,
+                           num_stages: int, n_tp: int) -> dict:
+    """V-shape chunk layout with Megatron sharding: TP-sharded leaves
+    ``(S, 2, N, L/(2S), ...)``, replicated ``(S, 2, L/(2S), ...)`` —
+    :func:`shard_blocks_interleaved_tp`'s pattern on the V placement."""
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        TP_REPLICATED,
+        tp_shard_blocks,
+    )
+
+    tp = tp_shard_blocks(blocks, cfg, n_tp)  # sharded leaves: (N, L, ...)
+    out = {}
+    for k, val in tp.items():
+        if k in TP_REPLICATED:
+            out[k] = _vshape_regroup(val, num_stages)
+        else:  # (N, L, ...) -> (N, S, 2, Lc, ...) -> (S, 2, N, Lc, ...)
+            out[k] = jnp.moveaxis(
+                jax.vmap(lambda a: _vshape_regroup(a, num_stages))(val), 0, 2
+            )
+    return out
+
+
+def unshard_blocks_vshape_tp(staged: dict, cfg: TransformerConfig) -> dict:
+    """Inverse of :func:`shard_blocks_vshape_tp`."""
+    from tpu_dist_nn.parallel.tensor_parallel import (
+        TP_REPLICATED,
+        tp_unshard_blocks,
+    )
+
+    tp = {}
+    for k, val in staged.items():
+        if k in TP_REPLICATED:
+            tp[k] = _vshape_ungroup(val)
+        else:  # (S, 2, N, Lc, ...) -> (N, L, ...)
+            tp[k] = jax.vmap(_vshape_ungroup)(jnp.moveaxis(val, 2, 0))
+    return tp_unshard_blocks(tp, cfg)
+
+
+def make_pipeline_tp_lm_zb_v_grad(mesh, cfg: TransformerConfig,
+                                  num_microbatches: int,
+                                  attn_fn=dot_product_attention):
+    """ZB-V x Megatron TP: the V-placement zero-bubble tables played
+    back with psum-bearing chunk bodies — legal by the same
+    [device, tick] model-invariance argument as every scheduled x TP
+    composition. ``params["blocks"]`` in :func:`shard_blocks_vshape_tp`
+    layout."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zb_v
+
+    tables = build_zb_v(mesh.shape[_AS], num_microbatches)
+    return make_pipeline_tp_lm_interleaved_grad(
+        mesh, cfg, 2, num_microbatches, attn_fn, tables=tables
+    )
+
+
+def make_pipeline_sp_lm_zb_v_grad(mesh, cfg: TransformerConfig,
+                                  num_microbatches: int,
+                                  mode: str = "ring"):
+    """ZB-V x sequence parallelism: V-placement tables with ring
+    (group-local rotation) or Ulysses attention in the chunk bodies.
+    Blocks in plain :func:`shard_blocks_vshape` layout."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zb_v
+
+    tables = build_zb_v(mesh.shape[_AS], num_microbatches)
+    return make_pipeline_sp_lm_interleaved_grad(
+        mesh, cfg, 2, num_microbatches, mode, tables=tables
+    )
+
+
+def make_pipeline_tp_sp_lm_zb_v_grad(mesh, cfg: TransformerConfig,
+                                     num_microbatches: int,
+                                     mode: str = "ring"):
+    """ZB-V x Megatron TP x sequence parallelism: the V-placement
+    tables at 4D. Blocks in :func:`shard_blocks_vshape_tp` layout."""
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zb_v
+
+    tables = build_zb_v(mesh.shape[_AS], num_microbatches)
+    return make_pipeline_tp_sp_lm_interleaved_grad(
+        mesh, cfg, 2, num_microbatches, mode, tables=tables
+    )
 
 
 def make_pipeline_lm_zb_v_grad(mesh, cfg: TransformerConfig,

@@ -120,18 +120,14 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
         )
     if schedule == "zb-v":
         # Zero-bubble on the V-shape placement: v=2 fixed by the
-        # placement; blocks in shard_blocks_vshape layout. TP/SP
-        # compositions are not wired for this placement yet.
+        # placement; blocks in shard_blocks_vshape (or _tp) layout.
         from tpu_dist_nn.parallel import transformer_pipeline as tpl
 
-        if tensor_parallel > 1:
-            raise ValueError(
-                "schedule='zb-v' has no tensor-parallel layout yet: "
-                "use schedule='zb' for ZB x TP"
-            )
-        vag = tpl.make_pipeline_lm_zb_v_grad(
-            mesh, cfg, num_microbatches, attn
+        make = (
+            tpl.make_pipeline_tp_lm_zb_v_grad
+            if tensor_parallel > 1 else tpl.make_pipeline_lm_zb_v_grad
         )
+        vag = make(mesh, cfg, num_microbatches, attn)
         return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule in ("interleaved", "zb"):
         # Both ride the table executor on the shard_blocks_interleaved
@@ -205,12 +201,16 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
-    if schedule == "zb-v":
-        raise ValueError(
-            "schedule='zb-v' has no expert-parallel composition yet: "
-            "use schedule='zb' for ZB x EP"
-        )
     attn_fn = _resolve_attn_fn(attn_fn)
+    if schedule == "zb-v":
+        from tpu_dist_nn.parallel.expert_parallel import (
+            make_pipeline_ep_lm_zb_v_grad,
+        )
+
+        vag = make_pipeline_ep_lm_zb_v_grad(
+            mesh, cfg, num_microbatches, attn_fn
+        )
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule in ("interleaved", "zb"):
         make = (
             make_pipeline_ep_lm_interleaved_grad
@@ -265,16 +265,18 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
-    if schedule == "zb-v":
-        raise ValueError(
-            "schedule='zb-v' has no sequence-parallel composition yet: "
-            "use schedule='zb' for ZB x SP"
-        )
     if tensor_parallel > 1 and mesh.shape.get(AXIS_MODEL, 1) != tensor_parallel:
         raise ValueError(
             f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
             f"axis has size {mesh.shape.get(AXIS_MODEL, 1)}"
         )
+    if schedule == "zb-v":
+        make = (
+            tpl.make_pipeline_tp_sp_lm_zb_v_grad
+            if tensor_parallel > 1 else tpl.make_pipeline_sp_lm_zb_v_grad
+        )
+        vag = make(mesh, cfg, num_microbatches, mode)
+        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule in ("interleaved", "zb"):
         make = {
             ("interleaved", False): tpl.make_pipeline_sp_lm_interleaved_grad,
