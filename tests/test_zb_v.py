@@ -103,6 +103,24 @@ def test_zb_v_placement_properties():
     assert tails[0].any() and not tails[1:].any()
 
 
+def test_schedule_viz_renders():
+    # The ASCII renderer exercises the routing accessors on both
+    # placements (tools/schedule_viz.py).
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "schedule_viz",
+        pathlib.Path(__file__).parent.parent / "tools" / "schedule_viz.py",
+    )
+    viz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(viz)
+    out = viz.render(build_zb_v(4, 4))
+    assert "placement=vshape" in out and "o" in out and "<" in out
+    out = viz.render(build_zero_bubble(4, 2, 4))
+    assert "placement=megatron" in out and "<" not in out
+
+
 def test_zb_v_shard_roundtrip():
     params = init_transformer(jax.random.key(0), CFG)
     staged = shard_blocks_vshape(params["blocks"], 2)

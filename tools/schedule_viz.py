@@ -1,0 +1,85 @@
+"""Render a compiled schedule table as ASCII — one row per device, one
+column per tick — for debugging table builders and documenting what
+each schedule actually does.
+
+Glyphs: ``.`` idle, ``F`` forward, ``B`` combined backward, ``b``
+split input-grad (BWD_B), ``w`` split weight-grad (BWD_W); the digit
+row below each device row is the op's local chunk slot. Routing
+annotations (``send_rev``): lowercase suffix ``<`` = this op's output
+rides the OPPOSITE ring, ``o`` = self loopback (the ZB-V apex).
+
+Usage:
+
+    PYTHONPATH=. python tools/schedule_viz.py --schedule zb-v --stages 4 --microbatches 4
+    PYTHONPATH=. python tools/schedule_viz.py --schedule zb --stages 4 --virtual 2 --microbatches 8
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from tpu_dist_nn.parallel.schedule_table import (
+    BWD,
+    BWD_B,
+    BWD_W,
+    FWD,
+    ScheduleTables,
+    build_interleaved_1f1b,
+    build_zb_v,
+    build_zero_bubble,
+)
+
+GLYPH = {FWD: "F", BWD: "B", BWD_B: "b", BWD_W: "w"}
+
+
+def render(tb: ScheduleTables, *, chunks: bool = True) -> str:
+    lines = [
+        f"placement={tb.placement}  S={tb.num_devices}  V={tb.num_chunks}  "
+        f"M={tb.num_microbatches}  ticks={tb.ticks}  "
+        f"bubble={tb.bubble_ticks} chunk-ticks  "
+        f"slots: stash={tb.stash_slots} abuf={tb.abuf_slots} "
+        f"gbuf={tb.gbuf_slots} dybuf={tb.dybuf_slots}"
+    ]
+    rev = tb.send_rev_or_default()
+    for s in range(tb.num_devices):
+        ops = []
+        for t in range(tb.ticks):
+            g = GLYPH.get(int(tb.op[s, t]), ".")
+            if g != "." and rev[s, t] == 1:
+                g += "<"
+            elif g != "." and rev[s, t] == 2:
+                g += "o"
+            ops.append(g.ljust(2))
+        lines.append(f"dev {s}: " + "".join(ops))
+        if chunks:
+            cs = [
+                (str(int(tb.chunk[s, t])) if tb.op[s, t] != 0 else " ").ljust(2)
+                for t in range(tb.ticks)
+            ]
+            lines.append("chunk: " + "".join(cs))
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--schedule", choices=["interleaved", "zb", "zb-v"],
+                    default="zb-v")
+    ap.add_argument("--stages", type=int, default=4)
+    ap.add_argument("--virtual", type=int, default=1,
+                    help="chunks per device (interleaved/zb; zb-v is 2 "
+                         "by placement)")
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--no-chunks", action="store_true")
+    args = ap.parse_args()
+    if args.schedule == "zb-v":
+        tb = build_zb_v(args.stages, args.microbatches)
+    elif args.schedule == "zb":
+        tb = build_zero_bubble(args.stages, args.virtual, args.microbatches)
+    else:
+        tb = build_interleaved_1f1b(args.stages, args.virtual, args.microbatches)
+    print(render(tb, chunks=not args.no_chunks))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
