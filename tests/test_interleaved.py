@@ -310,3 +310,20 @@ def test_engine_interleaved_dense_training_matches_gpipe(tmp_path):
     # And interleaved without the placement points at --virtual-stages.
     with pytest.raises(ValueError, match="virtual_stages"):
         eng_g.train(tr, cfg, schedule="interleaved")
+
+
+def test_interleaved_forward_single_device_self_loopback():
+    # S=1, v>1: every chunk hand-off is device-LOCAL, riding the SELF
+    # loopback channel. Regression for the channel-major receive
+    # tables: the legacy abuf_write view is empty for self hops, so an
+    # executor reading only the fwd wire would silently consume zeros
+    # for every chunk after the first (wrong outputs, no error).
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_forward
+
+    mesh = build_mesh(MeshSpec(stage=1, data=1))
+    fwd = make_interleaved_forward(mesh, lambda p, st, x: x * p["k"],
+                                   num_virtual=2, num_microbatches=2)
+    params = {"k": jnp.asarray([[[2.0], [3.0]]])}  # (S=1, v=2, 1)
+    xs = jnp.asarray([[1.0, 2.0, 3.0, 4.0], [5.0, 6.0, 7.0, 8.0]])
+    out = jax.jit(lambda p, x: fwd(x, p, {}))(params, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xs) * 6.0)

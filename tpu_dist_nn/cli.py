@@ -527,6 +527,14 @@ def cmd_lm(args) -> int:
     # seq-parallel compatibility checks, with or without --stages.)
     if not moe and args.expert_parallel > 1:
         raise ValueError("--expert-parallel requires --experts > 0")
+    if args.schedule == "zb-v" and getattr(args, "virtual_stages", None) not in (
+        None, 2,
+    ):
+        raise ValueError(
+            "--schedule zb-v fixes the chunk count at 2 per device (the "
+            "V placement's two legs); drop --virtual-stages or use "
+            "--schedule zb for a free chunk count"
+        )
     if args.tensor_parallel > 1:
         if args.stages <= 1:
             raise ValueError(

@@ -660,6 +660,42 @@ def make_pipeline_ep_lm_loss(mesh, cfg: MoEConfig, num_stages: int,
     return loss_fn
 
 
+def _ep_sched_stage_and_tail(cfg: MoEConfig, attn_fn, aux_scale: float,
+                             M: int, n_shards: int):
+    """Chunk/stage body + masked-CE tail shared by every scheduled
+    MoE factory (the `_lm_sched_stage_and_tail` pattern — one
+    definition so the 1F1B, interleaved, zb, and zb-v EP paths cannot
+    drift numerically). ``aux_scale`` pre-folds the router aux weight
+    and the 1/(chunks * M * shards) normalization into each
+    contribution (the executors' pre-scaled ``with_aux`` contract)."""
+    from tpu_dist_nn.models.transformer import maybe_remat, unembed
+
+    ep_ffn = _make_ep_ffn(cfg)
+
+    def stage_fn(stage_blocks, _static, x):
+        # The executor stripped the stage dim; EP-sharded leaves still
+        # carry their length-1 expert-shard dim.
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in stage_blocks.items()
+        }
+        apply = maybe_remat(cfg, moe_block_apply)
+
+        def body(carry, block):
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
+            return y, aux
+
+        y, auxs = lax.scan(body, x, blocks)
+        return y, jnp.mean(auxs) * aux_scale
+
+    def tail_fn(tail_params, y, targets_f):
+        # Per-(microbatch, shard) CE contribution; shards cover
+        # (data, expert) jointly, so the global token mean divides by
+        # M * n_shards.
+        return next_token_ce(unembed(tail_params, y), targets_f) / (M * n_shards)
+
+    return stage_fn, tail_fn
+
+
 def make_pipeline_ep_lm_1f1b_grad(mesh, cfg: MoEConfig, num_stages: int,
                                   num_microbatches: int,
                                   attn_fn=dot_product_attention):
@@ -686,7 +722,6 @@ def make_pipeline_ep_lm_1f1b_grad(mesh, cfg: MoEConfig, num_stages: int,
     oracle's weighted mean over blocks and groups. ``params["blocks"]``
     in :func:`shard_blocks_pp_ep` layout; grads come back in it.
     """
-    from tpu_dist_nn.models.transformer import maybe_remat, unembed
     from tpu_dist_nn.parallel.mesh import AXIS_STAGE
     from tpu_dist_nn.parallel.one_f_one_b import make_1f1b
     from tpu_dist_nn.parallel.transformer_pipeline import _lm_vag_from_mapped
@@ -698,29 +733,10 @@ def make_pipeline_ep_lm_1f1b_grad(mesh, cfg: MoEConfig, num_stages: int,
         )
     S, M = num_stages, num_microbatches
     n_shards = mesh.shape[AXIS_DATA] * n_ep
-    ep_ffn = _make_ep_ffn(cfg)
-    aux_scale = cfg.router_aux_weight / (S * M * n_shards)
-
-    def stage_fn(stage_blocks, _static, x):
-        # The executor stripped the stage dim; EP-sharded leaves still
-        # carry their length-1 expert-shard dim.
-        blocks = {
-            k: (v[0] if k in EP_SHARDED else v) for k, v in stage_blocks.items()
-        }
-        apply = maybe_remat(cfg, moe_block_apply)
-
-        def body(carry, block):
-            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
-            return y, aux
-
-        y, auxs = lax.scan(body, x, blocks)
-        return y, jnp.mean(auxs) * aux_scale
-
-    def tail_fn(tail_params, y, targets_f):
-        # Per-(microbatch, shard) CE contribution; shards cover
-        # (data, expert) jointly, so the global token mean divides by
-        # M * n_shards.
-        return next_token_ce(unembed(tail_params, y), targets_f) / (M * n_shards)
+    stage_fn, tail_fn = _ep_sched_stage_and_tail(
+        cfg, attn_fn, cfg.router_aux_weight / (S * M * n_shards),
+        M, n_shards,
+    )
 
     blocks_spec = {
         k: (P(AXIS_STAGE, AXIS_EXPERT) if k in EP_SHARDED else P(AXIS_STAGE))
@@ -791,7 +807,6 @@ def make_pipeline_ep_lm_interleaved_grad(mesh, cfg: MoEConfig,
     weight grad through BWD_W — interleaved.make_interleaved_1f1b).
     ``params["blocks"]`` in :func:`shard_blocks_interleaved_ep` layout.
     """
-    from tpu_dist_nn.models.transformer import maybe_remat, unembed
     from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
     from tpu_dist_nn.parallel.mesh import AXIS_STAGE
     from tpu_dist_nn.parallel.transformer_pipeline import _lm_vag_from_mapped
@@ -804,24 +819,10 @@ def make_pipeline_ep_lm_interleaved_grad(mesh, cfg: MoEConfig,
     S = mesh.shape[AXIS_STAGE]
     V, M = S * num_virtual, num_microbatches
     n_shards = mesh.shape[AXIS_DATA] * n_ep
-    ep_ffn = _make_ep_ffn(cfg)
-    aux_scale = cfg.router_aux_weight / (V * M * n_shards)
-
-    def stage_fn(chunk_blocks, _static, x):
-        blocks = {
-            k: (v[0] if k in EP_SHARDED else v) for k, v in chunk_blocks.items()
-        }
-        apply = maybe_remat(cfg, moe_block_apply)
-
-        def body(carry, block):
-            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
-            return y, aux
-
-        y, auxs = lax.scan(body, x, blocks)
-        return y, jnp.mean(auxs) * aux_scale
-
-    def tail_fn(tail_params, y, targets_f):
-        return next_token_ce(unembed(tail_params, y), targets_f) / (M * n_shards)
+    stage_fn, tail_fn = _ep_sched_stage_and_tail(
+        cfg, attn_fn, cfg.router_aux_weight / (V * M * n_shards),
+        M, n_shards,
+    )
 
     blocks_spec = {
         k: (

@@ -482,8 +482,25 @@ def make_interleaved_forward(
     xs_spec = P(None, *microbatch_spec)
     tb = {
         name: jnp.asarray(getattr(tables, name))
-        for name in ("op", "chunk", "mb", "abuf_read", "abuf_write")
+        for name in ("op", "chunk", "mb", "abuf_read")
     }
+    # Channel-major receives: forward-only schedules use the fwd ring
+    # and, at S=1 (where every hop is device-local), the self loopback.
+    # A reverse-ring forward hop (send_rev == 1) would need the bwd
+    # wire this executor does not carry — no forward-only builder
+    # emits one; fail loudly if that changes.
+    import numpy as _np
+
+    send_rev_np = tables.send_rev_or_default()
+    if (_np.asarray(send_rev_np) == 1).any():
+        raise ValueError(
+            "forward-only executor has no reverse ring: tables contain "
+            "send_rev == 1 hops (use the training executor's wire model)"
+        )
+    tb["send_rev"] = jnp.asarray(send_rev_np)
+    for name, arr in tables.channel_tables().items():
+        if name.startswith(("fwdch", "selfch")):
+            tb[name] = jnp.asarray(arr)
 
     def device_fn(xs, chunk_params, chunk_static):
         sp = jax.tree.map(lambda a: a[0], chunk_params)
@@ -504,20 +521,23 @@ def make_interleaved_forward(
         zeros_wire = vcast(jnp.zeros(mb_shape, dt))
         carry0 = (
             zeros_wire,                            # fwd ring payload
+            zeros_wire,                            # self loopback
             vcast(jnp.zeros((A, *mb_shape), dt)),  # activation recv buf
             vcast(jnp.zeros((M, *mb_shape), dt)),  # per-mb outputs
         )
 
         def tick(carry, t):
-            fwd_wire, abuf, outs = carry
-            aw = row["abuf_write"][t]
-            abuf = jnp.where(
-                aw >= 0,
-                lax.dynamic_update_index_in_dim(
-                    abuf, fwd_wire, jnp.clip(aw, 0, A - 1), 0
-                ),
-                abuf,
-            )
+            fwd_wire, self_wire, abuf, outs = carry
+            for name, wire in (("fwdch", fwd_wire), ("selfch", self_wire)):
+                dst = row[f"{name}_dst"][t]
+                slot = row[f"{name}_slot"][t]
+                abuf = jnp.where(
+                    dst == 0,
+                    lax.dynamic_update_index_in_dim(
+                        abuf, wire, jnp.clip(slot, 0, A - 1), 0
+                    ),
+                    abuf,
+                )
             g_slot = row["chunk"][t]
             f = row["mb"][t]
             c_global = g_slot * S + s_idx
@@ -550,14 +570,16 @@ def make_interleaved_forward(
                 return jnp.where(is_last, zeros_wire, y), new_outs
 
             send_y, outs = lax.switch(row["op"][t], [idle, fwd], 0)
+            sr = row["send_rev"][t]
+            ring_y = jnp.where(sr == 2, zeros_wire, send_y)
             with jax.named_scope("interleaved_fwd_ring_hop"):
                 nxt = (
-                    lax.ppermute(send_y, AXIS_STAGE, fwd_perm)
-                    if S > 1 else send_y
+                    lax.ppermute(ring_y, AXIS_STAGE, fwd_perm)
+                    if S > 1 else ring_y
                 )
-            return (nxt, abuf, outs), None
+            return (nxt, send_y, abuf, outs), None
 
-        (_w, _a, outs), _ = lax.scan(tick, carry0, jnp.arange(T))
+        (_w, _sf, _a, outs), _ = lax.scan(tick, carry0, jnp.arange(T))
         # Outputs live only on the last chunk's device (S-1): replicate.
         return lax.psum(outs, AXIS_STAGE)
 

@@ -379,8 +379,11 @@ def _dryrun_transformer_sp_tp(n_devices: int) -> None:
         jax.block_until_ready(g)
         assert float(loss) > 0
 
+    if n_devices % 4 == 0:
         # SP x ZeRO-1 (round 4): sharded moments over the data axis of
-        # the (seq, data) mesh, ring loss over seq.
+        # the (seq, data) mesh, ring loss over seq. Own guard — it must
+        # keep running on 4-device hosts, not only when the 8-device
+        # 3-way block above does.
         import optax
 
         from tpu_dist_nn.parallel.zero import make_sp_sharded_lm_train_step
