@@ -497,6 +497,79 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _default_virtual(args, sched: str) -> int:
+    """--virtual-stages defaulting shared by every pipelined-LM branch:
+    interleaved is pointless at v=1 (it IS the v>1 placement), zb's
+    documented default is the classic contiguous v=1 placement, and
+    zb-v's placement fixes v=2."""
+    if sched == "zb-v":
+        return 2
+    v = getattr(args, "virtual_stages", None)
+    if v is None:
+        v = 2 if sched == "interleaved" else 1
+    return v
+
+
+def _lm_block_layout(sched: str, stages: int, num_virtual: int, *,
+                     cfg=None, tp: int = 1, ep: int = 0):
+    """-> ``(shard_blocks_fn, unshard_blocks_fn)`` for the pipelined-LM
+    param layout implied by (schedule, sharding) — ONE dispatch shared
+    by the MoE, pp x sp, and pp x tp branches of ``cmd_lm`` so a new
+    schedule cannot land in one branch and silently mis-lay the
+    others. ``ep > 0`` selects the expert-sharded family (``cfg``
+    unused), ``tp > 1`` the Megatron family (needs ``cfg``), else the
+    dense family."""
+    if ep:
+        from tpu_dist_nn.parallel import expert_parallel as m
+
+        if sched == "zb-v":
+            return (
+                lambda b: m.shard_blocks_vshape_ep(b, stages, ep),
+                m.unshard_blocks_vshape_ep,
+            )
+        if sched in ("interleaved", "zb"):
+            return (
+                lambda b: m.shard_blocks_interleaved_ep(
+                    b, stages, num_virtual, ep
+                ),
+                m.unshard_blocks_interleaved_ep,
+            )
+        return (
+            lambda b: m.shard_blocks_pp_ep(b, stages, ep),
+            m.unshard_blocks_pp_ep,
+        )
+    from tpu_dist_nn.parallel import transformer_pipeline as m
+
+    if tp > 1:
+        if sched == "zb-v":
+            return (
+                lambda b: m.shard_blocks_vshape_tp(b, cfg, stages, tp),
+                lambda b: m.unshard_blocks_vshape_tp(b, cfg),
+            )
+        if sched in ("interleaved", "zb"):
+            return (
+                lambda b: m.shard_blocks_interleaved_tp(
+                    b, cfg, stages, num_virtual, tp
+                ),
+                lambda b: m.unshard_blocks_interleaved_tp(b, cfg),
+            )
+        return (
+            lambda b: m.shard_blocks_pp_tp(b, cfg, stages, tp),
+            lambda b: m.unshard_blocks_pp_tp(b, cfg),
+        )
+    if sched == "zb-v":
+        return (
+            lambda b: m.shard_blocks_vshape(b, stages),
+            m.unshard_blocks_vshape,
+        )
+    if sched in ("interleaved", "zb"):
+        return (
+            lambda b: m.shard_blocks_interleaved(b, stages, num_virtual),
+            m.unshard_blocks_interleaved,
+        )
+    return (lambda b: m.shard_blocks(b, stages), m.unshard_blocks)
+
+
 def cmd_lm(args) -> int:
     """Train + evaluate the Tiny-Transformer LM (BASELINE configs[4]).
 
@@ -660,14 +733,6 @@ def cmd_lm(args) -> int:
             # Pipeline x expert parallelism: MoE blocks pipelined over
             # `stage`, experts sharded over `expert` inside each stage,
             # batch over (data, expert) — round 4, previously rejected.
-            from tpu_dist_nn.parallel.expert_parallel import (
-                shard_blocks_interleaved_ep,
-                shard_blocks_pp_ep,
-                shard_blocks_vshape_ep,
-                unshard_blocks_interleaved_ep,
-                unshard_blocks_pp_ep,
-                unshard_blocks_vshape_ep,
-            )
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
             from tpu_dist_nn.train.lm_trainer import (
                 make_pipeline_moe_lm_train_step,
@@ -692,44 +757,16 @@ def cmd_lm(args) -> int:
             schedule_handled = True  # MoE x pp consumes --schedule itself
             _stages, _mb, _sched = args.stages, args.microbatches, args.schedule
             _ep = max(ep, 1)
-            if _sched == "zb-v":
-                step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
-                    pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched
-                )
-                shard_fn = lambda p: dict(  # noqa: E731
-                    p,
-                    blocks=shard_blocks_vshape_ep(p["blocks"], _stages, _ep),
-                )
-                unshard_fn = lambda p: dict(  # noqa: E731
-                    p, blocks=unshard_blocks_vshape_ep(p["blocks"])
-                )
-            elif _sched in ("interleaved", "zb"):
-                _v = getattr(args, "virtual_stages", None)
-                if _v is None:
-                    _v = 2 if _sched == "interleaved" else 1
-                step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
-                    pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched,
-                    num_virtual=_v,
-                )
-                shard_fn = lambda p: dict(  # noqa: E731
-                    p,
-                    blocks=shard_blocks_interleaved_ep(
-                        p["blocks"], _stages, _v, _ep
-                    ),
-                )
-                unshard_fn = lambda p: dict(  # noqa: E731
-                    p, blocks=unshard_blocks_interleaved_ep(p["blocks"])
-                )
-            else:
-                step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
-                    pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched
-                )
-                shard_fn = lambda p: dict(  # noqa: E731
-                    p, blocks=shard_blocks_pp_ep(p["blocks"], _stages, _ep)
-                )
-                unshard_fn = lambda p: dict(  # noqa: E731
-                    p, blocks=unshard_blocks_pp_ep(p["blocks"])
-                )
+            _v = _default_virtual(args, _sched)
+            step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
+                pp_ep_mesh, cfg, _stages, _mb, opt, schedule=_sched,
+                num_virtual=_v,
+            )
+            _shard_b, _unshard_b = _lm_block_layout(
+                _sched, _stages, _v, ep=_ep
+            )
+            shard_fn = lambda p: dict(p, blocks=_shard_b(p["blocks"]))  # noqa: E731
+            unshard_fn = lambda p: dict(p, blocks=_unshard_b(p["blocks"]))  # noqa: E731
         elif args.seq_parallel > 1:
             # Long-context MoE (round 4, previously "dense LM only"):
             # sequence parallelism x expert parallelism on the flat
@@ -817,20 +854,6 @@ def cmd_lm(args) -> int:
                 # Ulysses attention inside the stage), batch over
                 # `data`. Rows carry seq_len+1 tokens (the sp loss
                 # masks position 0 instead of slicing).
-                from tpu_dist_nn.parallel.transformer_pipeline import (
-                    shard_blocks,
-                    shard_blocks_interleaved,
-                    shard_blocks_interleaved_tp,
-                    shard_blocks_pp_tp,
-                    shard_blocks_vshape,
-                    shard_blocks_vshape_tp,
-                    unshard_blocks,
-                    unshard_blocks_interleaved,
-                    unshard_blocks_interleaved_tp,
-                    unshard_blocks_pp_tp,
-                    unshard_blocks_vshape,
-                    unshard_blocks_vshape_tp,
-                )
                 from tpu_dist_nn.train.lm_trainer import (
                     make_pipeline_sp_lm_train_step,
                 )
@@ -856,96 +879,20 @@ def cmd_lm(args) -> int:
                 schedule_handled = True  # pp x sp consumes --schedule itself
                 _stages, _mb, _mode = args.stages, args.microbatches, args.sp_mode
                 _sched, _tp = args.schedule, args.tensor_parallel
-                if _sched == "zb-v":
-                    step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
-                        pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
-                        schedule=_sched, tensor_parallel=_tp,
-                    )
-                    if _tp > 1:
-                        shard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=shard_blocks_vshape_tp(
-                                p["blocks"], cfg, _stages, _tp
-                            ),
-                        )
-                        unshard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=unshard_blocks_vshape_tp(p["blocks"], cfg),
-                        )
-                    else:
-                        shard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=shard_blocks_vshape(p["blocks"], _stages),
-                        )
-                        unshard_fn = lambda p: dict(  # noqa: E731
-                            p, blocks=unshard_blocks_vshape(p["blocks"])
-                        )
-                elif _sched in ("interleaved", "zb"):
-                    # Table executors x SP: virtual-stage chunk layout
-                    # (same --virtual-stages defaulting as the dense
-                    # pipelined path below).
-                    _v = getattr(args, "virtual_stages", None)
-                    if _v is None:
-                        _v = 2 if _sched == "interleaved" else 1
-                    step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
-                        pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
-                        schedule=_sched, num_virtual=_v, tensor_parallel=_tp,
-                    )
-                    if _tp > 1:
-                        shard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=shard_blocks_interleaved_tp(
-                                p["blocks"], cfg, _stages, _v, _tp
-                            ),
-                        )
-                        unshard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=unshard_blocks_interleaved_tp(
-                                p["blocks"], cfg
-                            ),
-                        )
-                    else:
-                        shard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=shard_blocks_interleaved(
-                                p["blocks"], _stages, _v
-                            ),
-                        )
-                        unshard_fn = lambda p: dict(  # noqa: E731
-                            p, blocks=unshard_blocks_interleaved(p["blocks"])
-                        )
-                else:
-                    step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
-                        pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
-                        schedule=_sched, tensor_parallel=_tp,
-                    )
-                    if _tp > 1:
-                        shard_fn = lambda p: dict(  # noqa: E731
-                            p,
-                            blocks=shard_blocks_pp_tp(
-                                p["blocks"], cfg, _stages, _tp
-                            ),
-                        )
-                        unshard_fn = lambda p: dict(  # noqa: E731
-                            p, blocks=unshard_blocks_pp_tp(p["blocks"], cfg)
-                        )
-                    else:
-                        shard_fn = lambda p: dict(  # noqa: E731
-                            p, blocks=shard_blocks(p["blocks"], _stages)
-                        )
-                        unshard_fn = lambda p: dict(  # noqa: E731
-                            p, blocks=unshard_blocks(p["blocks"])
-                        )
+                _v = _default_virtual(args, _sched)
+                step_fn = lambda opt: make_pipeline_sp_lm_train_step(  # noqa: E731
+                    pp_sp_mesh, cfg, _stages, _mb, opt, mode=_mode,
+                    schedule=_sched, num_virtual=_v, tensor_parallel=_tp,
+                )
+                _shard_b, _unshard_b = _lm_block_layout(
+                    _sched, _stages, _v, cfg=cfg, tp=_tp
+                )
+                shard_fn = lambda p: dict(p, blocks=_shard_b(p["blocks"]))  # noqa: E731
+                unshard_fn = lambda p: dict(p, blocks=_unshard_b(p["blocks"]))  # noqa: E731
             elif args.tensor_parallel > 1:
                 # Pipeline x Megatron TP (x DP): previously library-only
                 # (make_pipeline_lm_train_step(tensor_parallel=)), now a
                 # flag. Layouts per schedule as in the pp x sp branch.
-                from tpu_dist_nn.parallel.transformer_pipeline import (
-                    shard_blocks_interleaved_tp,
-                    shard_blocks_pp_tp,
-                    unshard_blocks_interleaved_tp,
-                    unshard_blocks_pp_tp,
-                )
                 from tpu_dist_nn.train.lm_trainer import (
                     make_pipeline_lm_train_step,
                 )
@@ -967,49 +914,16 @@ def cmd_lm(args) -> int:
                     args.stages, args.microbatches, args.tensor_parallel
                 )
                 _sched = args.schedule
-                _v = getattr(args, "virtual_stages", None)
-                if _v is None:
-                    _v = 2 if _sched == "interleaved" else 1
+                _v = _default_virtual(args, _sched)
                 step_fn = lambda opt: make_pipeline_lm_train_step(  # noqa: E731
                     pp_tp_mesh, cfg, _stages, _mb, opt, schedule=_sched,
                     num_virtual=_v, tensor_parallel=_tp,
                 )
-                if _sched == "zb-v":
-                    from tpu_dist_nn.parallel.transformer_pipeline import (
-                        shard_blocks_vshape_tp,
-                        unshard_blocks_vshape_tp,
-                    )
-
-                    shard_fn = lambda p: dict(  # noqa: E731
-                        p,
-                        blocks=shard_blocks_vshape_tp(
-                            p["blocks"], cfg, _stages, _tp
-                        ),
-                    )
-                    unshard_fn = lambda p: dict(  # noqa: E731
-                        p, blocks=unshard_blocks_vshape_tp(p["blocks"], cfg)
-                    )
-                elif _sched in ("interleaved", "zb"):
-                    shard_fn = lambda p: dict(  # noqa: E731
-                        p,
-                        blocks=shard_blocks_interleaved_tp(
-                            p["blocks"], cfg, _stages, _v, _tp
-                        ),
-                    )
-                    unshard_fn = lambda p: dict(  # noqa: E731
-                        p,
-                        blocks=unshard_blocks_interleaved_tp(p["blocks"], cfg),
-                    )
-                else:
-                    shard_fn = lambda p: dict(  # noqa: E731
-                        p,
-                        blocks=shard_blocks_pp_tp(
-                            p["blocks"], cfg, _stages, _tp
-                        ),
-                    )
-                    unshard_fn = lambda p: dict(  # noqa: E731
-                        p, blocks=unshard_blocks_pp_tp(p["blocks"], cfg)
-                    )
+                _shard_b, _unshard_b = _lm_block_layout(
+                    _sched, _stages, _v, cfg=cfg, tp=_tp
+                )
+                shard_fn = lambda p: dict(p, blocks=_shard_b(p["blocks"]))  # noqa: E731
+                unshard_fn = lambda p: dict(p, blocks=_unshard_b(p["blocks"]))  # noqa: E731
             else:
                 mesh = build_mesh(
                     MeshSpec(stage=args.stages, data=args.data_parallel)
