@@ -80,3 +80,28 @@ def test_deep_pipeline_8stage_experiment(tmp_path):
                 m, dp.SHALLOW_DIST).step_latency(256, 30),
         }
     assert lat["deep_8stage"]["p50_s"] > lat["shallow_3stage"]["p50_s"]
+
+
+def test_four_d_training_example(tmp_path, capsys, monkeypatch):
+    # The 4D composition example (artifacts/four_d_r04): PP x TP x SP
+    # trains on real text under all four schedules and their
+    # trajectories agree to float tolerance. Short step budget for CI.
+    import runpy
+
+    import pytest
+
+    out = tmp_path / "four_d.json"
+    monkeypatch.setattr(
+        sys, "argv", ["four_d_training.py", "--steps", "2",
+                      "--out", str(out)],
+    )
+    with pytest.raises(SystemExit) as exc:
+        runpy.run_path(
+            str(Path(__file__).resolve().parents[1] / "examples"
+                / "four_d_training.py"),
+            run_name="__main__",
+        )
+    assert exc.value.code == 0
+    record = json.loads(out.read_text())
+    assert record["final_loss_spread_across_schedules"] < 1e-3
+    assert set(record["schedules"]) == {"gpipe", "1f1b", "interleaved", "zb"}
