@@ -132,6 +132,63 @@ def scenario_train_lm_pipelined() -> dict:
     }
 
 
+def scenario_train_lm_3d() -> dict:
+    """PP x TP x DP across REAL processes: the stage axis spans the
+    two hosts (inter-stage ppermute hand-offs ride the DCN transport
+    every tick, forward and backward), Megatron psums stay intra-host,
+    and the data axis feeds through the global-batch assembler — the
+    full 3D deployment shape on the reference's production topology
+    (N cooperating processes). Both hosts must see the identical loss
+    stream and end with identical weights."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist_nn.data.feed import global_batch, shard_for_host
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.parallel.mesh import AXIS_DATA, MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.multihost import to_host_numpy
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks_pp_tp
+    from tpu_dist_nn.train.lm_trainer import (
+        LMTrainConfig,
+        make_pipeline_lm_train_step,
+        train_lm,
+    )
+
+    mesh = build_mesh(MeshSpec(stage=2, model=2, data=2))
+    cfg = TransformerConfig(
+        vocab_size=31, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+        max_seq_len=12,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    params = dict(
+        params, blocks=shard_blocks_pp_tp(params["blocks"], cfg, 2, 2)
+    )
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, cfg.vocab_size, (64, 13)).astype(np.int32)
+    local_rows = shard_for_host(rows)
+    batches = [local_rows[i * 8:(i + 1) * 8] for i in range(4)]
+    globalize = lambda b: global_batch(mesh, P(AXIS_DATA, None), b)  # noqa: E731
+    step_fn = lambda opt: make_pipeline_lm_train_step(  # noqa: E731
+        mesh, cfg, 2, 2, opt, schedule="1f1b", tensor_parallel=2
+    )
+    params, history = train_lm(
+        params, cfg, batches,
+        LMTrainConfig(steps=4, log_every=1),
+        mesh=mesh, num_stages=2, num_microbatches=2, globalize=globalize,
+        step_fn=step_fn,
+    )
+    tok = to_host_numpy(params["tok_embed"])
+    return {
+        "losses": [round(h["loss"], 6) for h in history],
+        "tok_digest": float(np.abs(tok).sum()),
+    }
+
+
 def scenario_step_parity() -> dict:
     """ONE optimizer step on a FIXED global batch: loss and updated
     weights are row-partition-invariant, so this must match the parent's
