@@ -654,6 +654,32 @@ def cmd_lm(args) -> int:
                 f"{prompt_len}-byte prompt leaves {args.seq_len - prompt_len} "
                 f"positions within --seq-len {args.seq_len}"
             )
+        spp = args.sample_pipeline_stages
+        if spp > 1:
+            if args.sample_tensor_parallel > 1:
+                raise ValueError(
+                    "--sample-pipeline-stages and --sample-tensor-parallel "
+                    "are different decode placements: pick one"
+                )
+            if args.temperature != 0:
+                raise ValueError(
+                    "--sample-pipeline-stages decodes greedily "
+                    "(temperature 0) only"
+                )
+            if _jax_process_count() > 1:
+                raise ValueError(
+                    "--sample-pipeline-stages is single-host only"
+                )
+            if spp > len(jax.devices()):
+                raise ValueError(
+                    f"--sample-pipeline-stages {spp} needs {spp} devices; "
+                    f"{len(jax.devices())} available"
+                )
+            if args.layers % spp:
+                raise ValueError(
+                    f"--sample-pipeline-stages {spp} must divide "
+                    f"--layers ({args.layers})"
+                )
         stp = args.sample_tensor_parallel
         if stp > 1:
             if _jax_process_count() > 1:
@@ -1130,7 +1156,27 @@ def cmd_lm(args) -> int:
 
         prompt = encode(args.prompt)[None, :]
         n = args.sample_bytes  # validated to fit before training
-        if args.sample_tensor_parallel > 1:
+        if args.sample_pipeline_stages > 1:
+            # Pipelined decode: generation IN the training placement —
+            # blocks and KV caches sharded over the stage ring
+            # (parallel/pp_generate.py; greedy).
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.parallel.pp_generate import (
+                make_pipeline_generate,
+            )
+            from tpu_dist_nn.parallel.transformer_pipeline import (
+                shard_blocks as _pp_shard_blocks,
+            )
+
+            spp = args.sample_pipeline_stages
+            pp_mesh = build_mesh(MeshSpec(stage=spp))
+            params_pp = dict(
+                params, blocks=_pp_shard_blocks(params["blocks"], spp)
+            )
+            fn = make_pipeline_generate(pp_mesh, cfg, spp, n)
+            full = fn(params_pp, jnp.asarray(prompt))
+            out = full[:, prompt.shape[1]:]
+        elif args.sample_tensor_parallel > 1:
             # Megatron-sharded decode: heads + KV cache split over the
             # model axis (the trained params shard on the fly).
             from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
@@ -1579,6 +1625,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sample-tensor-parallel", type=int, default=1,
                    help="decode --sample-bytes with heads + KV cache "
                         "Megatron-sharded over N devices")
+    p.add_argument("--sample-pipeline-stages", type=int, default=1,
+                   help="decode --sample-bytes IN the pipeline "
+                        "placement: blocks + per-stage KV caches over "
+                        "N stage devices (greedy)")
     p.add_argument("--sp-mode", choices=["ring", "ulysses"], default="ring",
                    help="sequence-parallel decomposition: ring attention "
                         "(K/V rotation, O(T/N) memory) or ulysses "

@@ -36,6 +36,23 @@ from tpu_dist_nn.models.transformer import (
 )
 
 
+def prefill_blocks(blocks: dict, x: jnp.ndarray, cfg: TransformerConfig,
+                   max_len: int):
+    """Run ``x (B, T, D)`` through a stacked block group, filling a
+    ``max_len`` cache for THOSE blocks — the per-stage building block
+    of :func:`prefill` and the pipelined decoder
+    (:mod:`tpu_dist_nn.parallel.pp_generate`)."""
+    T = x.shape[1]
+
+    def body(carry, block):
+        y, k, v = attn_sublayer(block, carry, cfg, return_kv=True)
+        return ffn_sublayer(block, y), (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, blocks)
+    pad = [(0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0)]
+    return x, {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
+
+
 def prefill(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
             max_len: int):
     """Run the prompt ``(B, T)``, filling a ``max_len`` cache.
@@ -44,34 +61,24 @@ def prefill(params: dict, tokens: jnp.ndarray, cfg: TransformerConfig,
     ``logits[:, T-1]`` and decodes from position ``T``.
     """
     params = cfg.cast_params(params)
-    B, T = tokens.shape
+    T = tokens.shape[1]
     if T > max_len:
         raise ValueError(f"prompt length {T} exceeds cache length {max_len}")
     x = embed(params, tokens)
-
-    def body(carry, block):
-        y, k, v = attn_sublayer(block, carry, cfg, return_kv=True)
-        return ffn_sublayer(block, y), (k, v)
-
-    x, (ks, vs) = lax.scan(body, x, params["blocks"])
-    pad = [(0, 0), (0, 0), (0, max_len - T), (0, 0), (0, 0)]
-    cache = {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
+    x, cache = prefill_blocks(params["blocks"], x, cfg, max_len)
     return unembed(params, x), cache
 
 
-def decode_step(params: dict, cache: dict, pos, token: jnp.ndarray,
-                cfg: TransformerConfig):
-    """One decode step: ``token (B,) int32`` at position ``pos``.
-
-    Returns ``(logits (B, V), cache)`` with the cache updated at
-    ``pos``. Attention masks positions ``> pos`` (the rest of the
-    buffer is zero-filled future space).
-    """
-    params = cfg.cast_params(params)
-    B = token.shape[0]
+def decode_blocks(blocks: dict, cache: dict, pos, x: jnp.ndarray,
+                  cfg: TransformerConfig):
+    """One decode step through a stacked block group: ``x (B, 1, D)``
+    attends against the group's cache (updated at ``pos``). The
+    per-stage building block of :func:`decode_step` and the pipelined
+    decoder. Attention masks positions ``> pos`` (the rest of the
+    buffer is zero-filled future space)."""
+    B = x.shape[0]
     H, Dh = cfg.n_heads, cfg.head_dim
     M = cache["k"].shape[2]
-    x = params["tok_embed"][token][:, None, :] + params["pos_embed"][pos][None, None, :]
 
     def body(carry, inputs):
         x = carry
@@ -92,8 +99,21 @@ def decode_step(params: dict, cache: dict, pos, token: jnp.ndarray,
         x = x + o @ block["w_o"] + block["b_o"]
         return ffn_sublayer(block, x), (k_cache, v_cache)
 
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
-    return unembed(params, x)[:, 0], {"k": ks, "v": vs}
+    x, (ks, vs) = lax.scan(body, x, (blocks, cache["k"], cache["v"]))
+    return x, {"k": ks, "v": vs}
+
+
+def decode_step(params: dict, cache: dict, pos, token: jnp.ndarray,
+                cfg: TransformerConfig):
+    """One decode step: ``token (B,) int32`` at position ``pos``.
+
+    Returns ``(logits (B, V), cache)`` with the cache updated at
+    ``pos``.
+    """
+    params = cfg.cast_params(params)
+    x = params["tok_embed"][token][:, None, :] + params["pos_embed"][pos][None, None, :]
+    x, cache = decode_blocks(params["blocks"], cache, pos, x, cfg)
+    return unembed(params, x)[:, 0], cache
 
 
 def _truncate_logits(logits: jnp.ndarray, top_k: int | None,

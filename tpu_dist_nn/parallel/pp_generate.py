@@ -1,0 +1,193 @@
+"""Pipelined autoregressive decoding: generation with the blocks
+sharded over the ``stage`` mesh axis.
+
+The missing serving leg of the pipeline family: training shards blocks
+over ``stage`` (transformer_pipeline), single-chip and tensor-parallel
+decode existed (models/generate.py, parallel/tp_generate.py), but a
+pipeline-trained model had to be gathered onto one device to sample.
+This module decodes IN the training placement: each stage holds its
+block group's KV cache, activations hop the stage ring, and the
+sampled token rides a ``psum`` broadcast from the last stage back to
+the embedding on stage 0.
+
+TPU-first structure (no data-dependent control flow, no branches):
+
+* **Prefill**: ``S`` uniform ticks. Every tick every stage runs its
+  block group (:func:`~tpu_dist_nn.models.generate.prefill_blocks`)
+  on whatever its wire holds and commits its cache only on its OWN
+  tick (``jnp.where`` predication — the padded/masked SPMD trade the
+  dense pipeline executor makes, one compiled program for all
+  stages).
+* **Decode**: one ``lax.scan`` over new tokens; each step is an inner
+  ``lax.scan`` of ``S`` ticks through
+  :func:`~tpu_dist_nn.models.generate.decode_blocks` with predicated
+  cache commits, a greedy argmax on the last stage's tick, and the
+  ``psum``-broadcast hand-back. Cost per token: every stage computes
+  every tick (S× redundant FLOPs — masking instead of branching);
+  the real win is MEMORY placement: the model and its caches never
+  leave the training shards. Overlapping multiple sequences into the
+  bubble (continuous batching) is the natural extension and would
+  reuse these tables.
+
+Greedy only (``temperature == 0`` semantics): parity-tested
+token-for-token against the single-chip
+:func:`~tpu_dist_nn.models.generate.generate`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist_nn.models.generate import decode_blocks, prefill_blocks
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    layer_norm,
+)
+from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
+
+
+def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
+                           max_new_tokens: int):
+    """-> ``fn(params_staged, prompt (B, T)) -> tokens (B, T + N)``.
+
+    ``params_staged["blocks"]`` in
+    :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`
+    layout (the training layout); embedding/unembed params replicated.
+    The batch shards over ``data`` if the mesh has that axis.
+    """
+    S = num_stages
+    N = max_new_tokens
+
+    def device_fn(embed_params, blocks_st, prompt):
+        blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        B, T = prompt.shape
+        D = cfg.d_model
+        total = T + N
+        max_len = total - 1  # last decode writes position total - 2
+        vary = (AXIS_STAGE, *data_axes)
+
+        def vcast(z):
+            # Scan carries become (stage, data)-varying after the first
+            # tick (ppermute + stage-predicated selects); mark the
+            # initial values to match (idempotent — one_f_one_b.py).
+            have = getattr(jax.typeof(z), "vma", frozenset())
+            need = tuple(a for a in vary if a not in have)
+            return lax.pcast(z, need, to="varying") if need else z
+
+        def unembed_local(x):
+            h = layer_norm(x, embed_params["lnf_g"], embed_params["lnf_b"])
+            return h @ embed_params["tok_embed"].T
+
+        # ---- Prefill: S uniform ticks, cache committed on own tick.
+        x0 = (
+            embed_params["tok_embed"][prompt]
+            + embed_params["pos_embed"][jnp.arange(T)]
+        )
+        dt = x0.dtype
+        zeros_cache = {
+            "k": vcast(jnp.zeros(
+                (blocks["w_qkv"].shape[0], B, max_len, cfg.n_heads,
+                 cfg.head_dim), dt,
+            )),
+        }
+        zeros_cache["v"] = zeros_cache["k"]
+
+        def prefill_tick(carry, t):
+            wire, cache = carry
+            x_in = jnp.where(s_idx == 0, x0, wire)
+            y, new_cache = prefill_blocks(blocks, x_in, cfg, max_len)
+            active = t == s_idx
+            cache = jax.tree.map(
+                lambda new, old: jnp.where(active, new, old), new_cache, cache
+            )
+            y = jnp.where(active, y, wire)
+            wire = (
+                lax.ppermute(y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)])
+                if S > 1 else y
+            )
+            return (wire, cache), y
+
+        (wire, cache), ys = lax.scan(
+            prefill_tick, (vcast(x0 * 0.0), zeros_cache), jnp.arange(S)
+        )
+        # The last stage's own tick (t = S-1) produced the final
+        # activation — it is ys[-1] on that device.
+        y_last = ys[S - 1]
+        logits = unembed_local(y_last[:, T - 1])
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Broadcast the sampled token from the last stage to everyone.
+        first = lax.psum(jnp.where(s_idx == S - 1, first, 0), AXIS_STAGE)
+
+        # ---- Decode: N-1 steps x S ticks (the single-chip loop's
+        # count: `first` came from the prefill logits).
+        def decode_token(carry, n):
+            cache, token = carry
+            pos = T + n
+            x_in0 = (
+                embed_params["tok_embed"][token][:, None, :]
+                + embed_params["pos_embed"][pos][None, None, :]
+            )
+
+            def tick(tc, t):
+                wire, cache = tc
+                x_in = jnp.where(s_idx == 0, x_in0, wire)
+                y, new_cache = decode_blocks(blocks, cache, pos, x_in, cfg)
+                active = t == s_idx
+                cache = jax.tree.map(
+                    lambda new, old: jnp.where(active, new, old),
+                    new_cache, cache,
+                )
+                y = jnp.where(active, y, wire)
+                wire = (
+                    lax.ppermute(
+                        y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)]
+                    )
+                    if S > 1 else y
+                )
+                return (wire, cache), y
+
+            (_, cache), ys = lax.scan(
+                tick, (vcast(x_in0 * 0.0), cache), jnp.arange(S)
+            )
+            logits = unembed_local(ys[S - 1][:, 0])
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = lax.psum(jnp.where(s_idx == S - 1, nxt, 0), AXIS_STAGE)
+            return (cache, nxt), nxt
+
+        if N == 1:
+            new_tokens = first[:, None]
+        else:
+            (_, _), rest = lax.scan(
+                decode_token, (cache, first), jnp.arange(N - 1)
+            )
+            new_tokens = jnp.concatenate(
+                [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+            )
+        return jnp.concatenate([prompt, new_tokens], axis=1)
+
+    data_axes = (AXIS_DATA,) if AXIS_DATA in mesh.shape else ()
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_STAGE), P(*data_axes)),
+        out_specs=P(*data_axes),
+    )
+
+    def generate_fn(params, prompt):
+        params = cfg.cast_params(params)
+        T = prompt.shape[1]
+        if T + N > cfg.max_seq_len + 1:
+            raise ValueError(
+                f"prompt {T} + max_new_tokens {N} exceeds "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        embed_params = {
+            k: v for k, v in params.items() if k != "blocks"
+        }
+        return fn(embed_params, params["blocks"], prompt)
+
+    return generate_fn
