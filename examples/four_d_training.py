@@ -51,13 +51,10 @@ def main() -> int:
         init_transformer,
     )
     from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
-    from tpu_dist_nn.parallel.transformer_pipeline import (
-        shard_blocks_interleaved_tp,
-        shard_blocks_pp_tp,
-        unshard_blocks_interleaved_tp,
-        unshard_blocks_pp_tp,
+    from tpu_dist_nn.train.lm_trainer import (
+        lm_block_layout,
+        make_pipeline_sp_lm_train_step,
     )
-    from tpu_dist_nn.train.lm_trainer import make_pipeline_sp_lm_train_step
 
     if len(jax.devices()) < 8:
         raise SystemExit(
@@ -86,12 +83,8 @@ def main() -> int:
     }
     finals = {}
     for sched in ("gpipe", "1f1b", "interleaved", "zb"):
-        if sched in ("interleaved", "zb"):
-            shard = lambda b: shard_blocks_interleaved_tp(b, cfg, 2, 1, 2)  # noqa: E731
-            unshard = lambda b: unshard_blocks_interleaved_tp(b, cfg)  # noqa: E731
-        else:
-            shard = lambda b: shard_blocks_pp_tp(b, cfg, 2, 2)  # noqa: E731
-            unshard = lambda b: unshard_blocks_pp_tp(b, cfg)  # noqa: E731
+        # The CLI's shared (schedule, sharding) -> layout dispatch.
+        shard, unshard = lm_block_layout(sched, 2, 1, cfg=cfg, tp=2)
         params = dict(base, blocks=shard(base["blocks"]))
         step = make_pipeline_sp_lm_train_step(
             mesh, cfg, 2, 2, optimizer, mode="ring", schedule=sched,

@@ -133,16 +133,19 @@ def scenario_train_lm_pipelined() -> dict:
 
 
 def scenario_train_lm_3d() -> dict:
-    """PP x TP x DP across REAL processes: the stage axis spans the
-    two hosts (inter-stage ppermute hand-offs ride the DCN transport
-    every tick, forward and backward), Megatron psums stay intra-host,
-    and the data axis feeds through the global-batch assembler — the
-    full 3D deployment shape on the reference's production topology
-    (N cooperating processes). Both hosts must see the identical loss
-    stream and end with identical weights."""
+    """PP x TP x DP across REAL processes, under BOTH wire layouts.
+
+    Phase 1 — the production layout (`build_mesh`: data outermost, so
+    the DATA-axis gradient all-reduce is what rides the DCN transport
+    while stage ppermutes and Megatron psums stay intra-host — the
+    canonical DCN/ICI split the mesh module documents). Phase 2 — a
+    hand-made mesh with STAGE outermost, so every tick's forward and
+    backward inter-stage ppermute hand-off crosses the process
+    boundary instead. Same math either way: both hosts must see one
+    identical loss stream across BOTH layouts, proving the 3D step is
+    wire-placement-invariant on the real 2-process topology."""
     import jax
     import numpy as np
-    import optax
     from jax.sharding import PartitionSpec as P
 
     from tpu_dist_nn.data.feed import global_batch, shard_for_host
@@ -150,7 +153,13 @@ def scenario_train_lm_3d() -> dict:
         TransformerConfig,
         init_transformer,
     )
-    from tpu_dist_nn.parallel.mesh import AXIS_DATA, MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.mesh import (
+        AXIS_DATA,
+        AXIS_MODEL,
+        AXIS_STAGE,
+        MeshSpec,
+        build_mesh,
+    )
     from tpu_dist_nn.parallel.multihost import to_host_numpy
     from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks_pp_tp
     from tpu_dist_nn.train.lm_trainer import (
@@ -159,34 +168,77 @@ def scenario_train_lm_3d() -> dict:
         train_lm,
     )
 
-    mesh = build_mesh(MeshSpec(stage=2, model=2, data=2))
     cfg = TransformerConfig(
         vocab_size=31, d_model=16, n_heads=2, n_layers=2, d_ff=32,
         max_seq_len=12,
     )
-    params = init_transformer(jax.random.key(0), cfg)
-    params = dict(
-        params, blocks=shard_blocks_pp_tp(params["blocks"], cfg, 2, 2)
-    )
+    base = init_transformer(jax.random.key(0), cfg)
     rng = np.random.default_rng(0)
     rows = rng.integers(0, cfg.vocab_size, (64, 13)).astype(np.int32)
     local_rows = shard_for_host(rows)
-    batches = [local_rows[i * 8:(i + 1) * 8] for i in range(4)]
-    globalize = lambda b: global_batch(mesh, P(AXIS_DATA, None), b)  # noqa: E731
-    step_fn = lambda opt: make_pipeline_lm_train_step(  # noqa: E731
-        mesh, cfg, 2, 2, opt, schedule="1f1b", tensor_parallel=2
-    )
-    params, history = train_lm(
-        params, cfg, batches,
-        LMTrainConfig(steps=4, log_every=1),
-        mesh=mesh, num_stages=2, num_microbatches=2, globalize=globalize,
-        step_fn=step_fn,
-    )
-    tok = to_host_numpy(params["tok_embed"])
-    return {
-        "losses": [round(h["loss"], 6) for h in history],
-        "tok_digest": float(np.abs(tok).sum()),
+
+    meshes = {
+        # data outermost: DCN carries the data all-reduce.
+        "dcn_data": build_mesh(MeshSpec(stage=2, model=2, data=2)),
+        # stage outermost: DCN carries every inter-stage ppermute.
+        # (Auto axis types, like build_mesh: jax 0.9's make_mesh
+        # defaults to Explicit, which flips eager ops into
+        # sharding-in-types mode.)
+        "dcn_stage": jax.make_mesh(
+            (2, 2, 2), (AXIS_STAGE, AXIS_MODEL, AXIS_DATA),
+            axis_types=(jax.sharding.AxisType.Auto,) * 3,
+        ),
     }
+    only = os.environ.get("TDN_3D_ONLY")
+    if only:
+        meshes = {only: meshes[only]}
+    out = {}
+    for name, mesh in meshes.items():
+        params = dict(
+            base, blocks=shard_blocks_pp_tp(base["blocks"], cfg, 2, 2)
+        )
+        if name == "dcn_data":
+            # data spans the hosts: per-process stripes through the
+            # global-batch assembler (the production feed).
+            batches = [local_rows[i * 8:(i + 1) * 8] for i in range(4)]
+            globalize = lambda b, m=mesh: global_batch(  # noqa: E731
+                m, P(AXIS_DATA, None), b
+            )
+        else:
+            # stage spans the hosts: BOTH data shards live on every
+            # process, so per-process stripes would feed different
+            # rows into replicated shards (the documented
+            # N-diverging-models hazard). Every host supplies the
+            # FULL global batch; make_array_from_callback slices each
+            # addressable shard out of it.
+            from jax.sharding import NamedSharding
+
+            # The same global batches the dcn_data feed assembles:
+            # [process 0's stripe; process 1's stripe] per step.
+            batches = [
+                np.concatenate(
+                    [rows[i * 8:(i + 1) * 8],
+                     rows[32 + i * 8:32 + (i + 1) * 8]]
+                )
+                for i in range(4)
+            ]
+            sharding = NamedSharding(mesh, P(AXIS_DATA, None))
+            globalize = lambda b, sh=sharding: jax.make_array_from_callback(  # noqa: E731
+                b.shape, sh, lambda idx, bb=b: bb[idx]
+            )
+        step_fn = lambda opt, m=mesh: make_pipeline_lm_train_step(  # noqa: E731
+            m, cfg, 2, 2, opt, schedule="1f1b", tensor_parallel=2
+        )
+        params, history = train_lm(
+            params, cfg, batches,
+            LMTrainConfig(steps=4, log_every=1),
+            mesh=mesh, num_stages=2, num_microbatches=2,
+            globalize=globalize, step_fn=step_fn,
+        )
+        tok = to_host_numpy(params["tok_embed"])
+        out[f"losses_{name}"] = [round(h["loss"], 6) for h in history]
+        out[f"tok_digest_{name}"] = float(np.abs(tok).sum())
+    return out
 
 
 def scenario_step_parity() -> dict:

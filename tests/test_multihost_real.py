@@ -108,14 +108,23 @@ def test_two_process_lm_pipeline_in_sync():
 
 
 def test_two_process_lm_3d_in_sync():
-    # PP x TP x DP on the real 2-process topology: stage hand-offs
-    # cross the DCN boundary every tick, TP psums stay intra-host,
-    # the data axis feeds via global_batch — identical loss streams.
+    # PP x TP x DP on the real 2-process topology, under BOTH wire
+    # layouts: the production mesh (data outermost — the DCN carries
+    # the data all-reduce) and a stage-outermost mesh (the DCN carries
+    # every inter-stage ppermute). Hosts agree with each other AND the
+    # two layouts agree with each other.
     r0, r1 = _run_pair("train_lm_3d")
-    assert r0["losses"] == r1["losses"], (r0, r1)
-    assert r0["tok_digest"] == pytest.approx(r1["tok_digest"], rel=1e-6)
-    assert all(np.isfinite(r0["losses"]))
-    assert r0["losses"][-1] < r0["losses"][0]
+    for name in ("dcn_data", "dcn_stage"):
+        assert r0[f"losses_{name}"] == r1[f"losses_{name}"], (name, r0, r1)
+        assert r0[f"tok_digest_{name}"] == pytest.approx(
+            r1[f"tok_digest_{name}"], rel=1e-6
+        )
+        assert all(np.isfinite(r0[f"losses_{name}"]))
+        assert r0[f"losses_{name}"][-1] < r0[f"losses_{name}"][0]
+    # Wire placement must not change the math.
+    assert r0["losses_dcn_data"] == pytest.approx(
+        r0["losses_dcn_stage"], rel=1e-5
+    )
 
 
 @pytest.mark.parametrize("scenario", ["train_lm_zero1", "train_lm_fsdp"])

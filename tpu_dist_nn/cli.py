@@ -512,62 +512,13 @@ def _default_virtual(args, sched: str) -> int:
 
 def _lm_block_layout(sched: str, stages: int, num_virtual: int, *,
                      cfg=None, tp: int = 1, ep: int = 0):
-    """-> ``(shard_blocks_fn, unshard_blocks_fn)`` for the pipelined-LM
-    param layout implied by (schedule, sharding) — ONE dispatch shared
-    by the MoE, pp x sp, and pp x tp branches of ``cmd_lm`` so a new
-    schedule cannot land in one branch and silently mis-lay the
-    others. ``ep > 0`` selects the expert-sharded family (``cfg``
-    unused), ``tp > 1`` the Megatron family (needs ``cfg``), else the
-    dense family."""
-    if ep:
-        from tpu_dist_nn.parallel import expert_parallel as m
+    """Thin alias for
+    :func:`tpu_dist_nn.train.lm_trainer.lm_block_layout` (the shared
+    (schedule, sharding) -> layout dispatch lives with the trainers so
+    examples and tests can reuse it without importing the CLI)."""
+    from tpu_dist_nn.train.lm_trainer import lm_block_layout
 
-        if sched == "zb-v":
-            return (
-                lambda b: m.shard_blocks_vshape_ep(b, stages, ep),
-                m.unshard_blocks_vshape_ep,
-            )
-        if sched in ("interleaved", "zb"):
-            return (
-                lambda b: m.shard_blocks_interleaved_ep(
-                    b, stages, num_virtual, ep
-                ),
-                m.unshard_blocks_interleaved_ep,
-            )
-        return (
-            lambda b: m.shard_blocks_pp_ep(b, stages, ep),
-            m.unshard_blocks_pp_ep,
-        )
-    from tpu_dist_nn.parallel import transformer_pipeline as m
-
-    if tp > 1:
-        if sched == "zb-v":
-            return (
-                lambda b: m.shard_blocks_vshape_tp(b, cfg, stages, tp),
-                lambda b: m.unshard_blocks_vshape_tp(b, cfg),
-            )
-        if sched in ("interleaved", "zb"):
-            return (
-                lambda b: m.shard_blocks_interleaved_tp(
-                    b, cfg, stages, num_virtual, tp
-                ),
-                lambda b: m.unshard_blocks_interleaved_tp(b, cfg),
-            )
-        return (
-            lambda b: m.shard_blocks_pp_tp(b, cfg, stages, tp),
-            lambda b: m.unshard_blocks_pp_tp(b, cfg),
-        )
-    if sched == "zb-v":
-        return (
-            lambda b: m.shard_blocks_vshape(b, stages),
-            m.unshard_blocks_vshape,
-        )
-    if sched in ("interleaved", "zb"):
-        return (
-            lambda b: m.shard_blocks_interleaved(b, stages, num_virtual),
-            m.unshard_blocks_interleaved,
-        )
-    return (lambda b: m.shard_blocks(b, stages), m.unshard_blocks)
+    return lm_block_layout(sched, stages, num_virtual, cfg=cfg, tp=tp, ep=ep)
 
 
 def cmd_lm(args) -> int:
@@ -631,6 +582,12 @@ def cmd_lm(args) -> int:
         raise ValueError(
             "--sample-tensor-parallel requires --sample-bytes > 0 "
             "(it shards the decode; without sampling it would be "
+            "silently ignored)"
+        )
+    if args.sample_pipeline_stages > 1 and args.sample_bytes <= 0:
+        raise ValueError(
+            "--sample-pipeline-stages requires --sample-bytes > 0 "
+            "(it places the decode; without sampling it would be "
             "silently ignored)"
         )
     if args.sample_bytes > 0:

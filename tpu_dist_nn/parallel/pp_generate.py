@@ -170,12 +170,14 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
         return jnp.concatenate([prompt, new_tokens], axis=1)
 
     data_axes = (AXIS_DATA,) if AXIS_DATA in mesh.shape else ()
-    fn = jax.shard_map(
+    # One compiled program for the whole prefill+decode loop (the
+    # sibling single-chip/tp decoders enforce the same property).
+    fn = jax.jit(jax.shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P(), P(AXIS_STAGE), P(*data_axes)),
         out_specs=P(*data_axes),
-    )
+    ))
 
     def generate_fn(params, prompt):
         params = cfg.cast_params(params)

@@ -175,6 +175,66 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     return jax.jit(make_step_body(loss_fn, optimizer))
 
 
+def lm_block_layout(sched: str, stages: int, num_virtual: int, *,
+                    cfg=None, tp: int = 1, ep: int = 0):
+    """-> ``(shard_blocks_fn, unshard_blocks_fn)`` for the pipelined-LM
+    param layout implied by (schedule, sharding) — ONE dispatch shared
+    by the CLI's MoE / pp x sp / pp x tp branches and the examples, so
+    a new schedule cannot land in one site and silently mis-lay the
+    others. ``ep > 0`` selects the expert-sharded family (``cfg``
+    unused), ``tp > 1`` the Megatron family (needs ``cfg``), else the
+    dense family."""
+    if ep:
+        from tpu_dist_nn.parallel import expert_parallel as m
+
+        if sched == "zb-v":
+            return (
+                lambda b: m.shard_blocks_vshape_ep(b, stages, ep),
+                m.unshard_blocks_vshape_ep,
+            )
+        if sched in ("interleaved", "zb"):
+            return (
+                lambda b: m.shard_blocks_interleaved_ep(
+                    b, stages, num_virtual, ep
+                ),
+                m.unshard_blocks_interleaved_ep,
+            )
+        return (
+            lambda b: m.shard_blocks_pp_ep(b, stages, ep),
+            m.unshard_blocks_pp_ep,
+        )
+    from tpu_dist_nn.parallel import transformer_pipeline as m
+
+    if tp > 1:
+        if sched == "zb-v":
+            return (
+                lambda b: m.shard_blocks_vshape_tp(b, cfg, stages, tp),
+                lambda b: m.unshard_blocks_vshape_tp(b, cfg),
+            )
+        if sched in ("interleaved", "zb"):
+            return (
+                lambda b: m.shard_blocks_interleaved_tp(
+                    b, cfg, stages, num_virtual, tp
+                ),
+                lambda b: m.unshard_blocks_interleaved_tp(b, cfg),
+            )
+        return (
+            lambda b: m.shard_blocks_pp_tp(b, cfg, stages, tp),
+            lambda b: m.unshard_blocks_pp_tp(b, cfg),
+        )
+    if sched == "zb-v":
+        return (
+            lambda b: m.shard_blocks_vshape(b, stages),
+            m.unshard_blocks_vshape,
+        )
+    if sched in ("interleaved", "zb"):
+        return (
+            lambda b: m.shard_blocks_interleaved(b, stages, num_virtual),
+            m.unshard_blocks_interleaved,
+        )
+    return (lambda b: m.shard_blocks(b, stages), m.unshard_blocks)
+
+
 def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
                                     num_microbatches: int, optimizer,
                                     attn_fn=None, schedule: str = "gpipe",
