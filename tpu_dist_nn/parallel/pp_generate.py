@@ -193,3 +193,222 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
         return fn(embed_params, params["blocks"], prompt)
 
     return generate_fn
+
+
+def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
+                                      num_stages: int, max_new_tokens: int,
+                                      num_groups: int):
+    """Continuous-batching-style pipelined decode: ``G`` request groups
+    round-robin through the stage ring so that in steady state EVERY
+    stage does useful work EVERY tick — one token leaves the pipe per
+    tick — instead of :func:`make_pipeline_generate`'s one-group
+    scheme, where each tick only one stage's compute is live (S×
+    redundant FLOPs and ~S× the wall time for the same batch).
+
+    Static round-robin tables, no branches: at tick ``t`` stage ``s``
+    works on group ``g = (t - s) mod G`` decoding token ``n = (t - s)
+    div G`` (valid while ``0 <= t - s`` and ``n`` in range). The
+    sampled token for a group leaves the last stage and rides a
+    dedicated ``(S-1 -> 0)`` ppermute hop back to the embedding
+    stage's token buffer; ``G >= S`` guarantees it lands before the
+    group's next decode tick (the fill/drain bubble is ``S - 1`` ticks
+    total, amortized over ``(N-1) * G`` useful ticks). Per-stage KV
+    caches gain a leading group axis — the continuous-batching memory
+    trade.
+
+    -> ``fn(params_staged, prompts (G, Bg, T)) -> (G, Bg, T + N)``;
+    greedy, token-for-token equal to decoding each group alone.
+    """
+    S, N, G = num_stages, max_new_tokens, num_groups
+    if G < S:
+        raise ValueError(
+            f"num_groups ({G}) must be >= num_stages ({S}): the token "
+            "feedback hop needs G ticks of slack per token"
+        )
+
+    def device_fn(embed_params, blocks_st, prompts):
+        blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
+        s_idx = lax.axis_index(AXIS_STAGE)
+        Gp, Bg, T = prompts.shape
+        total = T + N
+        max_len = total - 1
+        vary = (AXIS_STAGE, *data_axes)
+
+        def vcast(z):
+            have = getattr(jax.typeof(z), "vma", frozenset())
+            need = tuple(a for a in vary if a not in have)
+            return lax.pcast(z, need, to="varying") if need else z
+
+        def unembed_local(x):
+            h = layer_norm(x, embed_params["lnf_g"], embed_params["lnf_b"])
+            return h @ embed_params["tok_embed"].T
+
+        x0 = (
+            embed_params["tok_embed"][prompts]
+            + embed_params["pos_embed"][jnp.arange(T)]
+        )  # (G, Bg, T, D)
+        dt = x0.dtype
+        Lc = blocks["w_qkv"].shape[0]
+        cache0 = {
+            "k": vcast(jnp.zeros(
+                (G, Lc, Bg, max_len, cfg.n_heads, cfg.head_dim), dt
+            )),
+        }
+        cache0["v"] = cache0["k"]
+
+        # ---- Prefill: G + S - 1 round-robin ticks; firsts collected
+        # on the last stage and psum-shared afterwards.
+        def prefill_tick(carry, t):
+            wire, cache, firsts = carry
+            g = jnp.clip(t - s_idx, 0, G - 1)
+            valid = (t - s_idx >= 0) & (t - s_idx < G)
+            x_in = jnp.where(
+                s_idx == 0,
+                lax.dynamic_index_in_dim(x0, g, 0, keepdims=False),
+                wire,
+            )
+            y, new_cache_g = prefill_blocks(blocks, x_in, cfg, max_len)
+            cache = jax.tree.map(
+                lambda c, newg: jnp.where(
+                    valid,
+                    lax.dynamic_update_index_in_dim(c, newg, g, 0),
+                    c,
+                ),
+                cache, new_cache_g,
+            )
+            emit = valid & (s_idx == S - 1)
+            tok = jnp.argmax(
+                unembed_local(y[:, T - 1]), axis=-1
+            ).astype(jnp.int32)
+            firsts = jnp.where(
+                emit,
+                lax.dynamic_update_index_in_dim(firsts, tok, g, 0),
+                firsts,
+            )
+            wire = (
+                lax.ppermute(y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)])
+                if S > 1 else y
+            )
+            return (wire, cache, firsts), None
+
+        firsts0 = vcast(jnp.zeros((G, Bg), jnp.int32))
+        (_w, cache, firsts), _ = lax.scan(
+            prefill_tick,
+            (vcast(jnp.zeros((Bg, T, cfg.d_model), dt)), cache0, firsts0),
+            jnp.arange(G + S - 1),
+        )
+        firsts = lax.psum(
+            jnp.where(s_idx == S - 1, firsts, 0), AXIS_STAGE
+        )  # (G, Bg) on every stage
+
+        if N == 1:
+            return jnp.concatenate([prompts, firsts[:, :, None]], axis=2)
+
+        # ---- Overlapped decode: (N-1)*G + S - 1 ticks.
+        TK = (N - 1) * G + S - 1
+
+        def tick(carry, t):
+            wire, fb_wire, cache, tokbuf, outbuf = carry
+            # Receive: last tick's feedback token belongs to group
+            # (t - S) mod G (emitted by the last stage at t-1 for its
+            # group (t-1) - (S-1)).
+            g_fb = (t - S) % G
+            fb_valid = (t - S >= 0) & ((t - S) // G < N - 1) & (s_idx == 0)
+            tokbuf = jnp.where(
+                fb_valid,
+                lax.dynamic_update_index_in_dim(tokbuf, fb_wire, g_fb, 0),
+                tokbuf,
+            )
+            d = t - s_idx
+            g = jnp.clip(d, 0, 10 ** 9) % G
+            n = jnp.clip(d, 0, 10 ** 9) // G
+            valid = (d >= 0) & (n < N - 1)
+            pos = T + n
+            tok_g = lax.dynamic_index_in_dim(tokbuf, g, 0, keepdims=False)
+            x_emb = (
+                embed_params["tok_embed"][tok_g][:, None, :]
+                + embed_params["pos_embed"][pos][None, None, :]
+            )
+            x_in = jnp.where(s_idx == 0, x_emb, wire)
+            cache_g = jax.tree.map(
+                lambda c: lax.dynamic_index_in_dim(c, g, 0, keepdims=False),
+                cache,
+            )
+            y, new_cache_g = decode_blocks(blocks, cache_g, pos, x_in, cfg)
+            cache = jax.tree.map(
+                lambda c, newg: jnp.where(
+                    valid,
+                    lax.dynamic_update_index_in_dim(c, newg, g, 0),
+                    c,
+                ),
+                cache, new_cache_g,
+            )
+            emit = valid & (s_idx == S - 1)
+            tok = jnp.argmax(
+                unembed_local(y[:, 0]), axis=-1
+            ).astype(jnp.int32)
+            outbuf = jnp.where(
+                emit,
+                lax.dynamic_update_index_in_dim(
+                    outbuf,
+                    lax.dynamic_update_index_in_dim(
+                        lax.dynamic_index_in_dim(outbuf, g, 0, keepdims=False),
+                        tok, n, 0,
+                    ),
+                    g, 0,
+                ),
+                outbuf,
+            )
+            wire = (
+                lax.ppermute(y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)])
+                if S > 1 else y
+            )
+            fb_wire = (
+                lax.ppermute(tok, AXIS_STAGE, [(S - 1, 0)])
+                if S > 1 else tok
+            )
+            return (wire, fb_wire, cache, tokbuf, outbuf), None
+
+        outbuf0 = vcast(jnp.zeros((G, N - 1, Bg), jnp.int32))
+        (_w, _f, _c, _tb, outbuf), _ = lax.scan(
+            tick,
+            (
+                vcast(jnp.zeros((Bg, 1, cfg.d_model), dt)),
+                vcast(jnp.zeros((Bg,), jnp.int32)),
+                cache, vcast(firsts), outbuf0,
+            ),
+            jnp.arange(TK),
+        )
+        rest = lax.psum(
+            jnp.where(s_idx == S - 1, outbuf, 0), AXIS_STAGE
+        )  # (G, N-1, Bg)
+        new_tokens = jnp.concatenate(
+            [firsts[:, :, None], jnp.transpose(rest, (0, 2, 1))], axis=2
+        )
+        return jnp.concatenate([prompts, new_tokens], axis=2)
+
+    data_axes = (AXIS_DATA,) if AXIS_DATA in mesh.shape else ()
+    fn = jax.jit(jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_STAGE), P(None, *data_axes)),
+        out_specs=P(None, *data_axes),
+    ))
+
+    def generate_fn(params, prompts):
+        params = cfg.cast_params(params)
+        if prompts.ndim != 3 or prompts.shape[0] != G:
+            raise ValueError(
+                f"prompts must be (num_groups={G}, Bg, T), got "
+                f"{prompts.shape}"
+            )
+        T = prompts.shape[2]
+        if T + N > cfg.max_seq_len + 1:
+            raise ValueError(
+                f"prompt {T} + max_new_tokens {N} exceeds "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        embed_params = {k: v for k, v in params.items() if k != "blocks"}
+        return fn(embed_params, params["blocks"], prompts)
+
+    return generate_fn
