@@ -313,7 +313,9 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
     transformer_pipeline.make_pipeline_sp_lm_1f1b_grad).
     ``schedule="interleaved"/"zb"``: the table executors with
     ``num_virtual`` chunks per device (``shard_blocks_interleaved``
-    layout; ``_tp`` variants with TP).
+    layout; ``_tp`` variants with TP). ``schedule="zb-v"``: the
+    V-placement zero-bubble tables (``shard_blocks_vshape[_tp]``
+    layout, v=2 fixed by the placement).
 
     ``tensor_parallel > 1`` additionally Megatron-shards each stage's
     blocks over the mesh's ``model`` axis — PP x TP x SP (x DP), the
