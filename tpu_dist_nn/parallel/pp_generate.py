@@ -222,14 +222,16 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
     S, N, G = num_stages, max_new_tokens, num_groups
     if G < S:
         raise ValueError(
-            f"num_groups ({G}) must be >= num_stages ({S}): the token "
-            "feedback hop needs G ticks of slack per token"
+            f"num_groups ({G}) must be >= num_stages ({S}): a group's "
+            f"sampled token takes {S} ticks to cross the pipe and ride "
+            f"the feedback hop, and the round-robin grants it G ticks "
+            "before that group decodes again"
         )
 
     def device_fn(embed_params, blocks_st, prompts):
         blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
         s_idx = lax.axis_index(AXIS_STAGE)
-        Gp, Bg, T = prompts.shape
+        _, Bg, T = prompts.shape  # group count == G (validated outside)
         total = T + N
         max_len = total - 1
         vary = (AXIS_STAGE, *data_axes)
@@ -268,11 +270,17 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                 wire,
             )
             y, new_cache_g = prefill_blocks(blocks, x_in, cfg, max_len)
+            # Predicate the SLICE, then write unconditionally: the
+            # select touches one group's cache, not all G (and the
+            # scan carry stays aliasable for XLA).
             cache = jax.tree.map(
-                lambda c, newg: jnp.where(
-                    valid,
-                    lax.dynamic_update_index_in_dim(c, newg, g, 0),
+                lambda c, newg: lax.dynamic_update_index_in_dim(
                     c,
+                    jnp.where(
+                        valid, newg,
+                        lax.dynamic_index_in_dim(c, g, 0, keepdims=False),
+                    ),
+                    g, 0,
                 ),
                 cache, new_cache_g,
             )
@@ -335,29 +343,25 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                 cache,
             )
             y, new_cache_g = decode_blocks(blocks, cache_g, pos, x_in, cfg)
+            # Slice-predicated write (prefill_tick's note): one group's
+            # select, unconditional group write.
             cache = jax.tree.map(
-                lambda c, newg: jnp.where(
-                    valid,
-                    lax.dynamic_update_index_in_dim(c, newg, g, 0),
-                    c,
+                lambda c, newg, oldg: lax.dynamic_update_index_in_dim(
+                    c, jnp.where(valid, newg, oldg), g, 0
                 ),
-                cache, new_cache_g,
+                cache, new_cache_g, cache_g,
             )
             emit = valid & (s_idx == S - 1)
             tok = jnp.argmax(
                 unembed_local(y[:, 0]), axis=-1
             ).astype(jnp.int32)
-            outbuf = jnp.where(
-                emit,
-                lax.dynamic_update_index_in_dim(
-                    outbuf,
-                    lax.dynamic_update_index_in_dim(
-                        lax.dynamic_index_in_dim(outbuf, g, 0, keepdims=False),
-                        tok, n, 0,
-                    ),
-                    g, 0,
-                ),
+            outbuf = lax.dynamic_update_slice(
                 outbuf,
+                jnp.where(
+                    emit, tok,
+                    lax.dynamic_slice(outbuf, (g, n, 0), (1, 1, Bg))[0, 0],
+                )[None, None, :],
+                (g, n, 0),
             )
             wire = (
                 lax.ppermute(y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)])
