@@ -1,0 +1,112 @@
+"""Pipelined decode: masked one-group vs overlapped round-robin.
+
+Measures what the round-robin structure buys: both decoders produce
+token-for-token identical streams (parity-tested in
+tests/test_generate.py), but the one-group scheme computes every stage
+every tick with only one stage's result live (S× redundant FLOPs),
+while the overlapped scheme keeps every stage useful every tick. The
+tick model says the same total batch decoded as G = S groups should
+take ~S× less wall time; this experiment measures it on the 8-device
+virtual mesh and records both the ratio and the per-token numbers.
+
+Run (8 virtual devices):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/pp_decode_throughput.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--repeat", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    if jax.default_backend() not in ("cpu", "tpu"):  # pragma: no cover
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pp_generate import (
+        make_pipeline_generate,
+        make_pipeline_generate_overlapped,
+    )
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+
+    S, G, Bg, T, N = 4, 4, 8, 16, 33
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_heads=4, n_layers=8, d_ff=256,
+        max_seq_len=T + N,
+    )
+    params = init_transformer(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    prompts = jnp.asarray(rng.integers(0, 256, (G, Bg, T)), jnp.int32)
+    mesh = build_mesh(MeshSpec(stage=S, data=1))
+    params_pp = dict(params, blocks=shard_blocks(params["blocks"], S))
+
+    masked = make_pipeline_generate(mesh, cfg, S, N)
+    overlapped = make_pipeline_generate_overlapped(mesh, cfg, S, N, G)
+    flat = prompts.reshape(G * Bg, T)
+
+    def bench(fn, arg):
+        out = fn(params_pp, arg)  # compile + warm
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            out = fn(params_pp, arg)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    t_mask, out_mask = bench(masked, flat)
+    t_ovl, out_ovl = bench(overlapped, prompts)
+    np.testing.assert_array_equal(
+        np.asarray(out_mask), np.asarray(out_ovl).reshape(G * Bg, T + N)
+    )
+
+    tokens = G * Bg * N
+    record = {
+        "config": f"d{cfg.d_model}/L{cfg.n_layers}, S={S} stages, "
+                  f"G={G} groups x Bg={Bg} rows, T={T} prompt, N={N} new",
+        "masked_one_group": {
+            "wall_s": round(t_mask, 4),
+            "tokens_per_s": round(tokens / t_mask, 1),
+            "ticks": f"~{N * S} (S per token, one stage live per tick)",
+        },
+        "overlapped_round_robin": {
+            "wall_s": round(t_ovl, 4),
+            "tokens_per_s": round(tokens / t_ovl, 1),
+            "ticks": f"~{(N - 1) * G + S - 1} (one token leaves per tick)",
+        },
+        "speedup": round(t_mask / t_ovl, 2),
+        "tick_model_prediction": (
+            f"~{S}x: masked computes the FULL {G * Bg}-row batch on "
+            f"every stage every tick; overlapped computes one "
+            f"{Bg}-row group per stage per tick with no waste"
+        ),
+        "identical_outputs": True,
+    }
+    out = json.dumps(record, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
