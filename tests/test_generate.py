@@ -336,12 +336,20 @@ def test_cli_lm_sample_pipeline_stages(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "sample" in out
-    # temperature > 0 rejected eagerly (before training).
-    assert main([
+    # temperature > 0 sampling works through the pipelined decoder too
+    # (the single-chip key schedule is reproduced exactly).
+    rc = main([
         "--platform", "cpu", "lm", "--steps", "1", "--batch-size", "4",
         "--seq-len", "24", "--d-model", "16", "--heads", "2",
         "--layers", "2", "--sample-bytes", "4", "--prompt", "ab",
         "--sample-pipeline-stages", "2", "--temperature", "0.8",
+    ])
+    assert rc == 0
+    assert "sample" in capsys.readouterr().out
+    # without --sample-bytes the flag rejects eagerly.
+    assert main([
+        "--platform", "cpu", "lm", "--steps", "1",
+        "--sample-pipeline-stages", "2",
     ]) != 0
 
 
@@ -392,3 +400,50 @@ def test_pipeline_generate_overlapped_matches_single_chip():
     for g in range(G):
         ref1 = np.asarray(generate(params, cfg, prompts[g], 1, temperature=0.0))
         np.testing.assert_array_equal(out1[g, :, T:], ref1, err_msg=str(g))
+
+
+def test_pipeline_generate_sampled_matches_single_chip():
+    # Sampling at temperature > 0: the pipelined decoders reproduce the
+    # single-chip KEY SCHEDULE (first from `key`, step n from
+    # split(fold_in(key, 1), N-1)[n]), so streams match key-for-key.
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pp_generate import (
+        make_pipeline_generate,
+        make_pipeline_generate_overlapped,
+    )
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_seq_len=24,
+    )
+    params = init_transformer(jax.random.key(71), cfg)
+    rng = np.random.default_rng(72)
+    G, Bg, T, N = 2, 2, 8, 7
+    prompts = jnp.asarray(rng.integers(0, 64, (G, Bg, T)), jnp.int32)
+    key = jax.random.key(9)
+
+    refs = [
+        np.asarray(generate(params, cfg, prompts[g], N, temperature=1.0,
+                            top_k=8, key=key))
+        for g in range(G)
+    ]
+
+    mesh = build_mesh(MeshSpec(stage=2, data=1))
+    params_pp = dict(params, blocks=shard_blocks(params["blocks"], 2))
+
+    fn = make_pipeline_generate(mesh, cfg, 2, N, temperature=1.0, top_k=8)
+    for g in range(G):
+        out = np.asarray(fn(params_pp, prompts[g], key=key))
+        np.testing.assert_array_equal(out[:, T:], refs[g], err_msg=str(g))
+
+    fno = make_pipeline_generate_overlapped(
+        mesh, cfg, 2, N, num_groups=G, temperature=1.0, top_k=8
+    )
+    out = np.asarray(fno(params_pp, prompts, key=key))
+    for g in range(G):
+        np.testing.assert_array_equal(out[g, :, T:], refs[g], err_msg=str(g))
+
+    # temperature > 0 without a key rejects.
+    with pytest.raises(ValueError, match="PRNG key"):
+        fn(params_pp, prompts[0])

@@ -618,11 +618,6 @@ def cmd_lm(args) -> int:
                     "--sample-pipeline-stages and --sample-tensor-parallel "
                     "are different decode placements: pick one"
                 )
-            if args.temperature != 0:
-                raise ValueError(
-                    "--sample-pipeline-stages decodes greedily "
-                    "(temperature 0) only"
-                )
             if _jax_process_count() > 1:
                 raise ValueError(
                     "--sample-pipeline-stages is single-host only"
@@ -1130,8 +1125,15 @@ def cmd_lm(args) -> int:
             params_pp = dict(
                 params, blocks=_pp_shard_blocks(params["blocks"], spp)
             )
-            fn = make_pipeline_generate(pp_mesh, cfg, spp, n)
-            full = fn(params_pp, jnp.asarray(prompt))
+            fn = make_pipeline_generate(
+                pp_mesh, cfg, spp, n, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p,
+            )
+            full = fn(
+                params_pp, jnp.asarray(prompt),
+                key=(jax.random.key(args.seed)
+                     if args.temperature != 0 else None),
+            )
             out = full[:, prompt.shape[1]:]
         elif args.sample_tensor_parallel > 1:
             # Megatron-sharded decode: heads + KV cache split over the

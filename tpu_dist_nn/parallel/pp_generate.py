@@ -41,7 +41,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpu_dist_nn.models.generate import decode_blocks, prefill_blocks
+from tpu_dist_nn.models.generate import (
+    _truncate_logits,
+    decode_blocks,
+    prefill_blocks,
+)
 from tpu_dist_nn.models.transformer import (
     TransformerConfig,
     layer_norm,
@@ -49,22 +53,52 @@ from tpu_dist_nn.models.transformer import (
 from tpu_dist_nn.parallel.mesh import AXIS_DATA, AXIS_STAGE
 
 
+def _make_sampler(temperature: float, top_k, top_p):
+    """The single-chip sampler (generate.py's), shared so the
+    pipelined decoders are token-for-token comparable at ANY
+    temperature: greedy argmax at 0, else truncated categorical."""
+    if temperature == 0:
+        return lambda logits, k: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample(logits, k):
+        t = _truncate_logits(logits, top_k, top_p)
+        return jax.random.categorical(k, t / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    return sample
+
+
+def _step_keys(key, n_steps: int):
+    """The single-chip decode key schedule (generate.py:
+    ``split(fold_in(key, 1), N-1)``) — reproduced exactly so sampled
+    pipelined streams equal the single-chip ones key-for-key."""
+    return jax.random.split(jax.random.fold_in(key, 1), n_steps)
+
+
 def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
-                           max_new_tokens: int):
-    """-> ``fn(params_staged, prompt (B, T)) -> tokens (B, T + N)``.
+                           max_new_tokens: int, *, temperature: float = 0.0,
+                           top_k=None, top_p=None):
+    """-> ``fn(params_staged, prompt (B, T), key=None) -> (B, T + N)``.
 
     ``params_staged["blocks"]`` in
     :func:`~tpu_dist_nn.parallel.transformer_pipeline.shard_blocks`
     layout (the training layout); embedding/unembed params replicated.
-    The batch shards over ``data`` if the mesh has that axis.
+    The batch shards over ``data`` if the mesh has that axis. Sampling
+    follows the single-chip semantics and KEY SCHEDULE exactly
+    (greedy at ``temperature == 0``, no key needed), so streams match
+    :func:`~tpu_dist_nn.models.generate.generate` token-for-token at
+    any temperature.
     """
     S = num_stages
     N = max_new_tokens
+    sample = _make_sampler(float(temperature), top_k, top_p)
 
-    def device_fn(embed_params, blocks_st, prompt):
+    def device_fn(embed_params, blocks_st, prompt, key):
         blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
         s_idx = lax.axis_index(AXIS_STAGE)
         B, T = prompt.shape
+        step_keys = _step_keys(key, max(N - 1, 1))
         D = cfg.d_model
         total = T + N
         max_len = total - 1  # last decode writes position total - 2
@@ -118,7 +152,7 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
         # activation — it is ys[-1] on that device.
         y_last = ys[S - 1]
         logits = unembed_local(y_last[:, T - 1])
-        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        first = sample(logits, key)
         # Broadcast the sampled token from the last stage to everyone.
         first = lax.psum(jnp.where(s_idx == S - 1, first, 0), AXIS_STAGE)
 
@@ -154,7 +188,7 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
                 tick, (vcast(x_in0 * 0.0), cache), jnp.arange(S)
             )
             logits = unembed_local(ys[S - 1][:, 0])
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = sample(logits, step_keys[n])
             nxt = lax.psum(jnp.where(s_idx == S - 1, nxt, 0), AXIS_STAGE)
             return (cache, nxt), nxt
 
@@ -175,11 +209,11 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
     fn = jax.jit(jax.shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(P(), P(AXIS_STAGE), P(*data_axes)),
+        in_specs=(P(), P(AXIS_STAGE), P(*data_axes), P()),
         out_specs=P(*data_axes),
     ))
 
-    def generate_fn(params, prompt):
+    def generate_fn(params, prompt, key=None):
         params = cfg.cast_params(params)
         T = prompt.shape[1]
         if T + N > cfg.max_seq_len + 1:
@@ -187,17 +221,23 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
                 f"prompt {T} + max_new_tokens {N} exceeds "
                 f"max_seq_len {cfg.max_seq_len}"
             )
+        if temperature != 0 and key is None:
+            raise ValueError("temperature > 0 sampling needs a PRNG key")
+        if key is None:
+            key = jax.random.key(0)  # unused by the greedy sampler
         embed_params = {
             k: v for k, v in params.items() if k != "blocks"
         }
-        return fn(embed_params, params["blocks"], prompt)
+        return fn(embed_params, params["blocks"], prompt, key)
 
     return generate_fn
 
 
 def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                                       num_stages: int, max_new_tokens: int,
-                                      num_groups: int):
+                                      num_groups: int, *,
+                                      temperature: float = 0.0,
+                                      top_k=None, top_p=None):
     """Continuous-batching-style pipelined decode: ``G`` request groups
     round-robin through the stage ring so that in steady state EVERY
     stage does useful work EVERY tick — one token leaves the pipe per
@@ -220,6 +260,7 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
     greedy, token-for-token equal to decoding each group alone.
     """
     S, N, G = num_stages, max_new_tokens, num_groups
+    sample = _make_sampler(float(temperature), top_k, top_p)
     if G < S:
         raise ValueError(
             f"num_groups ({G}) must be >= num_stages ({S}): a group's "
@@ -228,10 +269,11 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
             "before that group decodes again"
         )
 
-    def device_fn(embed_params, blocks_st, prompts):
+    def device_fn(embed_params, blocks_st, prompts, key):
         blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
         s_idx = lax.axis_index(AXIS_STAGE)
         _, Bg, T = prompts.shape  # group count == G (validated outside)
+        step_keys = _step_keys(key, max(N - 1, 1))
         total = T + N
         max_len = total - 1
         vary = (AXIS_STAGE, *data_axes)
@@ -285,9 +327,7 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                 cache, new_cache_g,
             )
             emit = valid & (s_idx == S - 1)
-            tok = jnp.argmax(
-                unembed_local(y[:, T - 1]), axis=-1
-            ).astype(jnp.int32)
+            tok = sample(unembed_local(y[:, T - 1]), key)
             firsts = jnp.where(
                 emit,
                 lax.dynamic_update_index_in_dim(firsts, tok, g, 0),
@@ -352,9 +392,7 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                 cache, new_cache_g, cache_g,
             )
             emit = valid & (s_idx == S - 1)
-            tok = jnp.argmax(
-                unembed_local(y[:, 0]), axis=-1
-            ).astype(jnp.int32)
+            tok = sample(unembed_local(y[:, 0]), step_keys[n])
             outbuf = lax.dynamic_update_slice(
                 outbuf,
                 jnp.where(
@@ -395,11 +433,11 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
     fn = jax.jit(jax.shard_map(
         device_fn,
         mesh=mesh,
-        in_specs=(P(), P(AXIS_STAGE), P(None, *data_axes)),
+        in_specs=(P(), P(AXIS_STAGE), P(None, *data_axes), P()),
         out_specs=P(None, *data_axes),
     ))
 
-    def generate_fn(params, prompts):
+    def generate_fn(params, prompts, key=None):
         params = cfg.cast_params(params)
         if prompts.ndim != 3 or prompts.shape[0] != G:
             raise ValueError(
@@ -412,7 +450,11 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                 f"prompt {T} + max_new_tokens {N} exceeds "
                 f"max_seq_len {cfg.max_seq_len}"
             )
+        if temperature != 0 and key is None:
+            raise ValueError("temperature > 0 sampling needs a PRNG key")
+        if key is None:
+            key = jax.random.key(0)  # unused by the greedy sampler
         embed_params = {k: v for k, v in params.items() if k != "blocks"}
-        return fn(embed_params, params["blocks"], prompts)
+        return fn(embed_params, params["blocks"], prompts, key)
 
     return generate_fn
