@@ -447,3 +447,90 @@ def test_pipeline_generate_sampled_matches_single_chip():
     # temperature > 0 without a key rejects.
     with pytest.raises(ValueError, match="PRNG key"):
         fn(params_pp, prompts[0])
+
+
+def test_pipeline_generate_data_shards_sample_independently():
+    # ADVICE r4 (medium): sampled pipelined decode on a data > 1 mesh
+    # must fold the data-shard index into the key (tp_generate.py's
+    # rule) — identical keys would draw identical gumbel noise on
+    # every shard, duplicating continuations at matching local
+    # indices. Same-prompt rows in different shards must diverge.
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pp_generate import (
+        make_pipeline_generate,
+        make_pipeline_generate_overlapped,
+    )
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_seq_len=24,
+    )
+    params = init_transformer(jax.random.key(81), cfg)
+    params_pp = dict(params, blocks=shard_blocks(params["blocks"], 2))
+    mesh = build_mesh(MeshSpec(stage=2, data=2))
+    N = 8
+
+    # Rows 0/1 on data shard 0, rows 2/3 on shard 1 — identical prompts.
+    prompt = jnp.tile(jnp.asarray([[3, 1, 4, 1, 5, 9]], jnp.int32), (4, 1))
+    fn = make_pipeline_generate(mesh, cfg, 2, N, temperature=1.0)
+    out = np.asarray(fn(params_pp, prompt, key=jax.random.key(5)))
+    assert (not np.array_equal(out[0], out[2])
+            or not np.array_equal(out[1], out[3]))
+
+    # Same property through the overlapped decoder (Bg shards on data).
+    prompts = jnp.tile(
+        jnp.asarray([[2, 7, 1, 8, 2, 8]], jnp.int32), (2, 4, 1)
+    )  # (G=2, Bg=4, T=6)
+    fno = make_pipeline_generate_overlapped(
+        mesh, cfg, 2, N, num_groups=2, temperature=1.0
+    )
+    outo = np.asarray(fno(params_pp, prompts, key=jax.random.key(5)))
+    assert (not np.array_equal(outo[0, 0], outo[0, 2])
+            or not np.array_equal(outo[0, 1], outo[0, 3]))
+
+
+def test_pipeline_generate_shares_validator_contract():
+    # ADVICE r4 (low): the pipelined wrappers route through
+    # validate_generate_args — the same contract as the single-chip /
+    # tp paths — instead of ad-hoc checks that drifted (they accepted
+    # T + N == max_seq_len + 1 and silently ignored top_k at
+    # temperature 0).
+    from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+    from tpu_dist_nn.parallel.pp_generate import (
+        make_pipeline_generate,
+        make_pipeline_generate_overlapped,
+    )
+    from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+        max_seq_len=24,
+    )
+    params = init_transformer(jax.random.key(91), cfg)
+    params_pp = dict(params, blocks=shard_blocks(params["blocks"], 2))
+    mesh = build_mesh(MeshSpec(stage=2, data=1))
+    prompt = jnp.zeros((2, 8), jnp.int32)
+
+    # T + N == max_seq_len + 1: single-chip rejects; pipelined must too.
+    fn = make_pipeline_generate(mesh, cfg, 2, max_new_tokens=17)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        fn(params_pp, prompt)
+
+    # top_k at temperature == 0 would be silently ignored — reject.
+    fnk = make_pipeline_generate(mesh, cfg, 2, 4, temperature=0.0, top_k=5)
+    with pytest.raises(ValueError, match="top_k"):
+        fnk(params_pp, prompt)
+
+    # Same contract through the overlapped wrapper.
+    prompts = jnp.zeros((2, 2, 8), jnp.int32)
+    fno = make_pipeline_generate_overlapped(
+        mesh, cfg, 2, 17, num_groups=2
+    )
+    with pytest.raises(ValueError, match="max_seq_len"):
+        fno(params_pp, prompts)
+    fnob = make_pipeline_generate_overlapped(
+        mesh, cfg, 2, 4, num_groups=2, temperature=1.0, top_p=1.5
+    )
+    with pytest.raises(ValueError, match="top_p"):
+        fnob(params_pp, prompts, key=jax.random.key(0))

@@ -29,9 +29,11 @@ TPU-first structure (no data-dependent control flow, no branches):
   bubble (continuous batching) is the natural extension and would
   reuse these tables.
 
-Greedy only (``temperature == 0`` semantics): parity-tested
-token-for-token against the single-chip
-:func:`~tpu_dist_nn.models.generate.generate`.
+Parity vs the single-chip :func:`~tpu_dist_nn.models.generate.generate`
+(both decoders, tested): greedy is token-for-token on any mesh; sampled
+(``temperature > 0``) is token-for-token when the data axis is 1. With
+data > 1 the key folds in the data-shard index (the tp_generate rule)
+so shards draw independent noise — a documented stream divergence.
 """
 
 from __future__ import annotations
@@ -45,6 +47,7 @@ from tpu_dist_nn.models.generate import (
     _truncate_logits,
     decode_blocks,
     prefill_blocks,
+    validate_generate_args,
 )
 from tpu_dist_nn.models.transformer import (
     TransformerConfig,
@@ -86,9 +89,14 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
     layout (the training layout); embedding/unembed params replicated.
     The batch shards over ``data`` if the mesh has that axis. Sampling
     follows the single-chip semantics and KEY SCHEDULE exactly
-    (greedy at ``temperature == 0``, no key needed), so streams match
-    :func:`~tpu_dist_nn.models.generate.generate` token-for-token at
-    any temperature.
+    (greedy at ``temperature == 0``, no key needed). Greedy streams
+    match :func:`~tpu_dist_nn.models.generate.generate`
+    token-for-token on any mesh; sampled streams match when the data
+    axis is 1. With data > 1 each data shard folds its shard index
+    into the key (tp_generate.py's rule — identical keys would draw
+    identical noise on every shard, duplicating continuations), so
+    sampled streams are a documented divergence from the single-chip
+    order, not a silent one.
     """
     S = num_stages
     N = max_new_tokens
@@ -98,6 +106,16 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
         blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
         s_idx = lax.axis_index(AXIS_STAGE)
         B, T = prompt.shape
+        if fold_data:
+            # Each data shard holds DIFFERENT batch rows: fold the
+            # shard index into the key (tp_generate.py's rule) or every
+            # shard would draw identical gumbel noise — duplicated
+            # continuations at matching local indices. Stage shards
+            # keep the same folded key: they must agree on the token.
+            # Skipped at data == 1 so those streams stay key-for-key
+            # equal to the single-chip schedule (fold_in(key, 0) would
+            # still be a different key).
+            key = jax.random.fold_in(key, lax.axis_index(AXIS_DATA))
         step_keys = _step_keys(key, max(N - 1, 1))
         D = cfg.d_model
         total = T + N
@@ -204,6 +222,7 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
         return jnp.concatenate([prompt, new_tokens], axis=1)
 
     data_axes = (AXIS_DATA,) if AXIS_DATA in mesh.shape else ()
+    fold_data = AXIS_DATA in mesh.shape and mesh.shape[AXIS_DATA] > 1
     # One compiled program for the whole prefill+decode loop (the
     # sibling single-chip/tp decoders enforce the same property).
     fn = jax.jit(jax.shard_map(
@@ -215,16 +234,13 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
 
     def generate_fn(params, prompt, key=None):
         params = cfg.cast_params(params)
-        T = prompt.shape[1]
-        if T + N > cfg.max_seq_len + 1:
-            raise ValueError(
-                f"prompt {T} + max_new_tokens {N} exceeds "
-                f"max_seq_len {cfg.max_seq_len}"
-            )
-        if temperature != 0 and key is None:
-            raise ValueError("temperature > 0 sampling needs a PRNG key")
-        if key is None:
-            key = jax.random.key(0)  # unused by the greedy sampler
+        # The shared argument contract (models/generate.py) — the same
+        # validator the single-chip and tp paths call, so the three
+        # decoders cannot drift (lengths, causality, sampling ranges,
+        # greedy-vs-top_k conflicts). Returns a dummy key when greedy.
+        key = validate_generate_args(
+            cfg, prompt.shape[1], N, temperature, top_k, top_p, key
+        )
         embed_params = {
             k: v for k, v in params.items() if k != "blocks"
         }
@@ -257,7 +273,15 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
     trade.
 
     -> ``fn(params_staged, prompts (G, Bg, T)) -> (G, Bg, T + N)``;
-    greedy, token-for-token equal to decoding each group alone.
+    token-for-token equal to decoding each group alone (greedy on any
+    mesh; sampled when data == 1 — data > 1 folds the shard index into
+    the key, see :func:`make_pipeline_generate`). That parity contract
+    means every group SHARES the one key schedule — identical prompts
+    in different groups sample identical continuations, exactly as G
+    separate single-chip ``generate`` calls with the same key would.
+    Best-of-N over groups needs per-group keys; fold the group index
+    yourself (``fold_in(key, g)``) and decode groups against their own
+    keys, or accept the duplication.
     """
     S, N, G = num_stages, max_new_tokens, num_groups
     sample = _make_sampler(float(temperature), top_k, top_p)
@@ -273,6 +297,11 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
         blocks = jax.tree.map(lambda a: a[0], blocks_st)  # (L/S, ...)
         s_idx = lax.axis_index(AXIS_STAGE)
         _, Bg, T = prompts.shape  # group count == G (validated outside)
+        if fold_data:
+            # Same rule as make_pipeline_generate: distinct noise per
+            # data shard, shared across the stage ring; skipped at
+            # data == 1 to preserve the single-chip key schedule.
+            key = jax.random.fold_in(key, lax.axis_index(AXIS_DATA))
         step_keys = _step_keys(key, max(N - 1, 1))
         total = T + N
         max_len = total - 1
@@ -430,6 +459,7 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
         return jnp.concatenate([prompts, new_tokens], axis=2)
 
     data_axes = (AXIS_DATA,) if AXIS_DATA in mesh.shape else ()
+    fold_data = AXIS_DATA in mesh.shape and mesh.shape[AXIS_DATA] > 1
     fn = jax.jit(jax.shard_map(
         device_fn,
         mesh=mesh,
@@ -444,16 +474,11 @@ def make_pipeline_generate_overlapped(mesh, cfg: TransformerConfig,
                 f"prompts must be (num_groups={G}, Bg, T), got "
                 f"{prompts.shape}"
             )
-        T = prompts.shape[2]
-        if T + N > cfg.max_seq_len + 1:
-            raise ValueError(
-                f"prompt {T} + max_new_tokens {N} exceeds "
-                f"max_seq_len {cfg.max_seq_len}"
-            )
-        if temperature != 0 and key is None:
-            raise ValueError("temperature > 0 sampling needs a PRNG key")
-        if key is None:
-            key = jax.random.key(0)  # unused by the greedy sampler
+        # Shared contract (models/generate.py) — see make_pipeline_
+        # generate's wrapper.
+        key = validate_generate_args(
+            cfg, prompts.shape[2], N, temperature, top_k, top_p, key
+        )
         embed_params = {k: v for k, v in params.items() if k != "blocks"}
         return fn(embed_params, params["blocks"], prompts, key)
 
