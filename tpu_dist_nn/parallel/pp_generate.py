@@ -108,13 +108,13 @@ def make_pipeline_generate(mesh, cfg: TransformerConfig, num_stages: int,
         B, T = prompt.shape
         if fold_data:
             # Each data shard holds DIFFERENT batch rows: fold the
-            # shard index into the key (tp_generate.py's rule) or every
-            # shard would draw identical gumbel noise — duplicated
-            # continuations at matching local indices. Stage shards
-            # keep the same folded key: they must agree on the token.
-            # Skipped at data == 1 so those streams stay key-for-key
-            # equal to the single-chip schedule (fold_in(key, 0) would
-            # still be a different key).
+            # shard index into the key (the rule tp_generate shares) or
+            # every shard would draw identical gumbel noise —
+            # duplicated continuations at matching local indices.
+            # Stage shards keep the same folded key: they must agree on
+            # the token. Skipped at data == 1 so those streams stay
+            # key-for-key equal to the single-chip schedule
+            # (fold_in(key, 0) would still be a different key).
             key = jax.random.fold_in(key, lax.axis_index(AXIS_DATA))
         step_keys = _step_keys(key, max(N - 1, 1))
         D = cfg.d_model

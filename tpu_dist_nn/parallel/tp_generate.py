@@ -95,15 +95,21 @@ def _compiled_tp_generate(mesh, cfg, T, max_new_tokens, temperature,
             jnp.int32
         )
 
+    fold_data = mesh.shape[AXIS_DATA] > 1
+
     def device_fn(ep, blocks_tp, prompt, key):
         blocks = {
             k: (v if k in TP_REPLICATED else v[0]) for k, v in blocks_tp.items()
         }
-        # Each data shard holds DIFFERENT batch rows: fold the shard
-        # index into the key or every shard would draw identical noise
-        # (duplicated continuations at matching local indices). Model
-        # shards keep the same key — they must sample the same token.
-        key = jax.random.fold_in(key, lax.axis_index(AXIS_DATA))
+        if fold_data:
+            # Each data shard holds DIFFERENT batch rows: fold the
+            # shard index into the key or every shard would draw
+            # identical noise (duplicated continuations at matching
+            # local indices). Model shards keep the same key — they
+            # must sample the same token. Skipped at data == 1 (the
+            # rule pp_generate shares) so those streams keep the
+            # single-chip key schedule.
+            key = jax.random.fold_in(key, lax.axis_index(AXIS_DATA))
         Bl = prompt.shape[0]
         x = ep["tok_embed"][prompt] + ep["pos_embed"][:T]
 
