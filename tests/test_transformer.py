@@ -198,7 +198,7 @@ class TestTextData:
         # Default: the VENDORED real corpus (committed with the package)
         # wins over the synthetic generator.
         text, source = load_corpus()
-        assert source.endswith("licenses_corpus.txt") and len(text) > 100_000
+        assert source.endswith("realtext_corpus.txt") and len(text) > 5_000_000
         assert "GNU GENERAL PUBLIC LICENSE" in text  # real bytes, not Zipf
         # An explicit WikiText-style file still takes precedence.
         f = tmp_path / "wiki.train.tokens"
@@ -213,6 +213,7 @@ class TestTextData:
         monkeypatch.delenv("TDN_WIKITEXT_PATH", raising=False)
         missing = text_mod._VENDORED_CORPUS.with_name("nope.txt")
         monkeypatch.setattr(text_mod, "_VENDORED_CORPUS", missing)
+        monkeypatch.setattr(text_mod, "_VENDORED_CORPUS_R3", missing)
         monkeypatch.setattr(text_mod, "_DEFAULT_PATHS", ())
         text, source = text_mod.load_corpus(synthetic_chars=1000)
         assert source == "synthetic" and len(text) == 1000

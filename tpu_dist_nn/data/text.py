@@ -5,8 +5,9 @@ environment has zero network egress, so corpus acquisition is tiered:
 :func:`load_corpus` reads a real WikiText file when one is present
 (``TDN_WIKITEXT_PATH`` or a conventional path), then falls back to the
 VENDORED real corpus shipped in this package
-(``data/corpus/licenses_corpus.txt`` — ~238 KB of real human-written
-English from the Debian common-licenses texts, built by
+(``data/corpus/realtext_corpus.txt`` — ~8 MB of real English
+paragraph-deduped from this box's on-disk text, with the round-3
+~238 KB ``licenses_corpus.txt`` kept as the next tier; both built by
 ``tools/make_text_corpus.py``; the round-3 vendored-digits move applied
 to text), and only generates the deterministic synthetic
 Wikipedia-markup-alike when even that is missing — so by default every
@@ -35,9 +36,16 @@ _DEFAULT_PATHS = (
     "/root/data/wikitext-2/wiki.train.tokens",
     "/root/data/wikitext-2-raw/wiki.train.raw",
 )
-# The vendored real corpus (tools/make_text_corpus.py): last real
-# candidate before the synthetic fallback.
+# The vendored real corpora (tools/make_text_corpus.py): last real
+# candidates before the synthetic fallback. The 8 MB round-5 corpus is
+# preferred — the 238 KB licenses tier cannot sustain a valid held-out
+# split at seq >= 512 (VERDICT r4 missing item 3) — with the r3 file
+# kept next in line so the r3/r4 records stay reproducible on a tree
+# where the big corpus was pruned.
 _VENDORED_CORPUS = Path(__file__).resolve().parent / (
+    "corpus/realtext_corpus.txt"
+)
+_VENDORED_CORPUS_R3 = Path(__file__).resolve().parent / (
     "corpus/licenses_corpus.txt"
 )
 
@@ -111,6 +119,7 @@ def load_corpus(path: str | os.PathLike | None = None, *,
         candidates.append(Path(os.environ[_WIKITEXT_ENV]))
     candidates.extend(Path(p) for p in _DEFAULT_PATHS)
     candidates.append(_VENDORED_CORPUS)
+    candidates.append(_VENDORED_CORPUS_R3)
     for cand in candidates:
         if cand.is_file():
             return cand.read_text(encoding="utf-8", errors="replace"), str(cand)
