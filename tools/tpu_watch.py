@@ -158,6 +158,25 @@ def capture_all(tag: str, watch_log: str) -> bool:
         "pipeline latency + trace",
         watch_log,
     )
+
+    # 4. Round-5 scale suite (85M MFU A/B + trace, 25.5M valid-eval
+    # re-derivation, seq-8192) when the runner exists — AFTER the
+    # steps-1-3 commit so a mid-suite tunnel drop cannot cost them.
+    scale_runner = os.path.join(REPO, "tools", "tpu_scale_r05.py")
+    if os.path.isfile(scale_runner):
+        rc, out, err = _run(
+            [sys.executable, scale_runner, "--budget", "1800"],
+            timeout=2100,
+        )
+        _append(watch_log, f"{_now()} scale suite rc={rc} "
+                           f"{(out.splitlines() or [''])[-1][:200]}")
+        ok &= rc == 0
+        _git_commit(
+            [os.path.join(REPO, "artifacts", "tpu_scale_r05"), watch_log],
+            f"Real-TPU scale suite ({tag}): 85M MFU A/B, 25.5M valid "
+            "eval, seq-8192",
+            watch_log,
+        )
     return ok
 
 

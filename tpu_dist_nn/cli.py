@@ -1047,6 +1047,8 @@ def cmd_lm(args) -> int:
         clip_norm=args.clip_norm, warmup_steps=args.warmup_steps,
         lr_schedule=args.lr_schedule, weight_decay=args.weight_decay,
         grad_accum=args.grad_accum,
+        steps_per_call=getattr(args, "steps_per_call", 1),
+        log_every=getattr(args, "log_every", 50),
     )
     batches = lm_batches(
         train_rows, local_batch_size, seed=args.seed, epochs=None
@@ -1063,17 +1065,27 @@ def cmd_lm(args) -> int:
     if num_virtual is None:
         num_virtual = 2 if args.schedule == "interleaved" else 1
     t0 = time.monotonic()
-    params, history = train_lm(
-        params, cfg, batches, train_cfg, mesh=mesh,
-        num_stages=args.stages, num_microbatches=args.microbatches,
-        checkpoints=checkpoints, step_fn=step_fn,
-        # A step_fn branch that consumed --schedule already encodes it;
-        # train_lm's own schedule validation applies to the built-in
-        # pipelined path only.
-        schedule="gpipe" if schedule_handled else args.schedule,
-        globalize=globalize,
-        num_virtual=num_virtual,
-    )
+    import contextlib
+
+    trace_ctx = contextlib.nullcontext()
+    if getattr(args, "profile_dir", None):
+        from tpu_dist_nn.utils.profiling import capture_trace
+
+        trace_ctx = capture_trace(args.profile_dir)
+    with trace_ctx:
+        params, history = train_lm(
+            params, cfg, batches, train_cfg, mesh=mesh,
+            num_stages=args.stages, num_microbatches=args.microbatches,
+            checkpoints=checkpoints, step_fn=step_fn,
+            # A step_fn branch that consumed --schedule already encodes
+            # it; train_lm's own schedule validation applies to the
+            # built-in pipelined path only.
+            schedule="gpipe" if schedule_handled else args.schedule,
+            globalize=globalize,
+            num_virtual=num_virtual,
+        )
+    if getattr(args, "profile_dir", None):
+        log.info("device trace written to %s", args.profile_dir)
     train_seconds = time.monotonic() - t0
     if unshard_fn is not None:
         params = unshard_fn(params)
@@ -1554,6 +1566,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="average gradients over N micro-steps per "
                         "optimizer update (N x effective batch at one "
                         "micro-batch's memory)")
+    p.add_argument("--steps-per-call", type=int, default=1,
+                   help="K optimizer steps per device call (one "
+                        "lax.scan over a K-step superbatch): removes "
+                        "per-step Python dispatch + host sync on the "
+                        "single-chip path; losses fetch once per call")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
@@ -1625,6 +1642,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-out",
                    help="write per-step training records + the final "
                         "eval report as JSONL here")
+    p.add_argument("--log-every", type=int, default=50,
+                   help="record loss every N steps (each record is a "
+                        "value-fetch barrier — the honest timing "
+                        "points on the tunneled TPU)")
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler device trace of the "
+                        "training loop here")
     p.add_argument("--sample-bytes", type=int, default=0,
                    help="generate this many bytes after training")
     p.add_argument("--prompt", default="The ", help="generation prompt")

@@ -40,6 +40,12 @@ class LMTrainConfig:
     lr_schedule: str = "constant"
     weight_decay: float = 0.0
     grad_accum: int = 1
+    # K training steps per device call (one lax.scan over a (K, B, T+1)
+    # superbatch): kills the per-step Python dispatch + host round-trip
+    # that capped real-workload MFU at ~0.21 on the live TPU (VERDICT
+    # r4 item 1 / artifacts/tpu_scale_r04 mfu_note). Built-in
+    # single-chip path only.
+    steps_per_call: int = 1
 
 
 def _resolve_attn_fn(attn_fn):
@@ -70,23 +76,56 @@ def make_step_body(loss_fn, optimizer, value_and_grad=None):
     return step
 
 
-def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
+def make_lm_train_step(cfg: TransformerConfig, optimizer, attn_fn=None, *,
+                       donate: bool = False, steps_per_call: int = 1):
     """jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
 
     ``attn_fn=None`` picks the backend default (the Pallas flash kernel
     on TPU, the jnp reference elsewhere).
+
+    ``donate=True`` donates the (params, opt_state) input buffers to
+    XLA so the update aliases them in place instead of allocating a
+    fresh copy of every parameter and moment each step — at 85M params
+    that is ~1 GB of HBM writes per step saved. The caller's input
+    arrays are INVALIDATED by each call (rebind to the results, as
+    :func:`train_lm` does); default False so ad-hoc callers that reuse
+    a params pytree across step functions keep working.
+
+    ``steps_per_call=K > 1`` returns a superstep
+    ``(params, opt_state, tokens_k (K, B, T+1)) -> (..., losses (K,))``
+    running K optimizer steps in ONE ``lax.scan``-ed device program:
+    no Python dispatch, no host sync, no loss fetch between the K
+    steps — the input-pipeline shape the TPU wants. Losses come back
+    as a K-vector (one fetch per superstep when the caller logs).
     """
     attn_fn = _resolve_attn_fn(attn_fn)
-    return jax.jit(
-        make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer)
-    )
+    body = make_step_body(lambda p, t: lm_loss(p, t, cfg, attn_fn), optimizer)
+    donate_kw = {"donate_argnums": (0, 1)} if donate else {}
+    if steps_per_call == 1:
+        return jax.jit(body, **donate_kw)
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+
+    def superstep(params, opt_state, tokens_k):
+        def scan_body(carry, toks):
+            p, o = carry
+            p, o, loss = body(p, o, toks)
+            return (p, o), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            scan_body, (params, opt_state), tokens_k
+        )
+        return params, opt_state, losses
+
+    return jax.jit(superstep, **donate_kw)
 
 
 def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
                                 num_microbatches: int, optimizer,
                                 attn_fn=None, schedule: str = "gpipe",
                                 num_virtual: int = 1,
-                                tensor_parallel: int = 1):
+                                tensor_parallel: int = 1,
+                                donate: bool = False):
     """Pipelined train step.
 
     ``schedule``: "gpipe" (AD through the forward schedule; blocks in
@@ -112,6 +151,12 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    # Same donation contract as make_lm_train_step: opt-in in-place
+    # (params, opt_state) update; each call invalidates its inputs so
+    # callers must rebind (train_lm does).
+    _jit = functools.partial(
+        jax.jit, **({"donate_argnums": (0, 1)} if donate else {})
+    )
     attn = _resolve_attn_fn(attn_fn)
     if tensor_parallel > 1 and mesh.shape.get(AXIS_MODEL, 1) != tensor_parallel:
         raise ValueError(
@@ -128,7 +173,7 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
             if tensor_parallel > 1 else tpl.make_pipeline_lm_zb_v_grad
         )
         vag = make(mesh, cfg, num_microbatches, attn)
-        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+        return _jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule in ("interleaved", "zb"):
         # Both ride the table executor on the shard_blocks_interleaved
         # (or _tp) layout; "zb" swaps in the split-backward zero-bubble
@@ -143,7 +188,7 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
             ("zb", True): tpl.make_pipeline_tp_lm_zb_grad,
         }[(schedule, tensor_parallel > 1)]
         vag = make(mesh, cfg, num_virtual, num_microbatches, attn)
-        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+        return _jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule == "1f1b":
         if tensor_parallel > 1:
             from tpu_dist_nn.parallel.transformer_pipeline import (
@@ -161,7 +206,7 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
             vag = make_pipeline_lm_1f1b_grad(
                 mesh, cfg, num_stages, num_microbatches, attn
             )
-        return jax.jit(make_step_body(None, optimizer, value_and_grad=vag))
+        return _jit(make_step_body(None, optimizer, value_and_grad=vag))
     if tensor_parallel > 1:
         from tpu_dist_nn.parallel.transformer_pipeline import (
             make_pipeline_tp_lm_loss,
@@ -170,9 +215,9 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
         loss_fn = make_pipeline_tp_lm_loss(
             mesh, cfg, num_stages, num_microbatches, attn
         )
-        return jax.jit(make_step_body(loss_fn, optimizer))
+        return _jit(make_step_body(loss_fn, optimizer))
     loss_fn = make_pipeline_lm_loss(mesh, cfg, num_stages, num_microbatches, attn)
-    return jax.jit(make_step_body(loss_fn, optimizer))
+    return _jit(make_step_body(loss_fn, optimizer))
 
 
 def lm_block_layout(sched: str, stages: int, num_virtual: int, *,
@@ -447,6 +492,15 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     batches stay process-local and every host trains its own divergent
     model — so that case warns and requires the caller to feed IDENTICAL
     data on every host (replicated training).
+
+    Device-residency (VERDICT r4 item 1 — the 0.21-MFU suspects): the
+    built-in steps run with donated (params, opt_state) buffers — the
+    incoming pytrees are copied ONCE so the caller's arrays survive,
+    then every update aliases in place. With
+    ``train_cfg.steps_per_call=K > 1`` (single-chip path only) the loop
+    feeds K-step superbatches through one ``lax.scan``-ed device
+    program: no per-step Python dispatch, loss fetched at most once
+    per group (checkpoint saves then land on group boundaries).
     """
     from tpu_dist_nn.checkpoint.store import resume_or_init
 
@@ -478,6 +532,35 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
             "replicated per host (identical data required on every host); "
             "no cross-host parallelism"
         )
+    k = train_cfg.steps_per_call
+    if k < 1:
+        # Same contract as make_lm_train_step: reject, don't clamp — a
+        # silently-ignored 0 would make an A/B harness believe it
+        # measured an arm that never ran.
+        raise ValueError(f"steps_per_call must be >= 1, got {k}")
+    if k > 1 and train_cfg.log_every % k != 0:
+        # Mid-group history entries would all be stamped at the group's
+        # single device call, so their `seconds` deltas are not
+        # value-fetch barriers — the dishonest-timing failure the r4
+        # forensics rule exists to prevent. Requiring log boundaries to
+        # land on group ends keeps every logged timestamp a true fetch.
+        raise ValueError(
+            f"log_every ({train_cfg.log_every}) must be a multiple of "
+            f"steps_per_call ({k}): per-step timestamps inside one "
+            "grouped device call are not fetch barriers"
+        )
+    if k > 1 and (step_fn is not None or pipelined):
+        raise ValueError(
+            "steps_per_call > 1 is the built-in single-chip path only "
+            "(custom step_fn and pipelined schedules run one step per "
+            "call)"
+        )
+    if k > 1 and globalize is not None:
+        raise ValueError(
+            "steps_per_call > 1 does not compose with a multi-host "
+            "batch globalizer; set steps_per_call=1 for multi-host runs"
+        )
+    multi = None
     if step_fn is not None:
         step = step_fn(optimizer)
     elif pipelined and schedule == "zb-v":
@@ -490,7 +573,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         )
         step = make_pipeline_lm_train_step(
             mesh, cfg, num_stages, num_microbatches, optimizer,
-            schedule=schedule,
+            schedule=schedule, donate=True,
         )
     elif pipelined and schedule in ("interleaved", "zb"):
         from tpu_dist_nn.parallel.transformer_pipeline import (
@@ -505,16 +588,20 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         )
         step = make_pipeline_lm_train_step(
             mesh, cfg, num_stages, num_microbatches, optimizer,
-            schedule=schedule, num_virtual=num_virtual,
+            schedule=schedule, num_virtual=num_virtual, donate=True,
         )
     elif pipelined:
         params = dict(params, blocks=shard_blocks(params["blocks"], num_stages))
         step = make_pipeline_lm_train_step(
             mesh, cfg, num_stages, num_microbatches, optimizer,
-            schedule=schedule,
+            schedule=schedule, donate=True,
         )
     else:
-        step = make_lm_train_step(cfg, optimizer)
+        step = make_lm_train_step(cfg, optimizer, donate=True)
+        if k > 1:
+            multi = make_lm_train_step(
+                cfg, optimizer, donate=True, steps_per_call=k
+            )
     # A step may carry its own (e.g. sharded, ZeRO-1) state init —
     # eager optimizer.init would materialize full replicated moments.
     opt_state = getattr(step, "init_opt_state", optimizer.init)(params)
@@ -522,30 +609,66 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         checkpoints, {"params": params, "opt_state": opt_state}
     )
     params, opt_state = state["params"], state["opt_state"]
+    if step_fn is None:
+        # The built-in steps donate their (params, opt_state) inputs:
+        # copy once so the CALLER's pytree (and a restore template a
+        # test may reuse) is never invalidated — every later input is
+        # loop-internal and safely consumed in place.
+        params = jax.tree.map(jnp.copy, params)
+        opt_state = jax.tree.map(jnp.copy, opt_state)
     every = checkpoint_every or train_cfg.log_every
 
     history = []
     t0 = time.monotonic()
+
+    def _flush_group(group):
+        """Run the buffered (index, batch) group as ONE device call."""
+        nonlocal params, opt_state
+        if len(group) == 1 and multi is None:
+            i, batch = group[0]
+            gb = (
+                globalize(batch) if globalize is not None
+                else jnp.asarray(batch)
+            )
+            params, opt_state, loss = step(params, opt_state, gb)
+            losses = [loss]
+        else:
+            # (K, B, T+1) superbatch; a shorter FINAL group re-traces
+            # once for its length (the scan program is length-static).
+            stack = jnp.asarray(np.stack([b for _, b in group]))
+            params, opt_state, losses_v = multi(params, opt_state, stack)
+            losses = [losses_v[j] for j in range(len(group))]
+        for j, (i, _) in enumerate(group):
+            if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
+                # float() is the only host sync — one fetch per logged
+                # step, at most one per group.
+                history.append(
+                    {"step": i + 1, "loss": float(losses[j]),
+                     "seconds": time.monotonic() - t0}
+                )
+        if checkpoints is not None and any(
+            (i + 1) % every == 0 or i == train_cfg.steps - 1
+            for i, _ in group
+        ):
+            i_last = group[-1][0]
+            checkpoints.save(
+                i_last + 1, {"params": params, "opt_state": opt_state},
+                metadata={"step": i_last + 1, "loss": float(losses[-1])},
+            )
+
     try:
+        group = []
         for i, batch in enumerate(batches):
             if i >= train_cfg.steps:
                 break
             if i < start_step:
                 continue  # replay-skip: keeps a seeded stream aligned
-            gb = globalize(batch) if globalize is not None else jnp.asarray(batch)
-            params, opt_state, loss = step(params, opt_state, gb)
-            if (i + 1) % train_cfg.log_every == 0 or i == train_cfg.steps - 1:
-                history.append(
-                    {"step": i + 1, "loss": float(loss),
-                     "seconds": time.monotonic() - t0}
-                )
-            if checkpoints is not None and (
-                (i + 1) % every == 0 or i == train_cfg.steps - 1
-            ):
-                checkpoints.save(
-                    i + 1, {"params": params, "opt_state": opt_state},
-                    metadata={"step": i + 1, "loss": float(loss)},
-                )
+            group.append((i, batch))
+            if len(group) == k or i == train_cfg.steps - 1:
+                _flush_group(group)
+                group = []
+        if group:
+            _flush_group(group)
     except BaseException:
         # Enqueued async saves become durable even when the loop
         # raises — the crash-resume guarantee is the point. On this
@@ -602,14 +725,17 @@ def _jitted_moe_ce(cfg):
 
 
 def _evaluate_ce(loss_fn, params, rows: np.ndarray, batch_size: int) -> dict:
-    losses, weights = [], []
+    # Per-batch losses stay ON DEVICE (full batches are equal-weight,
+    # so a plain mean is the weighted mean); the single float() at the
+    # end is the only host sync — per-batch float() was one blocking
+    # round-trip per eval batch on the tunneled TPU.
+    losses = []
     for i in range(0, len(rows) - batch_size + 1, batch_size):
         batch = jnp.asarray(rows[i : i + batch_size])
-        losses.append(float(loss_fn(params, batch)))
-        weights.append(len(batch))
+        losses.append(loss_fn(params, batch))
     if not losses:
         raise ValueError("not enough rows for one eval batch")
-    loss = float(np.average(losses, weights=weights))
+    loss = float(jnp.mean(jnp.stack(losses)))
     return {
         "loss_nats_per_token": loss,
         "perplexity": float(np.exp(loss)),
