@@ -1,0 +1,48 @@
+"""Meta-guards for the `-m quick` tier (conftest.QUICK_TESTS).
+
+The quick tier is a curated list; lists rot. These tests make the rot
+loud: every test module must contribute at least one quick test, and
+every curated entry must still resolve to a real test in its module —
+a renamed or deleted test fails here instead of silently shrinking the
+tier's coverage.
+"""
+
+import glob
+import os
+import re
+
+from tests.conftest import QUICK_TESTS
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _modules():
+    return sorted(
+        os.path.basename(p)[:-3]
+        for p in glob.glob(os.path.join(TESTS_DIR, "test_*.py"))
+    )
+
+
+def test_every_module_has_a_quick_entry():
+    missing = [m for m in _modules() if m not in QUICK_TESTS]
+    assert not missing, (
+        f"test modules without a quick-tier entry: {missing} — add "
+        "representatives to tests/conftest.py QUICK_TESTS"
+    )
+
+
+def test_every_quick_entry_resolves():
+    stale = []
+    for module, entries in QUICK_TESTS.items():
+        path = os.path.join(TESTS_DIR, module + ".py")
+        if not os.path.isfile(path):
+            stale.append(f"{module}: module missing")
+            continue
+        src = open(path).read()
+        for entry in entries:
+            if entry == "*":
+                continue
+            bare = entry.split("[")[0]
+            if not re.search(rf"def {re.escape(bare)}\(", src):
+                stale.append(f"{module}::{entry}")
+    assert not stale, f"quick-tier entries that no longer resolve: {stale}"

@@ -321,3 +321,33 @@ def test_cli_lm_steps_per_call(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert '"final_train_loss"' in out
+
+
+def test_steps_per_call_resume_realigns_to_step_grid(tmp_path):
+    # Resume from a checkpoint whose step is NOT a multiple of K: the
+    # first post-resume group must shorten so later groups land back on
+    # the global grid (log boundaries stay fetch barriers), and the
+    # trajectory must match an unbroken run exactly.
+    from tpu_dist_nn.checkpoint import CheckpointManager
+    from tpu_dist_nn.train.lm_trainer import LMTrainConfig, train_lm
+
+    cfg, params, batches = _lm_setup()
+    ref_cfg = LMTrainConfig(
+        steps=8, batch_size=4, seq_len=16, log_every=4, steps_per_call=4,
+    )
+    _, ref_hist = train_lm(params, cfg, batches(), ref_cfg)
+
+    mgr = CheckpointManager(tmp_path)
+    # Interrupted run: 3 completed steps checkpointed (3 % 4 != 0).
+    pre_cfg = LMTrainConfig(
+        steps=3, batch_size=4, seq_len=16, log_every=1, steps_per_call=1,
+    )
+    train_lm(params, cfg, batches(), pre_cfg, checkpoints=mgr,
+             checkpoint_every=3)
+    assert mgr.latest_step() == 3
+    _, hist = train_lm(params, cfg, batches(), ref_cfg, checkpoints=mgr)
+    by_step = {h["step"]: h["loss"] for h in hist}
+    ref_by_step = {h["step"]: h["loss"] for h in ref_hist}
+    assert set(by_step) == {4, 8}  # grid preserved across the resume
+    for s, loss in by_step.items():
+        np.testing.assert_allclose(loss, ref_by_step[s], rtol=1e-6)

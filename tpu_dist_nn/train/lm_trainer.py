@@ -664,7 +664,12 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
             if i < start_step:
                 continue  # replay-skip: keeps a seeded stream aligned
             group.append((i, batch))
-            if len(group) == k or i == train_cfg.steps - 1:
+            # Flush on the GLOBAL step grid, not group length: a resume
+            # from a checkpoint at start_step % k != 0 would otherwise
+            # shift every later group off the log_every boundaries and
+            # stamp mid-group (non-fetch-barrier) timestamps — the
+            # first post-resume group is simply shorter instead.
+            if (i + 1) % k == 0 or i == train_cfg.steps - 1:
                 _flush_group(group)
                 group = []
         if group:
