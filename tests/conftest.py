@@ -36,9 +36,24 @@ if os.environ.get("TDN_TEST_TPU", "0") != "1":
 import tempfile  # noqa: E402
 
 _user = os.environ.get("USER") or os.environ.get("LOGNAME") or str(os.getuid())
+# The cache key includes a CPU-feature fingerprint: XLA:CPU AOT entries
+# compiled on a machine with different vector extensions SIGILL/abort
+# when loaded on this one (observed round 5 — "+prefer-no-scatter is
+# not supported on the host machine" followed by a fatal abort mid
+# suite), and /tmp can outlive a box swap on shared infrastructure.
+import hashlib  # noqa: E402
+
+try:
+    with open("/proc/cpuinfo") as _f:
+        _flags = next(
+            (ln for ln in _f if ln.startswith("flags")), ""
+        )
+    _fp = hashlib.sha1(_flags.encode()).hexdigest()[:8]
+except OSError:
+    _fp = "nofp"
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.join(tempfile.gettempdir(), f"tdn_jax_cache_{_user}"),
+    os.path.join(tempfile.gettempdir(), f"tdn_jax_cache_{_user}_{_fp}"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -419,10 +419,173 @@ def test_sp_ep_ulysses_and_train_step_and_cli(capsys):
     ])
     assert rc == 0
     assert "perplexity" in capsys.readouterr().out
-    # MoE x SP x PP stays rejected.
+    # MoE x SP x PP composes since round 5 (gpipe; the default) — only
+    # the scheduled three-axis variants stay bounded
+    # (test_pp_sp_ep_ulysses_matches_ring_and_cli asserts both sides).
     assert main([
         "--platform", "cpu", "lm", "--steps", "1", "--batch-size", "4",
         "--seq-len", "15", "--d-model", "16", "--heads", "2",
         "--layers", "2", "--experts", "2", "--seq-parallel", "2",
         "--stages", "2",
-    ]) != 0
+    ]) == 0
+
+
+def test_ep_tp_loss_and_grads_match_grouped_oracle():
+    # TP-INSIDE-EXPERTS (round 5; previously rejected as "expert banks
+    # are already sharded"): flat (model=2, expert=2, data=2) mesh,
+    # each expert's FFN Megatron-split over `model` (column-parallel
+    # up, row-parallel down + one psum). Must equal the flat EP math —
+    # i.e. the grouped oracle with n_groups = data*expert — exactly
+    # (modulo the psum's float reassociation).
+    from tpu_dist_nn.parallel.expert_parallel import make_ep_tp_lm_loss
+
+    mesh = build_mesh(MeshSpec(model=2, expert=2, data=2))
+    params = init_moe_transformer(jax.random.key(41), CFG)
+    tokens = _tokens(batch=8, seq=17, seed=42)
+
+    loss_tp = make_ep_tp_lm_loss(mesh, CFG)
+    params_ep = dict(params, blocks=ep_shard_blocks(params["blocks"], 2))
+    v_tp, g_tp = jax.jit(jax.value_and_grad(loss_tp))(params_ep, tokens)
+    v_ref, g_ref = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: moe_lm_loss(p, t, CFG, n_groups=4)
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(float(v_tp), float(v_ref), rtol=1e-5)
+    g_blocks = ep_unshard_blocks(g_tp["blocks"])
+    for k in g_ref["blocks"]:
+        np.testing.assert_allclose(
+            np.asarray(g_ref["blocks"][k]), np.asarray(g_blocks[k]),
+            rtol=5e-4, atol=1e-5, err_msg=k,
+        )
+    for k in ("tok_embed", "pos_embed", "lnf_g", "lnf_b"):
+        np.testing.assert_allclose(
+            np.asarray(g_ref[k]), np.asarray(g_tp[k]), rtol=5e-4,
+            atol=1e-5, err_msg=k,
+        )
+
+
+def test_ep_tp_rejects_indivisible_ff():
+    from tpu_dist_nn.parallel.expert_parallel import make_ep_tp_lm_loss
+
+    mesh = build_mesh(MeshSpec(model=3, expert=2))
+    import dataclasses
+
+    bad = dataclasses.replace(CFG, d_ff=64)  # 64 % 3 != 0
+    with pytest.raises(ValueError, match="d_ff"):
+        make_ep_tp_lm_loss(mesh, bad)
+
+
+def test_pp_sp_ep_loss_and_grads_match_grouped_oracle():
+    # THREE-AXIS MoE (round 5; the cell round 4 left eagerly rejected):
+    # pipeline x sequence x expert parallelism, gpipe schedule, on a
+    # (stage=2, seq=2, expert=2) mesh. Oracle: single-chip MoE forward
+    # with (batch slice x seq slice) routing groups —
+    # moe_ffn_apply(n_groups=M*expert, n_seq_groups=seq) — and the sp
+    # masking convention for the CE (full rows, final position
+    # unscored).
+    from tpu_dist_nn.models.transformer import masked_next_token_ce
+    from tpu_dist_nn.parallel.expert_parallel import (
+        make_pipeline_sp_ep_lm_loss,
+        shard_blocks_pp_ep,
+        unshard_blocks_pp_ep,
+    )
+
+    mesh = build_mesh(MeshSpec(stage=2, seq=2, expert=2))
+    params = init_moe_transformer(jax.random.key(51), CFG)
+    M = 2
+    tokens = _tokens(batch=4, seq=16, seed=52)  # full rows
+
+    loss3 = make_pipeline_sp_ep_lm_loss(
+        mesh, CFG, num_stages=2, num_microbatches=M, mode="ring"
+    )
+    params_pp = dict(
+        params, blocks=shard_blocks_pp_ep(params["blocks"], 2, 2)
+    )
+    v3, g3 = jax.jit(jax.value_and_grad(loss3))(params_pp, tokens)
+
+    def oracle(p, t):
+        ffn = lambda block, h: moe_ffn_apply(  # noqa: E731
+            block, h, CFG, n_groups=M * 2, n_seq_groups=2
+        )
+        logits, aux = moe_forward(p, t, CFG, ffn_fn=ffn)
+        return masked_next_token_ce(logits, t) + CFG.router_aux_weight * aux
+
+    v_ref, g_ref = jax.jit(jax.value_and_grad(oracle))(params, tokens)
+    np.testing.assert_allclose(float(v3), float(v_ref), rtol=1e-5)
+    g_blocks = unshard_blocks_pp_ep(g3["blocks"])
+    for k in g_ref["blocks"]:
+        np.testing.assert_allclose(
+            np.asarray(g_ref["blocks"][k]), np.asarray(g_blocks[k]),
+            rtol=5e-4, atol=1e-5, err_msg=k,
+        )
+    for k in ("tok_embed", "pos_embed", "lnf_g", "lnf_b"):
+        np.testing.assert_allclose(
+            np.asarray(g_ref[k]), np.asarray(g3[k]), rtol=5e-4,
+            atol=1e-5, err_msg=k,
+        )
+
+
+def test_pp_sp_ep_ulysses_matches_ring_and_cli(capsys):
+    # Ulysses mode agrees with the ring on identical shards, and the
+    # CLI drives the three-axis cell end to end; scheduled variants
+    # stay bounded with an explicit message (gpipe only).
+    from tpu_dist_nn.cli import main
+    from tpu_dist_nn.parallel.expert_parallel import (
+        make_pipeline_sp_ep_lm_loss,
+        shard_blocks_pp_ep,
+    )
+
+    mesh = build_mesh(MeshSpec(stage=2, seq=2, expert=2))
+    params = init_moe_transformer(jax.random.key(53), CFG)
+    params_pp = dict(
+        params, blocks=shard_blocks_pp_ep(params["blocks"], 2, 2)
+    )
+    tokens = _tokens(batch=4, seq=16, seed=54)
+    v_ring = float(jax.jit(make_pipeline_sp_ep_lm_loss(
+        mesh, CFG, 2, 2, "ring"
+    ))(params_pp, tokens))
+    v_uly = float(jax.jit(make_pipeline_sp_ep_lm_loss(
+        mesh, CFG, 2, 2, "ulysses"
+    ))(params_pp, tokens))
+    np.testing.assert_allclose(v_ring, v_uly, rtol=1e-5)
+
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "1", "--batch-size", "8",
+        "--seq-len", "15", "--d-model", "32", "--heads", "4",
+        "--layers", "4", "--experts", "4", "--stages", "2",
+        "--seq-parallel", "2", "--expert-parallel", "2",
+        "--microbatches", "2",
+    ])
+    assert rc == 0
+    assert "final_train_loss" in capsys.readouterr().out
+    # Scheduled three-axis variants are bounded, not silent.
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "1", "--batch-size", "8",
+        "--seq-len", "15", "--experts", "4", "--stages", "2",
+        "--seq-parallel", "2", "--schedule", "1f1b",
+    ])
+    assert rc != 0
+    assert "gpipe" in capsys.readouterr().err
+
+
+def test_ep_tp_cli_and_bounded_products(capsys):
+    # `tdn lm --experts --tensor-parallel` end to end, and the bounded
+    # products (x --stages, x --seq-parallel) reject with the
+    # documented message rather than silently.
+    from tpu_dist_nn.cli import main
+
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "1", "--batch-size", "8",
+        "--seq-len", "16", "--d-model", "32", "--heads", "4",
+        "--layers", "2", "--experts", "4", "--tensor-parallel", "2",
+        "--expert-parallel", "2",
+    ])
+    assert rc == 0
+    assert "final_train_loss" in capsys.readouterr().out
+    rc = main([
+        "--platform", "cpu", "lm", "--steps", "1", "--experts", "4",
+        "--tensor-parallel", "2", "--stages", "2",
+    ])
+    assert rc != 0
+    assert "out of scope" in capsys.readouterr().err

@@ -46,3 +46,44 @@ def test_every_quick_entry_resolves():
             if not re.search(rf"def {re.escape(bare)}\(", src):
                 stale.append(f"{module}::{entry}")
     assert not stale, f"quick-tier entries that no longer resolve: {stale}"
+
+
+def test_bracketed_quick_entries_match_collected_ids():
+    # A source-regex check cannot see parametrize ids: renaming a
+    # param (e.g. [4-2] -> [expert4-groups2]) would silently drop the
+    # entry from the tier while the bare-name check still passes. This
+    # collects the bracketed modules for real (subprocess — collection
+    # imports them) and requires every bracketed id to exist.
+    import subprocess
+    import sys
+
+    bracketed = {
+        module: [e for e in entries if "[" in e]
+        for module, entries in QUICK_TESTS.items()
+        if any("[" in e for e in entries)
+    }
+    files = [os.path.join(TESTS_DIR, m + ".py") for m in bracketed]
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-p", "no:cacheprovider", *files],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(TESTS_DIR),
+    )
+    collected = set()
+    for line in out.stdout.splitlines():
+        if "::" in line:
+            # Final segment only (class-scoped tests carry
+            # `file::Class::name[id]`) — the same name-based matching
+            # conftest's marker application uses.
+            collected.add(line.strip().rsplit("::", 1)[1])
+    assert collected, f"collection produced nothing:\n{out.stdout[-2000:]}"
+    missing = [
+        f"{m}::{e}"
+        for m, entries in bracketed.items()
+        for e in entries
+        if e not in collected
+    ]
+    assert not missing, (
+        f"bracketed quick-tier ids not collected (param ids changed?): "
+        f"{missing}"
+    )

@@ -560,24 +560,42 @@ def cmd_lm(args) -> int:
             "--schedule zb for a free chunk count"
         )
     if args.tensor_parallel > 1:
-        if args.stages <= 1:
-            raise ValueError(
-                "--tensor-parallel shards each pipeline stage's blocks: "
-                "it requires --stages > 1 (use "
-                "--sample-tensor-parallel for sharded decode)"
-            )
         if moe:
-            raise ValueError(
-                "--tensor-parallel does not compose with --experts "
-                "(expert FFN banks are already sharded over the "
-                "expert axis)"
-            )
-        if args.heads % args.tensor_parallel:
-            raise ValueError(
-                f"--heads {args.heads} must be divisible by "
-                f"--tensor-parallel {args.tensor_parallel} "
-                "(Megatron shards attention head-wise)"
-            )
+            # TP-INSIDE-EXPERTS (round 5; previously rejected as
+            # "expert banks are already sharded"): each expert's FFN
+            # Megatron-splits over `model` on the flat mesh. The
+            # pipelined product stays out of scope (README footnote).
+            if args.stages > 1:
+                raise ValueError(
+                    "--tensor-parallel x --experts x --stages is out "
+                    "of scope: TP-inside-experts runs on the flat "
+                    "(model, expert, data) mesh; pipelined MoE shards "
+                    "experts over `expert` (README matrix footnote)"
+                )
+            if args.seq_parallel > 1:
+                raise ValueError(
+                    "--tensor-parallel x --experts x --seq-parallel "
+                    "is out of scope (README matrix footnote)"
+                )
+            if (4 * args.d_model) % args.tensor_parallel:
+                raise ValueError(
+                    f"d_ff={4 * args.d_model} must be divisible by "
+                    f"--tensor-parallel {args.tensor_parallel} "
+                    "(TP-inside-experts shards the FF dim)"
+                )
+        else:
+            if args.stages <= 1:
+                raise ValueError(
+                    "--tensor-parallel shards each pipeline stage's "
+                    "blocks: it requires --stages > 1 (use "
+                    "--sample-tensor-parallel for sharded decode)"
+                )
+            if args.heads % args.tensor_parallel:
+                raise ValueError(
+                    f"--heads {args.heads} must be divisible by "
+                    f"--tensor-parallel {args.tensor_parallel} "
+                    "(Megatron shards attention head-wise)"
+                )
     if args.sample_tensor_parallel > 1 and args.sample_bytes <= 0:
         raise ValueError(
             "--sample-tensor-parallel requires --sample-bytes > 0 "
@@ -658,10 +676,13 @@ def cmd_lm(args) -> int:
     # wraps moe_block_apply in maybe_remat.)
     if args.zero1 and moe:
         raise ValueError("--zero1 supports the dense LM only")
-    if args.seq_parallel > 1 and moe and args.stages > 1:
+    if (args.seq_parallel > 1 and moe and args.stages > 1
+            and args.schedule != "gpipe"):
         raise ValueError(
-            "--seq-parallel with --experts does not compose with "
-            "--stages (long-context MoE is the flat sp x ep mesh)"
+            "--experts x --seq-parallel x --stages supports --schedule "
+            "gpipe only (three-axis MoE rides the branch-free gpipe "
+            "executor; the scheduled executors' three-axis product is "
+            "out of scope — README matrix footnote)"
         )
     if args.fsdp and moe:
         raise ValueError("--fsdp supports the dense LM only")
@@ -707,7 +728,57 @@ def cmd_lm(args) -> int:
         )
         init_fn, eval_fn = init_moe_transformer, evaluate_moe_lm
         ep, dp = args.expert_parallel, args.data_parallel
-        if args.stages > 1:
+        if args.stages > 1 and args.seq_parallel > 1:
+            # THREE-AXIS MoE (round 5; previously rejected): pipeline x
+            # sequence x expert parallelism on the (stage, seq, expert,
+            # data) mesh — gpipe only (validated above), full rows with
+            # the sp masking convention.
+            from tpu_dist_nn.parallel.expert_parallel import (
+                shard_blocks_pp_ep,
+                unshard_blocks_pp_ep,
+            )
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.train.lm_trainer import (
+                make_pipeline_moe_lm_train_step,
+            )
+
+            if args.layers % args.stages:
+                raise ValueError(
+                    f"--layers {args.layers} must be divisible by "
+                    f"--stages {args.stages}"
+                )
+            if (args.seq_len + 1) % args.seq_parallel:
+                raise ValueError(
+                    f"--seq-len+1 ({args.seq_len + 1}) must be divisible "
+                    f"by --seq-parallel {args.seq_parallel} (rows carry "
+                    "the next-token target)"
+                )
+            if args.batch_size % (args.microbatches * max(ep, 1) * dp):
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible by "
+                    f"microbatches*expert_parallel*data_parallel="
+                    f"{args.microbatches * max(ep, 1) * dp}"
+                )
+            pp_sp_ep_mesh = build_mesh(MeshSpec(
+                stage=args.stages, seq=args.seq_parallel,
+                expert=max(ep, 1), data=dp,
+            ))
+            global_mesh, global_span = pp_sp_ep_mesh, max(ep, 1) * dp
+            global_axes = "_data_expert_"
+            schedule_handled = True
+            _stages, _mb = args.stages, args.microbatches
+            _mode, _ep = args.sp_mode, max(ep, 1)
+            step_fn = lambda opt: make_pipeline_moe_lm_train_step(  # noqa: E731
+                pp_sp_ep_mesh, cfg, _stages, _mb, opt, schedule="gpipe",
+                sp_mode=_mode,
+            )
+            shard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=shard_blocks_pp_ep(p["blocks"], _stages, _ep)
+            )
+            unshard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=unshard_blocks_pp_ep(p["blocks"])
+            )
+        elif args.stages > 1:
             # Pipeline x expert parallelism: MoE blocks pipelined over
             # `stage`, experts sharded over `expert` inside each stage,
             # batch over (data, expert) — round 4, previously rejected.
@@ -775,6 +846,38 @@ def cmd_lm(args) -> int:
             _mode = args.sp_mode
             step_fn = lambda opt: make_sp_moe_lm_train_step(  # noqa: E731
                 sp_ep_mesh, cfg, opt, mode=_mode
+            )
+            _ep = max(ep, 1)
+            shard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=ep_shard_blocks(p["blocks"], _ep)
+            )
+            unshard_fn = lambda p: dict(  # noqa: E731
+                p, blocks=ep_unshard_blocks(p["blocks"])
+            )
+        elif args.tensor_parallel > 1:
+            # TP-INSIDE-EXPERTS (round 5; previously rejected): flat
+            # (model, expert, data) mesh, each expert's FFN
+            # Megatron-split over `model` (column-parallel up,
+            # row-parallel down + one psum). Params stay in the
+            # ep_shard_blocks layout — the model axis is a sharding
+            # annotation on the FF dim.
+            from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+            from tpu_dist_nn.train.lm_trainer import (
+                make_ep_tp_moe_lm_train_step,
+            )
+
+            if args.batch_size % (max(ep, 1) * dp):
+                raise ValueError(
+                    f"--batch-size {args.batch_size} must be divisible "
+                    f"by expert_parallel*data_parallel={max(ep, 1) * dp}"
+                )
+            ep_tp_mesh = build_mesh(MeshSpec(
+                model=args.tensor_parallel, expert=max(ep, 1), data=dp
+            ))
+            global_mesh, global_span = ep_tp_mesh, max(ep, 1) * dp
+            global_axes = "_data_expert_"
+            step_fn = lambda opt: make_ep_tp_moe_lm_train_step(  # noqa: E731
+                ep_tp_mesh, cfg, opt
             )
             _ep = max(ep, 1)
             shard_fn = lambda p: dict(  # noqa: E731
@@ -1098,9 +1201,11 @@ def cmd_lm(args) -> int:
             "over the FULL dataset (includes training rows)",
             len(eval_rows), args.batch_size,
         )
+    cap = getattr(args, "eval_batches", 512)
     eval_metrics = eval_fn(
         params, cfg, eval_rows if held_out else rows,
         batch_size=args.batch_size,
+        max_batches=cap if cap > 0 else None,
     )
     report = {
         "train_seconds": round(train_seconds, 2),
@@ -1646,6 +1751,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record loss every N steps (each record is a "
                         "value-fetch barrier — the honest timing "
                         "points on the tunneled TPU)")
+    p.add_argument("--eval-batches", type=int, default=512,
+                   help="cap the held-out eval at N batches (0 = the "
+                        "full split; the 8 MB corpus can mean "
+                        "thousands of eval batches at small seq). "
+                        "The report records eval_rows_used")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace of the "
                         "training loop here")

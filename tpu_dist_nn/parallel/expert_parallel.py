@@ -338,10 +338,23 @@ def ep_unshard_blocks(staged: dict) -> dict:
     return out
 
 
-def _make_ep_ffn(cfg: MoEConfig):
+def _make_ep_ffn(cfg: MoEConfig, expert_fn=None):
     """THE sharded routed-FFN body (route, all_to_all dispatch, local
     expert bank, all_to_all return) — one definition shared by the flat
-    EP executor and the pipelined composition."""
+    EP executor, the pipelined compositions, and (via ``expert_fn``)
+    TP-inside-experts.
+
+    ``expert_fn(block, buf) -> buf``: the local expert-bank MLP on the
+    dispatched ``(E_loc, n_ep*C, D)`` buffer; default is the plain
+    :func:`_expert_ffn` bank, the TP path swaps in the Megatron-split
+    one. Routing/dispatch/combine stay THIS one definition either way.
+    """
+    if expert_fn is None:
+        def expert_fn(block, buf):
+            return _expert_ffn(
+                block["w_up"], block["b_up"], block["w_down"],
+                block["b_down"], buf,
+            )
 
     def ep_ffn(block, h):
         """Sharded routed FFN on this device's token shard ``h (b, T, D)``."""
@@ -361,9 +374,7 @@ def _make_ep_ffn(cfg: MoEConfig):
         buf = lax.all_to_all(
             buf, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
         )
-        out = _expert_ffn(
-            block["w_up"], block["b_up"], block["w_down"], block["b_down"], buf,
-        )
+        out = expert_fn(block, buf)
         out = lax.all_to_all(
             out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
         )  # back to (E, C, D), rows for this shard's tokens
@@ -656,6 +667,224 @@ def make_pipeline_ep_lm_loss(mesh, cfg: MoEConfig, num_stages: int,
         # the oracle's mean over blocks and groups.
         aux = aux_sum / (S * M * n_shards)
         return ce + cfg.router_aux_weight * aux
+
+    return loss_fn
+
+
+def make_pipeline_sp_ep_lm_loss(mesh, cfg: MoEConfig, num_stages: int,
+                                num_microbatches: int, mode: str = "ring"):
+    """-> ``loss_fn(params, tokens) -> scalar``: THREE-AXIS MoE —
+    pipeline × sequence × expert parallelism (the cell round 4 left
+    eagerly rejected: "long-context MoE is the flat sp x ep mesh").
+
+    The two parent compositions supply every mechanism and this factory
+    only composes them: the stage body is the PP×EP MoE block scan with
+    the attention swapped for the SP decomposition (ring ppermute
+    rotation or Ulysses — gpipe's executor has no ``lax.switch``
+    branches, so the ring keeps its cheap rotation exactly like the
+    dense pp × sp path, transformer_pipeline.make_pipeline_sp_lm_forward),
+    and each microbatch's SEQUENCE dim shards over ``seq`` on the wire
+    (T/n_seq bytes per stage hop). Routing stays position-local, so each
+    ``(data, expert, seq)`` shard of each microbatch routes its own
+    contiguous (batch slice × seq slice) token block — the grouping the
+    flat SP×EP path established, oracle
+    ``moe_ffn_apply(n_groups=M*data*expert, n_seq_groups=seq)``.
+
+    Loss follows the SP convention (full input+target rows, final
+    position masked — the flat SP×EP path's masked_next_token_ce), with
+    embedding/unembed outside the schedule on globally-sharded arrays.
+
+    Scheduled variants (1f1b/interleaved/zb/zb-v) × SP × EP remain
+    out of scope: the executors' aux channel and the in-schedule
+    group-local ring rotation each compose with SP or EP separately
+    (both shipped), but their THREE-axis product adds a second varying
+    collective per tick body with no new mechanism to validate it
+    against — the gpipe cell here carries the three-axis parity
+    evidence. ``params["blocks"]`` in :func:`shard_blocks_pp_ep`
+    layout.
+    """
+    from tpu_dist_nn.models.transformer import (
+        embed,
+        masked_next_token_ce,
+        maybe_remat,
+        unembed,
+    )
+    from tpu_dist_nn.parallel.gpipe import make_gpipe
+    from tpu_dist_nn.parallel.mesh import AXIS_SEQ, AXIS_STAGE
+    from tpu_dist_nn.parallel.ring_attention import _sp_attn_fn
+
+    n_ep = mesh.shape[AXIS_EXPERT]
+    n_seq = mesh.shape[AXIS_SEQ]
+    if cfg.n_experts % n_ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by expert axis {n_ep}"
+        )
+    S, M = num_stages, num_microbatches
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+    ep_ffn = _make_ep_ffn(cfg)
+    attn_fn = _sp_attn_fn(mode)
+
+    def stage_fn(stage_blocks, x):
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in stage_blocks.items()
+        }
+        apply = maybe_remat(cfg, moe_block_apply)
+
+        def body(carry, block):
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_ffn)
+            return y, aux
+
+        y, auxs = lax.scan(body, x, blocks)
+        return y, jnp.mean(auxs)
+
+    blocks_spec = {
+        k: (P(AXIS_STAGE, AXIS_EXPERT) if k in EP_SHARDED else P(AXIS_STAGE))
+        for k in MOE_BLOCK_KEYS
+    }
+    gpipe = make_gpipe(
+        mesh, stage_fn, S, M,
+        microbatch_spec=P((AXIS_DATA, AXIS_EXPERT), AXIS_SEQ, None),
+        stage_params_spec=blocks_spec,
+        with_aux=True,
+    )
+
+    def loss_fn(params, tokens):
+        params = cfg.cast_params(params)
+        B, T = tokens.shape  # FULL rows (sp convention — no shift)
+        if B % (M * n_shards):
+            raise ValueError(
+                f"batch {B} not divisible by microbatches*data*expert "
+                f"shards = {M * n_shards}"
+            )
+        if T % n_seq:
+            raise ValueError(
+                f"sequence length {T} not divisible by seq axis {n_seq} "
+                "(sp feeds full input+target rows)"
+            )
+        if T > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}"
+            )
+        embed_params = {k: v for k, v in params.items() if k != "blocks"}
+        x = embed(embed_params, tokens)
+        xs = x.reshape(M, B // M, T, cfg.d_model)
+        ys, aux_sum = gpipe(xs, params["blocks"])
+        logits = unembed(embed_params, ys.reshape(B, T, cfg.d_model))
+        ce = masked_next_token_ce(logits, tokens)
+        # One per-stage block-mean aux term per (stage, microbatch,
+        # (data, expert, seq) shard): normalize to the oracle's mean.
+        aux = aux_sum / (S * M * n_shards * n_seq)
+        return ce + cfg.router_aux_weight * aux
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# Tensor parallelism INSIDE the expert bank (TP x EP)
+# ---------------------------------------------------------------------------
+
+def make_ep_tp_lm_loss(mesh, cfg: MoEConfig,
+                       attn_fn=dot_product_attention):
+    """-> ``loss_fn(params_ep, tokens) -> scalar``: experts sharded over
+    ``expert`` AND each expert's FFN Megatron-sharded over ``model`` —
+    the cell round 4 rejected with "expert FFN banks are already
+    sharded over the expert axis". Large-expert regimes shard both in
+    practice: the expert axis bounds sharding at E experts, while the
+    d_ff dim keeps growing; TP-inside-experts is the standard second
+    cut (column-parallel w_up/b_up, row-parallel w_down with one psum,
+    b_down added after — the exact Megatron MLP recipe applied per
+    expert).
+
+    Routing, dispatch (all_to_all over ``expert``) and combine are
+    replicated across ``model`` shards (the router is tiny; attention
+    stays data-sharded over ``(data, expert)`` as in the flat EP path —
+    this composition targets the expert-bank MEMORY, which dominates
+    MoE params). Numerics: identical to the flat EP path up to the one
+    psum's float reassociation; parity-tested against the grouped
+    oracle. ``params_ep["blocks"]`` in :func:`ep_shard_blocks` layout —
+    the model axis is a pure sharding annotation on the F dim, not a
+    host relayout.
+    """
+    from tpu_dist_nn.models.transformer import (
+        embed,
+        maybe_remat,
+        unembed,
+    )
+    from tpu_dist_nn.parallel.mesh import AXIS_MODEL
+
+    n_ep = mesh.shape[AXIS_EXPERT]
+    n_tp = mesh.shape[AXIS_MODEL]
+    if cfg.n_experts % n_ep:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} not divisible by expert axis {n_ep}"
+        )
+    if cfg.d_ff % n_tp:
+        raise ValueError(
+            f"d_ff={cfg.d_ff} not divisible by model axis {n_tp} "
+            "(TP-inside-experts shards the FF dim)"
+        )
+    n_shards = mesh.shape[AXIS_DATA] * n_ep
+
+    def megatron_expert_fn(block, buf):
+        # Megatron MLP per expert: column-parallel up (F dim local),
+        # row-parallel down (partial sums over model), bias once after
+        # the psum. Routing/dispatch stay _make_ep_ffn's one body.
+        hft = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", buf, block["w_up"])
+            + block["b_up"][:, None, :]
+        )
+        part = jnp.einsum("ecf,efd->ecd", hft, block["w_down"])
+        return lax.psum(part, AXIS_MODEL) + block["b_down"][:, None, :]
+
+    ep_tp_ffn = _make_ep_ffn(cfg, expert_fn=megatron_expert_fn)
+
+    def device_fn(embed_params, blocks_ep, tokens):
+        blocks = {
+            k: (v[0] if k in EP_SHARDED else v) for k, v in blocks_ep.items()
+        }
+        inputs = tokens[:, :-1]
+        x = embed(embed_params, inputs)
+        apply = maybe_remat(cfg, moe_block_apply)
+
+        def body(carry, block):
+            y, aux = apply(block, carry, cfg, 1, attn_fn, ep_tp_ffn)
+            return y, aux
+
+        x, auxs = lax.scan(body, x, blocks)
+        logits = unembed(embed_params, x)
+        ce = next_token_ce(logits, tokens[:, 1:])
+        ce = lax.pmean(lax.pmean(ce, AXIS_DATA), AXIS_EXPERT)
+        aux = lax.pmean(lax.pmean(jnp.mean(auxs), AXIS_DATA), AXIS_EXPERT)
+        return ce + cfg.router_aux_weight * aux
+
+    # ep_shard_blocks layout: EP-sharded leaves lead with the expert
+    # shard; the F dim additionally shards over `model` (w_up
+    # (n_ep, L, E_loc, D, F): dim 4; b_up (n_ep, L, E_loc, F): dim 3;
+    # w_down (n_ep, L, E_loc, F, D): dim 3). b_down rides the psum side
+    # replicated, like Megatron's down-proj bias.
+    blocks_specs = {
+        k: (P(AXIS_EXPERT) if k in EP_SHARDED else P())
+        for k in MOE_BLOCK_KEYS
+    }
+    blocks_specs["w_up"] = P(AXIS_EXPERT, None, None, None, AXIS_MODEL)
+    blocks_specs["b_up"] = P(AXIS_EXPERT, None, None, AXIS_MODEL)
+    blocks_specs["w_down"] = P(AXIS_EXPERT, None, None, AXIS_MODEL, None)
+    fn = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(), blocks_specs, P((AXIS_DATA, AXIS_EXPERT))),
+        out_specs=P(),
+    )
+
+    def loss_fn(params_ep, tokens):
+        B = tokens.shape[0]
+        if B % n_shards:
+            raise ValueError(
+                f"batch {B} not divisible by data*expert shards {n_shards}"
+            )
+        params_ep = cfg.cast_params(params_ep)
+        embed_params = {k: v for k, v in params_ep.items() if k != "blocks"}
+        return fn(embed_params, params_ep["blocks"], tokens)
 
     return loss_fn
 

@@ -20,7 +20,11 @@ import numpy as np
 import optax
 
 from tpu_dist_nn.checkpoint.store import flush
-from tpu_dist_nn.models.transformer import TransformerConfig, lm_loss
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    dot_product_attention,
+    lm_loss,
+)
 from tpu_dist_nn.parallel.transformer_pipeline import (
     make_pipeline_lm_loss,
     shard_blocks,
@@ -283,7 +287,8 @@ def lm_block_layout(sched: str, stages: int, num_virtual: int, *,
 def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
                                     num_microbatches: int, optimizer,
                                     attn_fn=None, schedule: str = "gpipe",
-                                    num_virtual: int = 1):
+                                    num_virtual: int = 1,
+                                    sp_mode: str | None = None):
     """Pipeline x expert-parallel MoE train step: blocks pipelined over
     ``stage``, experts sharded over ``expert`` inside each stage, batch
     over ``(data, expert)``. Blocks in
@@ -306,6 +311,32 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if sp_mode is not None:
+        # THREE-AXIS MoE (pp x sp x ep): gpipe only — tokens follow the
+        # sp convention (full rows, masked CE), so the scheduled
+        # executors' shifted-target tails don't apply; see
+        # make_pipeline_sp_ep_lm_loss's docstring for the boundary.
+        from tpu_dist_nn.parallel.expert_parallel import (
+            make_pipeline_sp_ep_lm_loss,
+        )
+
+        if schedule != "gpipe":
+            raise ValueError(
+                f"--experts x --seq-parallel x --stages supports the "
+                f"gpipe schedule only (got {schedule!r}): the scheduled "
+                "executors' three-axis product (aux channel + "
+                "in-schedule ring + expert all_to_all per tick branch) "
+                "is out of scope; the gpipe cell carries the "
+                "three-axis parity evidence"
+            )
+        return jax.jit(
+            make_step_body(
+                make_pipeline_sp_ep_lm_loss(
+                    mesh, cfg, num_stages, num_microbatches, sp_mode
+                ),
+                optimizer,
+            )
+        )
     attn_fn = _resolve_attn_fn(attn_fn)
     if schedule == "zb-v":
         from tpu_dist_nn.parallel.expert_parallel import (
@@ -460,11 +491,28 @@ def make_sp_moe_lm_train_step(mesh, cfg, optimizer, mode: str = "ring"):
     )
 
 
+def make_ep_tp_moe_lm_train_step(mesh, cfg, optimizer,
+                                 attn_fn=dot_product_attention):
+    """TP-inside-experts train step: experts over ``expert`` AND each
+    expert's FFN Megatron-split over ``model`` (the cell previously
+    rejected as "expert banks are already sharded").
+    ``params["blocks"]`` in ep_shard_blocks layout — the model axis is
+    a sharding annotation, not a host relayout."""
+    from tpu_dist_nn.parallel.expert_parallel import make_ep_tp_lm_loss
+
+    return jax.jit(
+        make_step_body(make_ep_tp_lm_loss(mesh, cfg, attn_fn), optimizer)
+    )
+
+
 def evaluate_moe_lm(params, cfg, rows: np.ndarray,
-                    batch_size: int = 16) -> dict:
+                    batch_size: int = 16,
+                    max_batches: int | None = None) -> dict:
     """MoE eval: CE only (router aux excluded) so perplexity/bits-per-
     byte are comparable with the dense model's numbers."""
-    return _evaluate_ce(_jitted_moe_ce(cfg), params, rows, batch_size)
+    return _evaluate_ce(
+        _jitted_moe_ce(cfg), params, rows, batch_size, max_batches
+    )
 
 
 def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
@@ -729,26 +777,40 @@ def _jitted_moe_ce(cfg):
     return ce
 
 
-def _evaluate_ce(loss_fn, params, rows: np.ndarray, batch_size: int) -> dict:
-    # Per-batch losses stay ON DEVICE (full batches are equal-weight,
-    # so a plain mean is the weighted mean); the single float() at the
-    # end is the only host sync — per-batch float() was one blocking
-    # round-trip per eval batch on the tunneled TPU.
-    losses = []
+def _evaluate_ce(loss_fn, params, rows: np.ndarray, batch_size: int,
+                 max_batches: int | None = None) -> dict:
+    # Per-batch losses accumulate in ONE on-device running sum (full
+    # batches are equal-weight, so the mean of batch means is the
+    # weighted mean); the single float() at the end is the only host
+    # sync — per-batch float() was one blocking round-trip per eval
+    # batch on the tunneled TPU. A running scalar, not a list: the
+    # 8 MB corpus can mean thousands of eval batches, and stacking
+    # thousands of unsynced device values aborted XLA:CPU (round 5).
+    total, n = None, 0
     for i in range(0, len(rows) - batch_size + 1, batch_size):
+        if max_batches is not None and n >= max_batches:
+            break
         batch = jnp.asarray(rows[i : i + batch_size])
-        losses.append(loss_fn(params, batch))
-    if not losses:
+        loss_b = loss_fn(params, batch)
+        total = loss_b if total is None else total + loss_b
+        n += 1
+    if n == 0:
         raise ValueError("not enough rows for one eval batch")
-    loss = float(jnp.mean(jnp.stack(losses)))
+    loss = float(total) / n
     return {
         "loss_nats_per_token": loss,
         "perplexity": float(np.exp(loss)),
         "bits_per_byte": loss / np.log(2),
+        # The count this loop ACTUALLY consumed — callers report it
+        # instead of re-deriving the batching arithmetic.
+        "eval_rows_used": n * batch_size,
     }
 
 
 def evaluate_lm(params, cfg: TransformerConfig, rows: np.ndarray,
-                batch_size: int = 16) -> dict:
+                batch_size: int = 16,
+                max_batches: int | None = None) -> dict:
     """Mean next-token CE + perplexity + bits/byte over ``(N, T+1)`` rows."""
-    return _evaluate_ce(_jitted_lm_loss(cfg), params, rows, batch_size)
+    return _evaluate_ce(
+        _jitted_lm_loss(cfg), params, rows, batch_size, max_batches
+    )
