@@ -594,6 +594,92 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
     )
     out["concurrent_clients"] = clients
     out["rpcs_per_client"] = rpcs_per_client
+
+    # LM GENERATION endpoint (round 5): the KV-cached decoder behind
+    # the same wire — coalesced tokens/s on a toy LM through the full
+    # loopback path. Runs single-chip (any device count, incl. the one
+    # real TPU); the pipelined-overlapped endpoint needs >= 2 devices
+    # and carries its artifact in artifacts/serving_generate_r05.
+    try:
+        import threading as _th
+
+        from tpu_dist_nn.models.transformer import (
+            TransformerConfig,
+            init_transformer,
+        )
+        from tpu_dist_nn.serving.server import serve_lm_generate
+
+        t_len, n_new = 16, 32
+        lm_cfg = TransformerConfig(
+            vocab_size=256, d_model=128, n_heads=4, n_layers=4,
+            d_ff=512, max_seq_len=t_len + n_new,
+        )
+        lm_params = init_transformer(jax.random.key(1), lm_cfg)
+        gsrv, gport = serve_lm_generate(
+            lm_params, lm_cfg, 0, max_new_tokens=n_new,
+            prompt_len=t_len, host="127.0.0.1", warm_rows=8,
+        )
+        try:
+            gclients = min(clients, 8)
+            grpcs = 4
+            lock = _th.Lock()
+            done: list[int] = []
+            gerrors: list[str] = []
+            # Prompts drawn on THIS thread: np.random.Generator is not
+            # thread-safe (run_concurrent follows the same rule).
+            gprompts = [
+                rng.integers(0, 256, (1, t_len)).astype(np.float64)
+                for _ in range(gclients)
+            ]
+
+            def gworker(i):
+                ok = 0
+                try:
+                    c = GrpcClient(f"127.0.0.1:{gport}")
+                    for _ in range(grpcs):
+                        c.generate(gprompts[i])
+                        ok += 1
+                    c.close()
+                except Exception as e:  # noqa: BLE001 — recorded
+                    with lock:
+                        gerrors.append(f"{type(e).__name__}: {e}"[:200])
+                finally:
+                    with lock:
+                        done.append(ok)
+
+            threads = [
+                _th.Thread(target=gworker, args=(i,))
+                for i in range(gclients)
+            ]
+            t0 = time.monotonic()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.monotonic() - t0
+            n_req = sum(done)
+            if n_req == 0:
+                raise RuntimeError(
+                    f"all generate workers failed: {gerrors[:3]}"
+                )
+            gb = gsrv.batcher
+            out["generate"] = {
+                "model": "d128/h4/L4 byte-vocab toy",
+                "prompt_len": t_len, "max_new_tokens": n_new,
+                "requests_per_s": round(n_req / wall, 1),
+                "generated_tokens_per_s": round(n_req * n_new / wall, 1),
+                "requests": gb.requests_total,
+                "batches": gb.batches_total,
+            }
+            if gerrors:
+                out["generate"]["completed"] = n_req
+                out["generate"]["errors"] = gerrors[:3]
+        finally:
+            gsrv.stop(0)
+    except Exception as e:  # noqa: BLE001 — must not cost the block
+        print(f"# generate serving bench unavailable "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        out["generate"] = None
     return out
 
 

@@ -141,7 +141,8 @@ QUICK_TESTS = {
     "test_schema": ["test_model_json_round_trip",
                     "test_shipped_sample_configs_load_and_run"],
     "test_serving": ["test_codec_round_trip",
-                     "test_grpc_round_trip_matches_local"],
+                     "test_grpc_round_trip_matches_local",
+                     "test_serve_generate_single_chip_and_validation"],
     "test_tensor_parallel": ["test_forward_matches_single_chip[spec1]",
                              "test_shard_roundtrip"],
     "test_tpu_hardware": ["*"],

@@ -1,7 +1,12 @@
 """Wire-compatible gRPC serving (the reference's LayerService protocol)."""
 
-from tpu_dist_nn.serving.server import GrpcClient, serve_engine  # noqa: F401
+from tpu_dist_nn.serving.server import (  # noqa: F401
+    GrpcClient,
+    serve_engine,
+    serve_lm_generate,
+)
 from tpu_dist_nn.serving.wire import (  # noqa: F401
+    GENERATE_METHOD,
     PROCESS_METHOD,
     decode_matrix,
     encode_matrix,

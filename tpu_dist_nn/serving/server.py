@@ -36,6 +36,7 @@ import grpc
 import numpy as np
 
 from tpu_dist_nn.serving.wire import (
+    GENERATE_METHOD,
     PROCESS_METHOD,
     SERVICE_NAME,
     decode_matrix,
@@ -57,8 +58,17 @@ class _Batcher:
     """
 
     def __init__(self, engine, max_batch_rows: int = 65536,
-                 submit_timeout: float | None = 120.0):
+                 submit_timeout: float | None = 120.0, run_fn=None):
         self._engine = engine
+        # The device launch the batcher owns: engine.infer by default,
+        # or any ``rows (n, ...) -> rows (n, ...)`` closure (the LM
+        # generation endpoint passes its decode runner) — coalescing,
+        # bucketing, abandonment, and error fan-out are identical.
+        self._run_fn = (
+            run_fn
+            if run_fn is not None
+            else lambda xs: np.asarray(engine.infer(xs))
+        )
         self._max_rows = int(max_batch_rows)
         self._submit_timeout = submit_timeout
         self._cond = threading.Condition()
@@ -165,7 +175,7 @@ class _Batcher:
                         xs = np.concatenate(
                             [xs, np.zeros((n_pad - n, *xs.shape[1:]), xs.dtype)]
                         )
-                    out = np.asarray(self._engine.infer(xs))
+                    out = np.asarray(self._run_fn(xs))
                     ofs = 0
                     for it in group:
                         k = len(it["x"])
@@ -183,6 +193,77 @@ class _Batcher:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout=10)
+
+
+def _abort_for_exception(context, e, what: str):
+    """Map framework exceptions to the reference's gRPC status taxonomy
+    (grpc_node.py:149-158) — ONE mapping for every method so a new
+    status cannot land in Process and miss Generate."""
+    from tpu_dist_nn.utils.errors import (
+        DeadlineExceededError,
+        InvalidArgumentError,
+        UnavailableError,
+    )
+
+    if isinstance(e, InvalidArgumentError):
+        # The reference's dim-check path (grpc_node.py:149-153).
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    if isinstance(e, DeadlineExceededError):
+        # Batcher wait expired (wedged engine): the reference's
+        # per-RPC timeout semantics (grpc_node.py:133).
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+    if isinstance(e, UnavailableError):
+        # Engine torn down mid-flight: the reference's dead-channel
+        # semantics (clients may retry elsewhere).
+        context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+    log.exception("%s failed", what)
+    context.abort(grpc.StatusCode.INTERNAL, f"{what} failed: {e}")
+
+
+def _new_grpc_server(max_workers: int):
+    """The reference's server shape: thread pool + unlimited messages
+    (grpc_node.py:169, run_grpc_inference.py:124-127)."""
+    return grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+        ],
+    )
+
+
+def _bind_or_close(server, host: str, port: int, batcher) -> int:
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        if batcher is not None:
+            batcher.close()
+        raise OSError(f"could not bind gRPC server to port {port}")
+    return bound
+
+
+def _wrap_server_stop(server, batcher) -> None:
+    """server.stop() must also stop the batcher thread (tests and the
+    CLI call stop(), not a separate teardown hook) — but only AFTER the
+    grace drain: closing immediately would turn in-flight RPCs that
+    haven't reached submit() yet into UNAVAILABLE during the window the
+    caller asked to protect."""
+    if batcher is None:
+        return
+    inner_stop = server.stop
+
+    def stop(grace=None):
+        ev = inner_stop(grace)
+        if grace:
+            def _close_after_drain():
+                ev.wait()
+                batcher.close()
+
+            threading.Thread(target=_close_after_drain, daemon=True).start()
+        else:
+            batcher.close()
+        return ev
+
+    server.stop = stop
 
 
 def _make_handler(engine, batcher: _Batcher | None):
@@ -217,25 +298,7 @@ def _make_handler(engine, batcher: _Batcher | None):
                 with lock:
                     out = engine.infer(x)
         except Exception as e:  # noqa: BLE001 — map to status codes
-            from tpu_dist_nn.utils.errors import (
-                DeadlineExceededError,
-                InvalidArgumentError,
-                UnavailableError,
-            )
-
-            if isinstance(e, InvalidArgumentError):
-                # The reference's dim-check path (grpc_node.py:149-153).
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            if isinstance(e, DeadlineExceededError):
-                # Batcher wait expired (wedged engine): the reference's
-                # per-RPC timeout semantics (grpc_node.py:133).
-                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
-            if isinstance(e, UnavailableError):
-                # Engine torn down mid-flight: the reference's
-                # dead-channel semantics (clients may retry elsewhere).
-                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-            log.exception("inference failed")
-            context.abort(grpc.StatusCode.INTERNAL, f"inference failed: {e}")
+            _abort_for_exception(context, e, "inference")
         return encode_matrix(np.asarray(out, np.float64))
 
     rpc = grpc.unary_unary_rpc_method_handler(
@@ -277,13 +340,7 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
     DEADLINE_EXCEEDED for the affected requests instead of stranding
     every worker thread.
     """
-    server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers),
-        options=[
-            ("grpc.max_send_message_length", -1),
-            ("grpc.max_receive_message_length", -1),
-        ],
-    )
+    server = _new_grpc_server(max_workers)
     batcher = (
         _Batcher(engine, max_batch_rows, submit_timeout) if coalesce else None
     )
@@ -297,39 +354,180 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
                 engine.infer(np.zeros((n, dim)))
                 n *= 2
     server.add_generic_rpc_handlers((_make_handler(engine, batcher),))
-    bound = server.add_insecure_port(f"{host}:{port}")
-    if bound == 0:
-        if batcher is not None:
-            batcher.close()
-        raise OSError(f"could not bind gRPC server to port {port}")
+    bound = _bind_or_close(server, host, port, batcher)
     server.batcher = batcher
-    if batcher is not None:
-        # server.stop() must also stop the batcher thread (tests and
-        # tdn up --serve call stop(), not a separate teardown hook) —
-        # but only AFTER the grace drain: closing immediately would
-        # turn in-flight RPCs that haven't reached submit() yet into
-        # UNAVAILABLE during the window the caller asked to protect.
-        inner_stop = server.stop
-
-        def stop(grace=None):
-            ev = inner_stop(grace)
-            if grace:
-                def _close_after_drain():
-                    ev.wait()
-                    batcher.close()
-
-                threading.Thread(
-                    target=_close_after_drain, daemon=True
-                ).start()
-            else:
-                batcher.close()
-            return ev
-
-        server.stop = stop
+    _wrap_server_stop(server, batcher)
     server.start()
     log.info("gRPC LayerService serving on :%d (wire-compatible with "
              "run_grpc_inference.py)%s", bound,
              " with request coalescing" if coalesce else "")
+    return server, bound
+
+
+def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
+    """The Generate method: Matrix of token ids (N, prompt_len) ->
+    Matrix (N, prompt_len + max_new_tokens). Same wire format, same
+    status taxonomy as Process."""
+
+    def generate(request_bytes: bytes, context) -> bytes:
+        try:
+            x = decode_matrix(request_bytes)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad Matrix: {e}")
+        if x.ndim != 2 or x.shape[1] != prompt_len:
+            # The decode program is compiled for ONE static prompt
+            # length per endpoint (static shapes under jit); clients
+            # pad/pack to it.
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"expected prompts of shape (N, {prompt_len}), got "
+                f"{tuple(x.shape)}",
+            )
+        ids = x.astype(np.int64)
+        if (ids != x).any() or (ids < 0).any() or (ids >= vocab_size).any():
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"prompts must be integer token ids in [0, {vocab_size})",
+            )
+        try:
+            out = run_submit(ids.astype(np.int32), context.time_remaining())
+        except Exception as e:  # noqa: BLE001 — map to status codes
+            _abort_for_exception(context, e, "generation")
+        return encode_matrix(np.asarray(out, np.float64))
+
+    rpc = grpc.unary_unary_rpc_method_handler(
+        generate, request_deserializer=bytes, response_serializer=bytes
+    )
+    return grpc.method_handlers_generic_handler(
+        SERVICE_NAME, {"Generate": rpc}
+    )
+
+
+def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
+                      prompt_len: int, num_stages: int = 1,
+                      num_groups: int | None = None,
+                      temperature: float = 0.0, top_k: int | None = None,
+                      top_p: float | None = None, seed: int = 0,
+                      host: str = "0.0.0.0", max_workers: int = 10,
+                      coalesce: bool = True, warm_rows: int = 0,
+                      submit_timeout: float | None = 120.0):
+    """Serve LM GENERATION over the reference wire (VERDICT r4 item 7:
+    the continuous-batching decoder behind a serving endpoint).
+
+    ``num_stages > 1`` decodes IN the pipeline placement with the
+    OVERLAPPED round-robin decoder
+    (:func:`~tpu_dist_nn.parallel.pp_generate.make_pipeline_generate_overlapped`):
+    ``num_groups`` (default ``max(num_stages, 2)``) request groups ride
+    the stage ring so every stage does useful work every tick — the
+    batcher's coalesced rows are exactly the decoder's group slots
+    (rows pad to a ``(G, Bg)`` grid, ``Bg`` power-of-two bucketed).
+    ``num_stages == 1`` serves the single-chip KV-cached decoder on the
+    same endpoint contract.
+
+    One endpoint = one decode config (prompt_len, max_new_tokens,
+    sampling knobs are compile-time static). Sampling at
+    ``temperature > 0`` folds a per-batch counter into the key so
+    repeated identical prompts draw fresh continuations.
+
+    Returns ``(server, bound_port)``; ``server.batcher`` exposes the
+    coalescing counters when ``coalesce=True``.
+    """
+    import itertools
+
+    import jax
+
+    params = cfg.cast_params(params)
+    N = int(max_new_tokens)
+    T = int(prompt_len)
+    if T + N > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len {T} + max_new_tokens {N} exceeds max_seq_len "
+            f"{cfg.max_seq_len}"
+        )
+    counter = itertools.count()
+    base_key = jax.random.key(seed)
+
+    if num_stages > 1:
+        from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
+        from tpu_dist_nn.parallel.pp_generate import (
+            make_pipeline_generate_overlapped,
+        )
+        from tpu_dist_nn.parallel.transformer_pipeline import shard_blocks
+
+        S = int(num_stages)
+        G = int(num_groups) if num_groups is not None else max(S, 2)
+        mesh = build_mesh(MeshSpec(stage=S))
+        params_served = dict(
+            params, blocks=shard_blocks(params["blocks"], S)
+        )
+        fn = make_pipeline_generate_overlapped(
+            mesh, cfg, S, N, G, temperature=temperature, top_k=top_k,
+            top_p=top_p,
+        )
+
+        def run(rows: np.ndarray) -> np.ndarray:
+            n = len(rows)
+            bg = -(-n // G)  # ceil: the batcher's bucket already padded
+            grid = n if n == bg * G else bg * G
+            if grid != n:
+                rows = np.concatenate(
+                    [rows, np.zeros((grid - n, T), rows.dtype)]
+                )
+            prompts = rows.reshape(G, -1, T)
+            key = (
+                jax.random.fold_in(base_key, next(counter))
+                if temperature > 0 else None
+            )
+            out = np.asarray(fn(params_served, prompts, key=key))
+            return out.reshape(-1, T + N)[:n]
+    else:
+        from tpu_dist_nn.models.generate import generate
+
+        params_served = params
+
+        def run(rows: np.ndarray) -> np.ndarray:
+            key = (
+                jax.random.fold_in(base_key, next(counter))
+                if temperature > 0 else None
+            )
+            out = generate(
+                params_served, cfg, rows, N, temperature=temperature,
+                top_k=top_k, top_p=top_p, key=key,
+            )
+            return np.concatenate([rows, np.asarray(out)], axis=1)
+
+    server = _new_grpc_server(max_workers)
+    batcher = (
+        _Batcher(None, 65536, submit_timeout, run_fn=run)
+        if coalesce else None
+    )
+    lock = threading.Lock()
+
+    def run_submit(ids: np.ndarray, time_remaining):
+        if batcher is not None:
+            return batcher.submit(ids, timeout=time_remaining)
+        with lock:
+            return run(ids)
+
+    if warm_rows > 0:
+        n = 1
+        while n <= warm_rows:
+            run(np.zeros((n, T), np.int32))
+            n *= 2
+    server.add_generic_rpc_handlers(
+        (_make_generate_handler(run_submit, T, cfg.vocab_size),)
+    )
+    bound = _bind_or_close(server, host, port, batcher)
+    server.batcher = batcher
+    _wrap_server_stop(server, batcher)
+    server.start()
+    log.info(
+        "gRPC LayerService.Generate serving on :%d (%s, prompt_len=%d, "
+        "max_new_tokens=%d)%s", bound,
+        f"pipelined x{num_stages} overlapped decode" if num_stages > 1
+        else "single-chip decode", T, N,
+        " with request coalescing" if coalesce else "",
+    )
     return server, bound
 
 
@@ -354,11 +552,26 @@ class GrpcClient:
             request_serializer=bytes,
             response_deserializer=bytes,
         )
+        self._call_generate = self._channel.unary_unary(
+            GENERATE_METHOD,
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
 
     def process(self, x: np.ndarray) -> np.ndarray:
         reply = self._call(encode_matrix(np.asarray(x, np.float64)),
                            timeout=self.timeout)
         return decode_matrix(reply)
+
+    def generate(self, prompts: np.ndarray) -> np.ndarray:
+        """Token-id prompts ``(N, prompt_len)`` -> full sequences
+        ``(N, prompt_len + max_new_tokens)`` (ids ride the Matrix wire
+        as doubles — exact)."""
+        reply = self._call_generate(
+            encode_matrix(np.asarray(prompts, np.float64)),
+            timeout=self.timeout,
+        )
+        return decode_matrix(reply).astype(np.int64)
 
     def close(self) -> None:
         self._channel.close()

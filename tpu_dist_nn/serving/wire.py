@@ -153,4 +153,8 @@ def decode_matrix(data: bytes) -> np.ndarray:
 #: package is ``grpc_dist_nn`` (``src/proto/dist_nn.proto:3``), so
 #: LayerServiceStub targets exactly this path.
 PROCESS_METHOD = "/grpc_dist_nn.LayerService/Process"
+# Generation rides the SAME Matrix wire format (token ids as doubles —
+# exact for ids < 2^53): prompts (N, T) in, (N, T + max_new_tokens)
+# out. A second method on the reference's service, not a new protocol.
+GENERATE_METHOD = "/grpc_dist_nn.LayerService/Generate"
 SERVICE_NAME = "grpc_dist_nn.LayerService"
