@@ -165,8 +165,8 @@ def capture_all(tag: str, watch_log: str) -> bool:
     scale_runner = os.path.join(REPO, "tools", "tpu_scale_r05.py")
     if os.path.isfile(scale_runner):
         rc, out, err = _run(
-            [sys.executable, scale_runner, "--budget", "1800"],
-            timeout=2100,
+            [sys.executable, scale_runner, "--budget", "2700"],
+            timeout=3000,
         )
         _append(watch_log, f"{_now()} scale suite rc={rc} "
                            f"{(out.splitlines() or [''])[-1][:200]}")

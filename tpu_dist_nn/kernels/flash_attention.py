@@ -26,6 +26,7 @@ signature matches :func:`~tpu_dist_nn.models.transformer.dot_product_attention`
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -316,8 +317,13 @@ _flash_call.defvjp(_flash_call_fwd, _flash_call_bwd)
 # attention wins below this — flash 0.81x/0.89x at T=1024/2048 — and
 # collapses above it (T^2 f32 logits go HBM-bound): flash is 2.32x fwd
 # / 1.74x grad at T=4096. Shapes are static under jit, so the dispatch
-# resolves at trace time.
-FLASH_MIN_SEQ = 3072
+# resolves at trace time. ``TDN_FLASH_MIN_SEQ`` overrides for on-chip
+# re-verification at other shapes (the r4 85M MFU note named the
+# seq-1024 attention path a suspect; the scale suite A/Bs it).
+try:
+    FLASH_MIN_SEQ = int(os.environ.get("TDN_FLASH_MIN_SEQ", "") or 3072)
+except ValueError:
+    FLASH_MIN_SEQ = 3072  # malformed override must not break import
 
 
 def select_attention(q, k, v, *, causal: bool):
