@@ -302,12 +302,14 @@ def test_cli_lm_seq_parallel(capsys):
 
 
 def test_cli_lm_seq_parallel_rejections(capsys):
-    # MoE x SP is now supported FLAT (test_expert_parallel.py); the
-    # remaining rejection is the three-axis MoE x SP x PP shape.
+    # MoE x SP is supported flat AND three-axis on gpipe since round 5
+    # (test_expert_parallel.py); the remaining rejection is the
+    # SCHEDULED three-axis product, named explicitly.
     assert cli_main([
         "lm", "--experts", "2", "--seq-parallel", "2", "--stages", "2",
+        "--schedule", "1f1b",
     ]) == 2
-    assert "--stages" in capsys.readouterr().err
+    assert "gpipe" in capsys.readouterr().err
     assert cli_main([
         "lm", "--seq-parallel", "2", "--seq-len", "16", "--steps", "1",
     ]) == 2
