@@ -147,32 +147,37 @@ def main() -> int:
         return args.budget - (time.monotonic() - t_start)
 
     # ---- Leg 1: 85M MFU, steps-per-call A/B -------------------------
+    # Deliverable arms run FIRST (spc 1 vs 10 — the dispatch suspect);
+    # the two PROBE arms run LAST, after every deliverable leg, so a
+    # tight window can never starve a deliverable for a probe:
+    # spc10_flash forces the flash kernel at seq 1024 (the attention
+    # suspect — the r4 sweep said XLA wins below T=3072 at small
+    # shapes, re-verified at the 85M config itself) and
+    # spc10_noremat_b8 drops remat at batch 8 (remat's recomputed
+    # forward inflates step time by ~1/3 without appearing in model
+    # flops, so model-flops MFU understates the chip where HBM permits
+    # no-remat). Per-arm batch/remat make the flops/tokens accounting
+    # per-arm; the run-level fields describe the baseline arms only.
     n85 = 86_039_040
-    flops85 = model_flops_per_step(n85, 16, 1024, 768, 12)
     record["run_85m"] = {
-        "config": "d768/h12/L12 byte vocab, seq 1024, batch 16, "
-                  "bf16 + remat, donated buffers",
-        "model_flops_per_step": flops85,
+        "baseline_config": "d768/h12/L12 byte vocab, seq 1024, "
+                           "batch 16, bf16 + remat, donated buffers "
+                           "(per-arm batch/remat/flops recorded on "
+                           "each arm)",
         "arms": {},
     }
-    # Arms: steps-per-call 1 vs 10 (the dispatch suspect), plus a
-    # flash-forced attention arm at spc 10 (the seq-1024 attention
-    # suspect: the r4 sweep said XLA wins below T=3072 at small
-    # shapes — re-verify at the 85M config itself).
-    arms = [
-        ("spc1", 1, None),
-        ("spc10", 10, None),
-        ("spc10_flash", 10, {"TDN_FLASH_MIN_SEQ": "1024"}),
-    ]
-    for arm_name, k, extra_env in arms:
+
+    # arm: (name, steps_per_call, batch, remat, extra_env)
+    def run_arm(arm_name, k, batch, remat, extra_env):
         if left() < 300:
             record["run_85m"]["arms"][arm_name] = {"skipped": "budget"}
-            continue
+            return
         metrics = os.path.join(ART, f"metrics_85m_{arm_name}.jsonl")
         rc, out, err = _run_cli(
             ["--d-model", "768", "--heads", "12", "--layers", "12",
              "--seq-len", "1024", "--steps", str(args.steps_85m),
-             "--batch-size", "16", "--bf16", "--remat",
+             "--batch-size", str(batch), "--bf16",
+             *(["--remat"] if remat else []),
              "--lr", "3e-4", "--lr-schedule", "cosine",
              "--warmup-steps", "20", "--steps-per-call", str(k),
              "--log-every", "10", "--metrics-out", metrics],
@@ -181,21 +186,28 @@ def main() -> int:
         hist = _read_history(metrics)
         ss = steady_state(hist)
         arm = {
-            "rc": rc, "cmd_steps_per_call": k,
+            "rc": rc, "cmd_steps_per_call": k, "batch": batch,
+            "remat": remat,
+            "model_flops_per_step": model_flops_per_step(
+                n85, batch, 1024, 768, 12
+            ),
             "steady_state": ss,
             "final_report": _final_report(metrics),
         }
         if extra_env:
             arm["env"] = extra_env
         if ss:
-            tf = flops85 / ss["s_per_step"] / 1e12
+            tf = arm["model_flops_per_step"] / ss["s_per_step"] / 1e12
             arm["model_tflops_steady"] = round(tf, 2)
             arm["mfu"] = round(tf / PEAK_TFLOPS_V5E, 4)
-            arm["tokens_per_sec"] = round(16 * 1024 / ss["s_per_step"])
+            arm["tokens_per_sec"] = round(batch * 1024 / ss["s_per_step"])
         if rc != 0:
             arm["stderr_tail"] = err[-500:]
         record["run_85m"]["arms"][arm_name] = arm
         _flush(record)
+
+    for spec in (("spc1", 1, 16, True, None), ("spc10", 10, 16, True, None)):
+        run_arm(*spec)
 
     # ---- Leg 2: short profiler trace of the 85M step ----------------
     if left() > 240:
@@ -269,6 +281,13 @@ def main() -> int:
             leg["stderr_tail"] = err[-500:]
         record["run_seq8k"] = leg
         _flush(record)
+
+    # ---- Probe arms LAST (never at a deliverable's expense) ---------
+    for spec in (
+        ("spc10_flash", 10, 16, True, {"TDN_FLASH_MIN_SEQ": "1024"}),
+        ("spc10_noremat_b8", 10, 8, False, None),
+    ):
+        run_arm(*spec)
 
     # Green only if every DELIVERABLE leg that ran succeeded, the
     # headline arm produced an MFU, and no deliverable was
