@@ -144,11 +144,27 @@ def branch_costs(cfg, n_blocks: int, reps: int) -> dict:
         (db,) = vjp(cot)
         return db
 
+    # The round-5 cotangent-stash split (parallel/split_backward.py):
+    # B = one forward + backbone + dx GEMMs, stashing (act, cot) pairs;
+    # W = pure dW GEMMs, no recompute — the executor-side fix the
+    # recompute finding motivates, measured here at the same widths.
+    from tpu_dist_nn.parallel.split_backward import (
+        chunk_backward_split,
+        chunk_weight_grads,
+    )
+
+    stash_b = jax.jit(
+        lambda b, xx, cot: chunk_backward_split(b, xx, cot, cfg)
+    )
+    _, _, wstash = stash_b(blocks, x, dy)
+
     return {
         "F": _time(jax.jit(fwd), blocks, x, reps=reps),
         "B": _time(jax.jit(bwd_full), blocks, x, dy, reps=reps),
         "B_split_dx": _time(jax.jit(bwd_b), blocks, x, dy, reps=reps),
         "B_split_dw": _time(jax.jit(bwd_w), blocks, x, dy, reps=reps),
+        "B_stash": _time(stash_b, blocks, x, dy, reps=reps),
+        "W_gemm": _time(jax.jit(chunk_weight_grads), wstash, reps=reps),
     }
 
 
@@ -213,16 +229,27 @@ def main() -> int:
     mesh = build_mesh(MeshSpec(stage=S))
     opt = optax.sgd(1e-3)
 
+    # (name, schedule, v, table builder, branch-cost overrides): the
+    # zb-stash arm prices BWD_B/BWD_W with the cotangent-stash costs —
+    # and is also MEASURED, since make_pipeline_lm_train_step runs the
+    # real stash executor for schedule="zb-stash".
     arms = [
-        ("1f1b", "1f1b", 1, lambda M: st.build_interleaved_1f1b(S, 1, M)),
+        ("1f1b", "1f1b", 1,
+         lambda M: st.build_interleaved_1f1b(S, 1, M), None),
         ("interleaved", "interleaved", 2,
-         lambda M: st.build_interleaved_1f1b(S, 2, M)),
-        ("zb", "zb", 1, lambda M: st.build_zero_bubble(S, 1, M)),
-        ("zb-v", "zb-v", 2, lambda M: st.build_zb_v(S, M)),
+         lambda M: st.build_interleaved_1f1b(S, 2, M), None),
+        ("zb", "zb", 1, lambda M: st.build_zero_bubble(S, 1, M), None),
+        ("zb-v", "zb-v", 2, lambda M: st.build_zb_v(S, M), None),
+        ("zb-stash", "zb-stash", 1,
+         lambda M: st.build_zero_bubble(S, 1, M),
+         {"B_split_dx": "B_stash", "B_split_dw": "W_gemm"}),
     ]
-    for name, sched, v, build in arms:
+    for name, sched, v, build, cost_overrides in arms:
         chunk_w = L // (S * v)
-        c = record["branch_costs_s"][f"{chunk_w}_blocks"]
+        c = dict(record["branch_costs_s"][f"{chunk_w}_blocks"])
+        if cost_overrides:
+            for dst, src in cost_overrides.items():
+                c[dst] = c[src]
         per_m = {}
         for M in ms:
             tb = build(M)
@@ -240,7 +267,7 @@ def main() -> int:
             if sched == "zb-v":
                 p = dict(params,
                          blocks=shard_blocks_vshape(params["blocks"], S))
-            elif sched in ("interleaved", "zb"):
+            elif sched in ("interleaved", "zb", "zb-stash"):
                 p = dict(params, blocks=shard_blocks_interleaved(
                     params["blocks"], S, v))
             else:
@@ -286,12 +313,27 @@ def main() -> int:
     canon = {"F": 1.0, "B": 2.0, "B_split_dx": 1.0, "B_split_dw": 1.0}
 
     def canon_makespan(name):
-        _, sched, v, build = next(a for a in arms if a[0] == name)
+        _, sched, v, build, _ov = next(a for a in arms if a[0] == name)
         tb = build(ms[-1])
         return price_tables(tb, canon)["parallel_makespan_s"]
 
     record["matched_pairs"] = {}
-    for a, b in (("1f1b", "zb"), ("interleaved", "zb-v")):
+    for a, b in (("1f1b", "zb"), ("interleaved", "zb-v"),
+                 ("1f1b", "zb-stash")):
+        chunk_w = record["schedules"][a]["blocks_per_chunk"]
+        c = record["branch_costs_s"][f"{chunk_w}_blocks"]
+        # Price the split schedule's tables with the COTANGENT-STASH
+        # branch costs (split_backward.py: B_stash carries the one
+        # forward + backbone + dx, W_gemm is pure dW GEMMs) — the
+        # executor-side fix this experiment motivates, priced before
+        # it is wired into the executor.
+        _, _, _, build, _ov2 = next(x for x in arms if x[0] == b)
+        tb = build(ms[-1])
+        stash_costs = dict(c)
+        stash_costs["B_split_dx"] = c["B_stash"]
+        stash_costs["B_split_dw"] = c["W_gemm"]
+        stash_pricing = price_tables(tb, stash_costs)
+        base = record["schedules"][a][Mk]
         record["matched_pairs"][f"{b}_vs_{a}"] = {
             "canonical_tick_model": round(
                 canon_makespan(b) / canon_makespan(a), 4
@@ -299,11 +341,13 @@ def main() -> int:
             "measured_cost_parallel_makespan": round(
                 record["schedules"][b][Mk]
                 ["parallel_makespan_with_overhead_s"]
-                / record["schedules"][a][Mk]
-                ["parallel_makespan_with_overhead_s"], 4
+                / base["parallel_makespan_with_overhead_s"], 4
             ),
-            "granularity_blocks_per_chunk":
-                record["schedules"][a]["blocks_per_chunk"],
+            "stash_split_parallel_makespan": round(
+                stash_pricing["parallel_makespan_s"]
+                / base["parallel_makespan_s"], 4
+            ),
+            "granularity_blocks_per_chunk": chunk_w,
         }
     _write(record, args.out)
     print(json.dumps(record["matched_pairs"], indent=2))

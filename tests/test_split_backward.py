@@ -1,0 +1,104 @@
+"""Cotangent-stash split backward: parity vs AD and the W-tick
+contract (pure GEMMs). See parallel/split_backward.py and docs/PERF.md
+"Do ticks translate to time?"."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    block_apply,
+    init_transformer,
+)
+from tpu_dist_nn.parallel.split_backward import (
+    block_backward_split,
+    block_weight_grads,
+    chunk_backward_split,
+    chunk_weight_grads,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+    max_seq_len=32,
+)
+
+
+def _setup(seed=3):
+    params = init_transformer(jax.random.key(seed), CFG)
+    rng = np.random.default_rng(seed + 1)
+    x = jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32)
+    dy = jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32)
+    return params["blocks"], x, dy
+
+
+def test_block_split_backward_matches_ad():
+    # dx + small grads from B, big-weight grads from the deferred W
+    # GEMMs: together they must equal jax.vjp of block_apply exactly
+    # (the sub-op math stays INSIDE jax.vjp — only the weight
+    # applications are hand-split).
+    blocks, x, dy = _setup()
+    block0 = jax.tree.map(lambda a: a[0], blocks)
+
+    _, ref_vjp = jax.vjp(lambda b, xx: block_apply(b, xx, CFG), block0, x)
+    ref_db, ref_dx = ref_vjp(dy)
+    dx, d_small, wstash = jax.jit(
+        lambda b, xx, cot: block_backward_split(b, xx, cot, CFG)
+    )(block0, x, dy)
+    d_big = jax.jit(block_weight_grads)(wstash)
+
+    np.testing.assert_allclose(
+        np.asarray(dx), np.asarray(ref_dx), rtol=5e-4, atol=1e-5
+    )
+    for k, v in {**d_small, **d_big}.items():
+        np.testing.assert_allclose(
+            np.asarray(v), np.asarray(ref_db[k]), rtol=5e-4, atol=1e-5,
+            err_msg=k,
+        )
+    # Every block param is covered by exactly one half.
+    assert set(d_small) | set(d_big) == set(block0)
+
+
+def test_chunk_split_backward_matches_ad():
+    blocks, x, dy = _setup(seed=9)
+
+    def chunk_fwd(bs, xx):
+        def body(c, blk):
+            return block_apply(blk, c, CFG), None
+
+        y, _ = jax.lax.scan(body, xx, bs)
+        return y
+
+    _, ref_vjp = jax.vjp(chunk_fwd, blocks, x)
+    ref_db, ref_dx = ref_vjp(dy)
+    dx, d_smalls, wstashes = jax.jit(
+        lambda bs, xx, cot: chunk_backward_split(bs, xx, cot, CFG)
+    )(blocks, x, dy)
+    d_bigs = jax.jit(chunk_weight_grads)(wstashes)
+
+    np.testing.assert_allclose(
+        np.asarray(dx), np.asarray(ref_dx), rtol=5e-4, atol=1e-5
+    )
+    for k, v in {**d_smalls, **d_bigs}.items():
+        np.testing.assert_allclose(
+            np.asarray(v), np.asarray(ref_db[k]), rtol=5e-4, atol=1e-5,
+            err_msg=k,
+        )
+
+
+def test_w_tick_is_pure_gemms():
+    # The W-tick contract the canonical ZB accounting assumes: the
+    # jaxpr of block_weight_grads contains contractions and reshapes
+    # only — no exp/erf/rsqrt (no softmax, gelu, layernorm — i.e. no
+    # forward recompute and no backward backbone).
+    blocks, x, dy = _setup(seed=5)
+    block0 = jax.tree.map(lambda a: a[0], blocks)
+    _, _, wstash = block_backward_split(block0, x, dy, CFG)
+    jaxpr = jax.make_jaxpr(block_weight_grads)(wstash)
+    prims = {eqn.primitive.name for eqn in jaxpr.jaxpr.eqns}
+    forbidden = {"exp", "erf", "rsqrt", "logistic", "tanh", "div",
+                 "reduce_max", "custom_vjp_call"}
+    assert not (prims & forbidden), (
+        f"W tick is not pure GEMMs: {sorted(prims & forbidden)}"
+    )
+    assert "dot_general" in prims

@@ -1746,7 +1746,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stages", type=int, default=1,
                    help="pipeline stages (per-block GPipe) when > 1")
     p.add_argument("--schedule",
-                   choices=["gpipe", "1f1b", "interleaved", "zb", "zb-v"],
+                   choices=["gpipe", "1f1b", "interleaved", "zb", "zb-v",
+                            "zb-stash"],
                    default="gpipe",
                    help="pipeline training schedule when --stages > 1 "
                         "(interleaved = Megatron virtual stages, see "
@@ -1754,7 +1755,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "backward, half the 1F1B bubble; zb-v = zero "
                         "bubble on the V-shape placement — bubble S-1 "
                         "chunk-ticks independent of M (zb needs larger "
-                        "M to match), embedding+loss co-located)")
+                        "M to match), embedding+loss co-located; "
+                        "zb-stash = ZB-H1 with the cotangent-stash "
+                        "split: W ticks are pure dW GEMMs, no "
+                        "recompute — the measured-cost zero bubble, "
+                        "dense LM only, ~16x bridge memory)")
     p.add_argument("--virtual-stages", type=int, default=None,
                    help="model chunks per device for --schedule "
                         "interleaved/zb (bubble shrinks ~v-fold under "

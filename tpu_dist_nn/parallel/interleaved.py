@@ -53,6 +53,7 @@ def make_interleaved_1f1b(
     want_dx0: bool = True,
     tables: ScheduleTables | None = None,
     with_aux: bool = False,
+    split_fns=None,
 ):
     """Interleaved counterpart of
     :func:`tpu_dist_nn.parallel.one_f_one_b.make_1f1b`.
@@ -76,7 +77,26 @@ def make_interleaved_1f1b(
     Returns ``f(xs, chunk_params, chunk_static, tail_params, aux) ->
     (loss, chunk_grads, tail_grads, dx0)`` with ``chunk_grads`` in the
     ``(S, v, ...)`` layout of the params.
+
+    ``split_fns=(fwd_collect, bwd_from_inputs, weight_grads)`` swaps
+    the split-backward branches for the COTANGENT-STASH split
+    (parallel/split_backward.py): ``BWD_B`` runs ``fwd_collect(pc, x)
+    -> (y, inner)`` once, then ``bwd_from_inputs(pc, inner, dy) ->
+    (dx, d_partial, wstash)`` — the backbone + dx GEMMs, stashing the
+    per-op (activation, cotangent) pairs — and ``BWD_W`` runs
+    ``weight_grads(wstash) -> d_partial``: PURE dW GEMMs, no forward
+    recompute (the round-5 wall-clock measurement's fix: the recompute
+    split priced zb at 1.39-1.92x of its combined-backward rivals; the
+    stash split restores the canonical tick ratios at ~16x the
+    split-bridge stash memory). ``d_partial`` pytrees must together
+    cover the chunk grads (zeros in the other half). Requires
+    ``with_aux=False`` (aux channels ride the recompute split).
     """
+    if split_fns is not None and with_aux:
+        raise ValueError(
+            "split_fns (cotangent-stash split) does not compose with "
+            "with_aux: aux channels ride the recompute split"
+        )
     S = mesh.shape[AXIS_STAGE]
     v, M = num_virtual, num_microbatches
     if tables is None:
@@ -177,6 +197,28 @@ def make_interleaved_1f1b(
         }
 
         zeros_wire = vcast(jnp.zeros(mb_shape, dt))
+        if split_fns is None or not has_split:
+            # Cotangent stash bridging split BWD_B -> BWD_W (1 dummy
+            # slot for combined schedules).
+            dybuf0 = vcast(jnp.zeros((D, *mb_shape), dt))
+        else:
+            # Stash-split mode: the bridge carries the per-op
+            # (activation, cotangent) PYTREE instead of the bare dy —
+            # shapes inferred once from the split fns at this chunk/
+            # microbatch shape (every chunk is shape-identical).
+            # Shapes only — strip vma so eval_shape traces clean.
+            pc0_sd = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), sp
+            )
+            x_sd = jax.ShapeDtypeStruct(mb_shape, dt)
+            _, inner_sd = jax.eval_shape(split_fns[0], pc0_sd, x_sd)
+            _, _, wst_sd = jax.eval_shape(
+                split_fns[1], pc0_sd, inner_sd, x_sd
+            )
+            dybuf0 = jax.tree.map(
+                lambda sd: vcast(jnp.zeros((D, *sd.shape), sd.dtype)),
+                wst_sd,
+            )
         carry0 = (
             zeros_wire,                                  # fwd ring payload
             zeros_wire,                                  # bwd ring payload
@@ -184,9 +226,7 @@ def make_interleaved_1f1b(
             vcast(jnp.zeros((A, *mb_shape), dt)),        # activation recv buf
             vcast(jnp.zeros((G, *mb_shape), dt)),        # cotangent recv buf
             vcast(jnp.zeros((K, *mb_shape), dt)),        # input stash
-            # Cotangent stash bridging split BWD_B -> BWD_W (1 dummy
-            # slot for combined schedules).
-            vcast(jnp.zeros((D, *mb_shape), dt)),
+            dybuf0,                                      # split bridge
             jax.tree.map(zeros_like_vma, sp),
             jax.tree.map(zeros_like_vma, tp),
             vcast(jnp.zeros((M if want_dx0 else 1, *mb_shape), dt)),
@@ -376,7 +416,63 @@ def make_interleaved_1f1b(
                     loss_acc,
                 )
 
-            branches = [idle, fwd, bwd] + ([bwd_b, bwd_w] if has_split else [])
+            def bwd_b_stash(_):
+                # Cotangent-stash split B: one forward (collecting the
+                # per-block inputs), backbone + dx GEMMs, and the
+                # per-op (act, cot) pairs parked in the bridge — the
+                # partial (bias/LN) grads accumulate HERE, the dW GEMMs
+                # moved wholesale to BWD_W.
+                x_in = lax.dynamic_index_in_dim(stash, k_slot, 0, keepdims=False)
+                y, inner = split_fns[0](pc, x_in)
+                dy, loss_f, d_tp = resolve_dy(y)
+                dx, d_part, wst = split_fns[1](pc, inner, dy)
+                dslot = jnp.clip(row["dy_stash"][t], 0, D - 1)
+                new_dybuf = jax.tree.map(
+                    lambda buf, w: lax.dynamic_update_index_in_dim(
+                        buf, w, dslot, 0
+                    ),
+                    dybuf, wst,
+                )
+                return (
+                    zeros_wire,
+                    dx,
+                    stash,
+                    new_dybuf,
+                    accumulate_g_sp(d_part),
+                    jax.tree.map(jnp.add, g_tp, d_tp),
+                    record_dx0(dx),
+                    loss_acc + loss_f,
+                )
+
+            def bwd_w_stash(_):
+                # The canonical ZB W tick: pure dW GEMMs from the
+                # bridged (act, cot) pairs — no forward recompute, no
+                # backward backbone (asserted by
+                # tests/test_split_backward.py's jaxpr contract).
+                dslot = jnp.clip(row["dy_stash"][t], 0, D - 1)
+                wst = jax.tree.map(
+                    lambda buf: lax.dynamic_index_in_dim(
+                        buf, dslot, 0, keepdims=False
+                    ),
+                    dybuf,
+                )
+                d_big = split_fns[2](wst)
+                return (
+                    zeros_wire,
+                    zeros_wire,
+                    stash,
+                    dybuf,
+                    accumulate_g_sp(d_big),
+                    g_tp,
+                    dx0,
+                    loss_acc,
+                )
+
+            split_branches = (
+                [bwd_b_stash, bwd_w_stash]
+                if split_fns is not None else [bwd_b, bwd_w]
+            )
+            branches = [idle, fwd, bwd] + (split_branches if has_split else [])
             (send_y, send_dx, stash, dybuf, g_sp, g_tp, dx0,
              loss_acc) = lax.switch(row["op"][t], branches, 0)
             # Sender-side routing: 0 = natural ring (fwd op -> fwd

@@ -43,7 +43,7 @@ from tpu_dist_nn.parallel.pipeline import PipelineMeta, PipelineWeights, _stage_
 #: The pipeline training schedules the framework implements.
 #: "interleaved" = virtual-stage (Megatron) 1F1B — see
 #: parallel/interleaved.py; LM family only for now.
-SCHEDULES = ("gpipe", "1f1b", "interleaved", "zb", "zb-v")
+SCHEDULES = ("gpipe", "1f1b", "interleaved", "zb", "zb-v", "zb-stash")
 
 
 def validate_schedule(schedule: str) -> str:

@@ -287,6 +287,93 @@ def make_pipeline_lm_interleaved_grad(mesh, cfg: TransformerConfig,
     return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
 
 
+def make_pipeline_lm_zb_stash_grad(mesh, cfg: TransformerConfig,
+                                   num_virtual: int, num_microbatches: int,
+                                   attn_fn=dot_product_attention,
+                                   tables=None):
+    """-> ``f(params, tokens) -> (loss, grads)`` via the ZB-H1 tables
+    with the COTANGENT-STASH split backward — the TRUE zero-bubble
+    executor the round-5 wall-clock measurement motivates
+    (docs/PERF.md "Do ticks translate to time?"): BWD_B runs one
+    forward + backbone + dx GEMMs and parks the per-op (activation,
+    cotangent) pairs; BWD_W is PURE dW GEMMs, no recompute
+    (:mod:`tpu_dist_nn.parallel.split_backward`). Same semantics as
+    ``jax.value_and_grad(make_pipeline_lm_loss)`` (parity-tested);
+    same :func:`shard_blocks_interleaved` layout as zb. Memory: the
+    split bridge carries ~(2F + 8D)/D ≈ 16x a block input per stashed
+    chunk — the canonical ZB accounting's price, now explicit.
+    Dense LM only (the chunk structure is known to the split); the
+    matrix compositions keep the recompute split (``zb``).
+    """
+    from tpu_dist_nn.models.transformer import block_apply
+    from tpu_dist_nn.parallel.interleaved import make_interleaved_1f1b
+    from tpu_dist_nn.parallel.mesh import AXIS_STAGE as _AS
+    from tpu_dist_nn.parallel.schedule_table import build_zero_bubble
+    from tpu_dist_nn.parallel.split_backward import (
+        block_backward_split,
+        block_weight_grads,
+    )
+
+    stage_fn, tail_fn = _lm_sched_stage_and_tail(
+        mesh, cfg, num_microbatches, attn_fn
+    )
+
+    def fwd_collect(chunk_blocks, x):
+        def body(carry, blk):
+            return block_apply(blk, carry, cfg, attn_fn), carry
+
+        y, xs = lax.scan(body, x, chunk_blocks)
+        return y, xs
+
+    def bwd_from_inputs(chunk_blocks, xs, dy):
+        def body(cot, inputs):
+            blk, x_in = inputs
+            dx, d_small, wst = block_backward_split(
+                blk, x_in, cot, cfg, attn_fn
+            )
+            return dx, (d_small, wst)
+
+        dx, (d_smalls, wsts) = lax.scan(
+            body, dy, (chunk_blocks, xs), reverse=True
+        )
+        # Full chunk-grad pytree: the dW half is zeros here (BWD_W's
+        # GEMMs own it), so B + W accumulate to the complete gradient.
+        d_part = {
+            k: d_smalls[k] if k in d_smalls else jnp.zeros_like(v)
+            for k, v in chunk_blocks.items()
+        }
+        return dx, d_part, wsts
+
+    def weight_grads(wsts):
+        d_big = jax.vmap(block_weight_grads)(wsts)
+        Lc, _, _, Dd = wsts["h1"].shape
+        Ff = wsts["u"].shape[-1]
+        dt = wsts["h1"].dtype
+
+        def z(*shape):
+            return jnp.zeros(shape, dt)
+
+        return dict(
+            d_big,
+            b_qkv=z(Lc, 3 * Dd), b_o=z(Lc, Dd), b_up=z(Lc, Ff),
+            b_down=z(Lc, Dd), ln1_g=z(Lc, Dd), ln1_b=z(Lc, Dd),
+            ln2_g=z(Lc, Dd), ln2_b=z(Lc, Dd),
+        )
+
+    if tables is None:
+        tables = build_zero_bubble(
+            mesh.shape[_AS], num_virtual, num_microbatches
+        )
+    mapped = make_interleaved_1f1b(
+        mesh, stage_fn, tail_fn, num_virtual, num_microbatches,
+        microbatch_spec=P(AXIS_DATA, None, None),
+        aux_spec=P(None, AXIS_DATA, None),
+        tables=tables,
+        split_fns=(fwd_collect, bwd_from_inputs, weight_grads),
+    )
+    return _lm_vag_from_mapped(mapped, cfg, num_microbatches)
+
+
 def _vshape_regroup(a, num_stages: int):
     """``(L, ...) -> (S, 2, L/(2S), ...)``: THE V-shape placement —
     device ``s`` holds chunk ``s`` (slot 0, descending leg) and chunk

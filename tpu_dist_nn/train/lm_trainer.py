@@ -178,6 +178,23 @@ def make_pipeline_lm_train_step(mesh, cfg: TransformerConfig, num_stages: int,
         )
         vag = make(mesh, cfg, num_microbatches, attn)
         return _jit(make_step_body(None, optimizer, value_and_grad=vag))
+    if schedule == "zb-stash":
+        # TRUE zero-bubble: the ZB-H1 tables with the cotangent-stash
+        # split backward — W ticks are pure dW GEMMs
+        # (parallel/split_backward.py; dense LM only). Same
+        # shard_blocks_interleaved layout as zb.
+        from tpu_dist_nn.parallel import transformer_pipeline as tpl
+
+        if tensor_parallel > 1:
+            raise ValueError(
+                "zb-stash is dense-LM only (the stash split knows the "
+                "dense block structure); use schedule='zb' with "
+                "tensor_parallel"
+            )
+        vag = tpl.make_pipeline_lm_zb_stash_grad(
+            mesh, cfg, num_virtual, num_microbatches, attn
+        )
+        return _jit(make_step_body(None, optimizer, value_and_grad=vag))
     if schedule in ("interleaved", "zb"):
         # Both ride the table executor on the shard_blocks_interleaved
         # (or _tp) layout; "zb" swaps in the split-backward zero-bubble
@@ -276,7 +293,7 @@ def lm_block_layout(sched: str, stages: int, num_virtual: int, *,
             lambda b: m.shard_blocks_vshape(b, stages),
             m.unshard_blocks_vshape,
         )
-    if sched in ("interleaved", "zb"):
+    if sched in ("interleaved", "zb", "zb-stash"):
         return (
             lambda b: m.shard_blocks_interleaved(b, stages, num_virtual),
             m.unshard_blocks_interleaved,
@@ -311,6 +328,11 @@ def make_pipeline_moe_lm_train_step(mesh, cfg, num_stages: int,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if schedule == "zb-stash":
+        raise ValueError(
+            "zb-stash is dense-LM only (the stash split knows the "
+            "dense block structure); use schedule='zb' with --experts"
+        )
     if sp_mode is not None:
         # THREE-AXIS MoE (pp x sp x ep): gpipe only — tokens follow the
         # sp convention (full rows, masked CE), so the scheduled
@@ -403,6 +425,12 @@ def make_pipeline_sp_lm_train_step(mesh, cfg: TransformerConfig,
     from tpu_dist_nn.parallel.one_f_one_b import validate_schedule
 
     validate_schedule(schedule)
+    if schedule == "zb-stash":
+        raise ValueError(
+            "zb-stash is dense-LM only (the stash split knows the "
+            "dense block structure); use schedule='zb' with "
+            "seq-parallel"
+        )
     if tensor_parallel > 1 and mesh.shape.get(AXIS_MODEL, 1) != tensor_parallel:
         raise ValueError(
             f"tensor_parallel={tensor_parallel} but the mesh '{AXIS_MODEL}' "
@@ -623,7 +651,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
             mesh, cfg, num_stages, num_microbatches, optimizer,
             schedule=schedule, donate=True,
         )
-    elif pipelined and schedule in ("interleaved", "zb"):
+    elif pipelined and schedule in ("interleaved", "zb", "zb-stash"):
         from tpu_dist_nn.parallel.transformer_pipeline import (
             shard_blocks_interleaved,
         )
@@ -742,7 +770,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
             params = dict(
                 params, blocks=unshard_blocks_vshape(params["blocks"])
             )
-        elif schedule in ("interleaved", "zb"):
+        elif schedule in ("interleaved", "zb", "zb-stash"):
             from tpu_dist_nn.parallel.transformer_pipeline import (
                 unshard_blocks_interleaved,
             )
