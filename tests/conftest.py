@@ -110,6 +110,9 @@ QUICK_TESTS = {
     "test_multihost_real": ["test_two_process_collectives"],
     "test_native_codec": ["test_examples_roundtrip_and_parity",
                           "test_fuzz_model_roundtrip_native_vs_python"],
+    "test_obs": ["test_counter_gauge_histogram_basics",
+                 "test_render_text_format_and_round_trip",
+                 "test_loopback_serving_metrics_and_healthz"],
     "test_optimizers": ["test_default_is_exactly_adam",
                         "test_warmup_ramps_learning_rate",
                         "test_grad_accum_no_update_until_k_steps"],

@@ -77,6 +77,17 @@ def test_sampling_reproducible_and_varies_with_key():
     assert int(a.min()) >= 0 and int(a.max()) < CFG.vocab_size
 
 
+def test_generate_boundary_total_fits_positional_table():
+    # T + N == max_seq_len + 1 is VALID: the decode loop embeds
+    # positions 0..T+N-2 only (the last sampled token is returned, not
+    # fed back), so the positional table is never over-indexed. The
+    # shared validator must accept what the decoders accept (ADVICE r5).
+    params = init_transformer(jax.random.key(0), CFG)
+    out = generate(params, CFG, _prompt(1, 40), CFG.max_seq_len + 1 - 40)
+    assert out.shape == (1, CFG.max_seq_len + 1 - 40)
+    assert int(out.min()) >= 0 and int(out.max()) < CFG.vocab_size
+
+
 def test_generate_bounds_and_key_requirements():
     params = init_transformer(jax.random.key(0), CFG)
     with pytest.raises(ValueError, match="max_seq_len"):
@@ -512,8 +523,10 @@ def test_pipeline_generate_shares_validator_contract():
     mesh = build_mesh(MeshSpec(stage=2, data=1))
     prompt = jnp.zeros((2, 8), jnp.int32)
 
-    # T + N == max_seq_len + 1: single-chip rejects; pipelined must too.
-    fn = make_pipeline_generate(mesh, cfg, 2, max_new_tokens=17)
+    # T + N == max_seq_len + 2 (one past the boundary: the decoders
+    # embed total-1 positions, so T + N == max_seq_len + 1 is valid):
+    # single-chip rejects; pipelined must too.
+    fn = make_pipeline_generate(mesh, cfg, 2, max_new_tokens=18)
     with pytest.raises(ValueError, match="max_seq_len"):
         fn(params_pp, prompt)
 
@@ -525,7 +538,7 @@ def test_pipeline_generate_shares_validator_contract():
     # Same contract through the overlapped wrapper.
     prompts = jnp.zeros((2, 2, 8), jnp.int32)
     fno = make_pipeline_generate_overlapped(
-        mesh, cfg, 2, 17, num_groups=2
+        mesh, cfg, 2, 18, num_groups=2
     )
     with pytest.raises(ValueError, match="max_seq_len"):
         fno(params_pp, prompts)

@@ -565,6 +565,31 @@ def test_serve_generate_single_chip_and_validation():
         server.stop(0)
 
 
+def test_serve_generate_validates_sampling_combo_at_construction():
+    # ADVICE r5: a bad sampling combination must fail at server
+    # construction with the validator's clear message, not surface as
+    # per-RPC INTERNAL from inside the decode runner.
+    from tpu_dist_nn.serving import serve_lm_generate
+
+    cfg, params = _gen_setup()
+    with pytest.raises(ValueError, match="top_k"):
+        serve_lm_generate(
+            params, cfg, 0, max_new_tokens=4, prompt_len=8,
+            temperature=0.0, top_k=5, host="127.0.0.1",
+        )
+    with pytest.raises(ValueError, match="max_seq_len"):
+        serve_lm_generate(
+            params, cfg, 0, max_new_tokens=18, prompt_len=8,
+            host="127.0.0.1",
+        )
+    # The boundary the decoders actually support (total-1 positions)
+    # constructs fine: prompt 8 + new 17 on max_seq_len 24.
+    server, port = serve_lm_generate(
+        params, cfg, 0, max_new_tokens=17, prompt_len=8, host="127.0.0.1",
+    )
+    server.stop(0)
+
+
 def test_serve_generate_sampled_draws_fresh_continuations():
     # temperature > 0: repeated identical prompts must NOT replay the
     # same continuation (the endpoint folds a batch counter into the

@@ -55,11 +55,44 @@ from tpu_dist_nn.parallel.pipeline import (
     pipeline_forward,
     pipeline_spec_summary,
 )
+from tpu_dist_nn.obs.registry import REGISTRY
 from tpu_dist_nn.train.metrics import classification_metrics
 from tpu_dist_nn.train.trainer import TrainConfig, train_fcnn
 from tpu_dist_nn.train.pipeline_trainer import train_pipelined
 
 log = logging.getLogger("tpu_dist_nn.engine")
+
+# Engine metric families (docs/OBSERVABILITY.md). Host-side float adds
+# only — a time.monotonic() pair around a device call, never a fetch.
+_INFER_SECONDS = REGISTRY.histogram(
+    "tdn_engine_infer_seconds", "Engine.infer wall time per call",
+)
+_INFER_ROWS = REGISTRY.counter(
+    "tdn_engine_infer_rows_total",
+    "rows computed by Engine.infer (includes coalescing padding; "
+    "tdn_batch_rows is the useful-rows view)",
+)
+_INFER_ERRORS = REGISTRY.counter(
+    "tdn_engine_infer_errors_total", "Engine.infer calls that raised",
+)
+# jit caches one program per input shape: a shape this engine has not
+# served before implies a compile (the bucketed batcher keeps this set
+# at ~log2(max_rows)); a repeat shape is a cache hit.
+_COMPILE_HITS = REGISTRY.counter(
+    "tdn_engine_compile_cache_hits_total",
+    "infer calls whose batch shape was already compiled",
+)
+_COMPILE_MISSES = REGISTRY.counter(
+    "tdn_engine_compile_cache_misses_total",
+    "infer calls whose batch shape was new (implies an XLA compile)",
+)
+_TRAIN_SECONDS = REGISTRY.histogram(
+    "tdn_engine_train_seconds", "Engine.train wall time per call",
+    buckets=(1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0),
+)
+_TRAIN_CALLS = REGISTRY.counter(
+    "tdn_engine_train_calls_total", "Engine.train invocations",
+)
 
 
 @dataclasses.dataclass
@@ -150,6 +183,9 @@ class Engine:
                 self._params = jax.device_put(self._params, replicated(self.mesh))
         self._q = None  # int8 serving path, single-program placement
         self._q_pp = None  # int8 serving path, pipelined placement
+        # Batch shapes this engine has served — the compile-cache
+        # hit/miss proxy (jit compiles one program per input shape).
+        self._seen_infer_shapes: set[tuple] = set()
         # Static activation names: passed explicitly on the hot path so
         # infer() never reads act ids back from the device.
         self._act_names = tuple(l.activation for l in model.layers)
@@ -313,6 +349,27 @@ class Engine:
         :class:`~tpu_dist_nn.utils.errors.UnavailableError` after
         :meth:`down` (the reference's dead-channel UNAVAILABLE).
         """
+        t0 = time.monotonic()
+        try:
+            out = self._infer_impl(x)
+        except Exception:
+            _INFER_ERRORS.inc()
+            raise
+        _INFER_SECONDS.observe(time.monotonic() - t0)
+        _INFER_ROWS.inc(len(out))
+        # Shape-set bookkeeping AFTER the call: jit compiles per input
+        # shape, so a first-seen shape is the honest proxy for an XLA
+        # compile on this engine's programs.
+        shape = tuple(np.shape(out)[:1]) + tuple(np.shape(x)[-1:])
+        seen = self._seen_infer_shapes
+        if shape in seen:
+            _COMPILE_HITS.inc()
+        else:
+            seen.add(shape)
+            _COMPILE_MISSES.inc()
+        return out
+
+    def _infer_impl(self, x) -> np.ndarray:
         from tpu_dist_nn.utils.errors import UnavailableError, check_input_dim
 
         if self._pp is None and self._params is None and self._hp is None:
@@ -497,6 +554,24 @@ class Engine:
         self,
         train_data: Dataset,
         config: TrainConfig = TrainConfig(),
+        eval_data: Dataset | None = None,
+        checkpoints=None,
+        schedule: str = "gpipe",
+    ) -> list[dict]:
+        """Train in place (pipelined if placed that way); returns history."""
+        _TRAIN_CALLS.inc()
+        t0 = time.monotonic()
+        try:
+            return self._train_impl(
+                train_data, config, eval_data, checkpoints, schedule
+            )
+        finally:
+            _TRAIN_SECONDS.observe(time.monotonic() - t0)
+
+    def _train_impl(
+        self,
+        train_data: Dataset,
+        config: TrainConfig,
         eval_data: Dataset | None = None,
         checkpoints=None,
         schedule: str = "gpipe",
@@ -686,24 +761,38 @@ class Engine:
 
     # ------------------------------------------------------------ health
 
-    def health(self) -> dict:
-        """Structured readiness report — the reference's TCP readiness
-        poll (run_grpc_fcnn.py:157-172) as an inspectable status."""
-        ready = (
+    @property
+    def is_ready(self) -> bool:
+        """Attribute-only readiness (no device work) — the ONE
+        predicate health(), /healthz, and the obs runtime sampler
+        share, so a new placement slot cannot silently drift one of
+        them out of sync."""
+        return (
             self._pp is not None
             or self._params is not None
             or self._hp is not None
         )
+
+    def health(self, probe: bool = True) -> dict:
+        """Structured readiness report — the reference's TCP readiness
+        poll (run_grpc_fcnn.py:157-172) as an inspectable status.
+
+        ``probe=False`` skips the device inference probe: the
+        per-request form served by ``/healthz`` (a liveness poller must
+        not dispatch device work concurrent with training/serving, nor
+        pay an XLA compile on its first hit).
+        """
+        ready = self.is_ready
         status = {
             "ready": ready,
             "devices": self.mesh_spec.num_devices,
             "pipelined": self.pipelined,
             "setup_seconds": self.setup_seconds,
         }
-        if ready:
+        if ready and probe:
             try:
-                probe = np.zeros((1, self.model.input_dim))
-                out = self.infer(probe)
+                probe_x = np.zeros((1, self.model.input_dim))
+                out = self.infer(probe_x)
                 status["probe_ok"] = bool(np.isfinite(out).all())
             except Exception as e:  # a failing probe is the finding, not a crash
                 status["probe_ok"] = False

@@ -10,6 +10,8 @@
                  offline scripts/generate_mnist_*.py + notebook recipes)
 ``tdn oracle`` — scripts/manual_nn.py analogue: single-process float64
                  forward with per-example latency printout
+``tdn metrics``— one-shot scrape/pretty-print of a ``--metrics-port``
+                 /metrics endpoint (obs/exposition.py)
 """
 
 from __future__ import annotations
@@ -154,6 +156,64 @@ def _jax_process_count() -> int:
     return jax.process_count()
 
 
+# Live (server, sampler) pairs, drained by main()'s finally so an
+# error path anywhere in a command cannot leak a bound port or a
+# sampler thread into an in-process caller (tests run main() directly).
+_live_metrics_servers: list = []
+
+
+def _start_metrics_server(args, health_fn=None):
+    """Start the /metrics + /healthz endpoint when --metrics-port was
+    passed; prints the bound port as a JSON line (``port=0`` picks an
+    ephemeral one — drivers/tests read the line, the reference's
+    port-in-stdout convention). Returns the server or None. A busy
+    port is a user error (ValueError -> clean rc 2), not a traceback."""
+    port = getattr(args, "metrics_port", None)
+    if port is None:
+        return None
+    if _jax_process_count() > 1:
+        import jax
+
+        if jax.process_index() != 0:
+            # One exposition endpoint per job: every host binding the
+            # same port on shared infra would collide, and per-host
+            # counters would cover only that host's stripe.
+            return None
+    from tpu_dist_nn.obs import start_http_server
+
+    try:
+        server = start_http_server(port, health_fn=health_fn)
+    except OSError as e:
+        raise ValueError(f"--metrics-port {port} could not bind: {e}") from e
+    _live_metrics_servers.append([server, None])
+    print(json.dumps({"metrics_port": server.port}), flush=True)
+    return server
+
+
+def _attach_metrics_sampler(server, sampler) -> None:
+    for entry in _live_metrics_servers:
+        if entry[0] is server:
+            entry[1] = sampler
+
+
+def _stop_metrics_server(server, sampler=None) -> None:
+    if sampler is not None:
+        sampler.stop()
+    if server is not None:
+        server.close()
+        _live_metrics_servers[:] = [
+            e for e in _live_metrics_servers if e[0] is not server
+        ]
+
+
+def _drain_metrics_servers() -> None:
+    """Close anything a command's error path left running (close() is
+    idempotent, so the normal-path _stop_metrics_server calls and this
+    sweep compose)."""
+    for server, sampler in list(_live_metrics_servers):
+        _stop_metrics_server(server, sampler)
+
+
 def _parse_distribution(text):
     if text is None:
         return None
@@ -220,6 +280,17 @@ def cmd_up(args) -> int:
             "host would dispatch collectives the other hosts never "
             "join (deadlock); serve from a single-process engine"
         )
+    # Bind /metrics + /healthz BEFORE the (expensive) engine bring-up:
+    # a busy port must fail in seconds, not after minutes of pod
+    # warmup (the file's fail-fast convention). The health closure
+    # late-binds `engine`; until it exists /healthz reports not-ready
+    # 503 — which is exactly what bring-up IS. probe=False: a per-
+    # request device probe from the HTTP thread would race the serving
+    # path and pay an XLA compile on the poller's first hit.
+    metrics_server = _start_metrics_server(
+        args, health_fn=lambda: engine.health(probe=False)
+    )
+    sampler = None
     engine = _engine_from_args(args)
     print(json.dumps({"ready": True, "setup_seconds": engine.setup_seconds,
                       "placement": engine.placement()}))
@@ -240,16 +311,30 @@ def cmd_up(args) -> int:
             engine, args.grpc_port, warm_rows=args.serve_warm_rows
         )
         print(json.dumps({"grpc_port": bound}), flush=True)
+        if metrics_server is not None:
+            from tpu_dist_nn.obs import RuntimeSampler
+
+            sampler = RuntimeSampler()
+            if server.batcher is not None:
+                sampler.add_batcher(server.batcher, method="Process")
+            sampler.add_engine(engine)
+            sampler.start()
+            _attach_metrics_sampler(metrics_server, sampler)
 
         def teardown():
             # Drain in-flight RPCs before the engine goes away.
             server.stop(grace=1.0).wait()
             engine.down()
+            _stop_metrics_server(metrics_server, sampler)
 
-        _serve_loop(engine, teardown=teardown)
+        _serve_loop(engine, max_seconds=args.serve_seconds,
+                    teardown=teardown)
         return 0
     if args.serve:
-        _serve_loop(engine)
+        _serve_loop(engine, max_seconds=args.serve_seconds)
+        _stop_metrics_server(metrics_server)
+        return 0
+    _stop_metrics_server(metrics_server)
     return 0
 
 
@@ -479,10 +564,19 @@ def cmd_train(args) -> int:
     checkpoints = None
     if args.checkpoint_dir:
         checkpoints = _make_checkpoint_manager(args)
-    history = engine.train(
-        data, cfg, eval_data=eval_data, checkpoints=checkpoints,
-        schedule=args.schedule,
+    # Scrapers watch the run live (step/loss/checkpoint families from
+    # the trainer); /healthz mirrors the engine while it trains
+    # (probe=False: no device dispatch from the HTTP thread mid-step).
+    metrics_server = _start_metrics_server(
+        args, health_fn=lambda: engine.health(probe=False)
     )
+    try:
+        history = engine.train(
+            data, cfg, eval_data=eval_data, checkpoints=checkpoints,
+            schedule=args.schedule,
+        )
+    finally:
+        _stop_metrics_server(metrics_server)
     if args.metrics_out:
         _write_metrics_jsonl(args.metrics_out, history)
     for h in history:
@@ -619,10 +713,13 @@ def cmd_lm(args) -> int:
                 f"--layers {args.layers} must be divisible by "
                 f"--serve-stages {args.serve_stages}"
             )
-        if args.serve_prompt_len + args.serve_new_tokens > args.seq_len:
+        if args.serve_prompt_len + args.serve_new_tokens - 1 > args.seq_len:
+            # total-1 positions are embedded (the final sampled token
+            # is returned, never fed back) — the shared validator's
+            # boundary (models/generate.validate_generate_args).
             raise ValueError(
                 f"--serve-prompt-len {args.serve_prompt_len} + "
-                f"--serve-new-tokens {args.serve_new_tokens} must fit "
+                f"--serve-new-tokens {args.serve_new_tokens} - 1 must fit "
                 f"--seq-len {args.seq_len} (the positional table)"
             )
         if (args.serve_groups is not None
@@ -1200,6 +1297,10 @@ def cmd_lm(args) -> int:
     num_virtual = getattr(args, "virtual_stages", None)
     if num_virtual is None:
         num_virtual = 2 if args.schedule == "interleaved" else 1
+    # Live telemetry for the whole run: training counters during the
+    # loop, serving counters if --serve-generate follows. No engine
+    # here, so /healthz is a bare liveness probe.
+    metrics_server = _start_metrics_server(args)
     t0 = time.monotonic()
     import contextlib
 
@@ -1234,9 +1335,21 @@ def cmd_lm(args) -> int:
             "over the FULL dataset (includes training rows)",
             len(eval_rows), args.batch_size,
         )
-    cap = getattr(args, "eval_batches", 512)
+    cap = getattr(args, "eval_batches", 0)
+    eval_rows_used = eval_rows if held_out else rows
+    avail_batches = len(eval_rows_used) // args.batch_size
+    if cap > 0 and cap < avail_batches:
+        # The cap changes WHAT the reported loss/perplexity measure —
+        # make every truncated eval loudly comparable (ADVICE r5: the
+        # old silent 512 default broke cross-round comparability).
+        log.warning(
+            "--eval-batches %d truncates the eval set (%d of %d "
+            "batches evaluated); loss/perplexity cover a subset — "
+            "compare eval_rows_used across runs",
+            cap, cap, avail_batches,
+        )
     eval_metrics = eval_fn(
-        params, cfg, eval_rows if held_out else rows,
+        params, cfg, eval_rows_used,
         batch_size=args.batch_size,
         max_batches=cap if cap > 0 else None,
     )
@@ -1342,6 +1455,14 @@ def cmd_lm(args) -> int:
             "max_new_tokens": args.serve_new_tokens,
             "stages": args.serve_stages,
         }
+        sampler = None
+        if metrics_server is not None and server.batcher is not None:
+            from tpu_dist_nn.obs import RuntimeSampler
+
+            sampler = RuntimeSampler()
+            sampler.add_batcher(server.batcher, method="Generate")
+            sampler.start()
+            _attach_metrics_sampler(metrics_server, sampler)
         print(json.dumps(report), flush=True)
         try:
             if args.serve_seconds is not None:
@@ -1351,8 +1472,75 @@ def cmd_lm(args) -> int:
         except KeyboardInterrupt:
             pass
         server.stop(1).wait()
+        _stop_metrics_server(metrics_server, sampler)
         return 0
     print(json.dumps(report))
+    _stop_metrics_server(metrics_server)
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """One-shot scrape of a running --metrics-port endpoint: fetch
+    /metrics, pretty-print the tdn_* families (or dump raw text) —
+    `curl | grep` without leaving the tool, and the quickest way to
+    check coalescing efficiency on a live server."""
+    import urllib.error
+    import urllib.request
+
+    target = args.target
+    if "://" not in target:
+        target = f"http://{target}"
+    base = target.rstrip("/")
+    try:
+        with urllib.request.urlopen(
+            base + "/metrics", timeout=args.timeout
+        ) as resp:
+            text = resp.read().decode()
+    except (urllib.error.URLError, OSError) as e:
+        raise ValueError(f"could not scrape {base}/metrics: {e}") from e
+    if args.raw:
+        print(text, end="")
+        return 0
+    from tpu_dist_nn.obs import parse_prometheus_text
+
+    parsed = parse_prometheus_text(text)
+    kinds = {
+        k.split(":", 1)[1]: v
+        for k, v in parsed.items() if str(k).startswith("__type__:")
+    }
+    series = {
+        k: v for k, v in parsed.items() if not str(k).startswith("__type__:")
+    }
+    for name in sorted(kinds):
+        kind = kinds[name]
+        if kind == "histogram":
+            # One line per labeled series: count / sum / mean (the
+            # bucket detail stays in --raw).
+            prefix = name + "_count"
+            for s in sorted(series):
+                if s == prefix or s.startswith(prefix + "{"):
+                    labels = s[len(prefix):]
+                    count = series[s]
+                    total = series.get(name + "_sum" + labels, 0.0)
+                    mean = total / count if count else 0.0
+                    print(
+                        f"[histogram] {name}{labels} count={int(count)} "
+                        f"sum={total:.6g} mean={mean:.6g}"
+                    )
+        else:
+            for s in sorted(series):
+                if s == name or s.startswith(name + "{"):
+                    print(f"[{kind}] {s} = {series[s]:g}")
+    try:
+        with urllib.request.urlopen(
+            base + "/healthz", timeout=args.timeout
+        ) as resp:
+            print(f"healthz: {resp.read().decode().strip()}")
+    except urllib.error.HTTPError as e:
+        # 503 carries the not-ready health JSON — that IS the report.
+        print(f"healthz [{e.code}]: {e.read().decode().strip()}")
+    except (urllib.error.URLError, OSError) as e:
+        print(f"healthz: unavailable ({e})")
     return 0
 
 
@@ -1619,6 +1807,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="precompile request-coalescing bucket shapes up "
                         "to this many rows before opening the port "
                         "(0 disables)")
+    p.add_argument("--serve-seconds", type=float, default=None,
+                   help="serve for N seconds then tear down (default: "
+                        "until interrupted; bounds --serve/--grpc-port "
+                        "runs for drivers and tests)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="also expose /metrics (Prometheus text) and "
+                        "/healthz (Engine.health as JSON) on this port "
+                        "(0 = ephemeral, printed as a JSON line)")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
@@ -1713,6 +1909,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="native",
                    help="native msgpack store or the Orbax ecosystem "
                         "format")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="expose /metrics + /healthz for the duration of "
+                        "the training run (0 = ephemeral, printed as a "
+                        "JSON line)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("lm", help="train + eval the Tiny-Transformer LM")
@@ -1822,11 +2022,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record loss every N steps (each record is a "
                         "value-fetch barrier — the honest timing "
                         "points on the tunneled TPU)")
-    p.add_argument("--eval-batches", type=int, default=512,
-                   help="cap the held-out eval at N batches (0 = the "
-                        "full split; the 8 MB corpus can mean "
-                        "thousands of eval batches at small seq). "
-                        "The report records eval_rows_used")
+    p.add_argument("--eval-batches", type=int, default=0,
+                   help="cap the held-out eval at N batches (default 0 "
+                        "= the full split, comparable across rounds; "
+                        "a truncating cap logs a warning — the 8 MB "
+                        "corpus can mean thousands of eval batches at "
+                        "small seq). The report records eval_rows_used")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace of the "
                         "training loop here")
@@ -1860,6 +2061,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-seconds", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                         "interrupted)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="expose /metrics + /healthz for the run — "
+                        "training counters during the loop, serving "
+                        "counters under --serve-generate (0 = "
+                        "ephemeral, printed as a JSON line)")
     p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("doctor",
@@ -1882,6 +2088,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", required=True)
     p.add_argument("--inputs", required=True)
     p.set_defaults(fn=cmd_oracle)
+
+    p = sub.add_parser("metrics",
+                       help="one-shot scrape of a --metrics-port "
+                            "endpoint (pretty-printed or --raw)")
+    p.add_argument("--target", required=True,
+                   help="host:port of a running --metrics-port endpoint")
+    p.add_argument("--raw", action="store_true",
+                   help="dump the Prometheus text exposition as-is")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="HTTP timeout in seconds (default 5)")
+    p.set_defaults(fn=cmd_metrics)
 
     return parser
 
@@ -1974,6 +2191,11 @@ def main(argv=None) -> int:
         # analogue of the reference's fail-fast validation messages.
         print(f"error: {e}", file=sys.stderr)
         return 2
+    finally:
+        # Any --metrics-port endpoint a command's error path left
+        # running must not outlive the command (in-process callers —
+        # the tests — would hit the stale bound port on a rerun).
+        _drain_metrics_servers()
 
 
 if __name__ == "__main__":

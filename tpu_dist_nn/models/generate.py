@@ -164,9 +164,15 @@ def validate_generate_args(cfg: TransformerConfig, prompt_len: int,
         )
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if total > cfg.max_seq_len:
+    # The decoders embed positions 0 .. total-2 only (the final sampled
+    # token is returned, never fed back), so the positional table needs
+    # total-1 rows — total == max_seq_len + 1 is a VALID boundary call
+    # (every decode path sizes its cache total-1; ADVICE r5: the shared
+    # validator must not reject what the decoders accept).
+    if total - 1 > cfg.max_seq_len:
         raise ValueError(
-            f"prompt {prompt_len} + new {max_new_tokens} exceeds max_seq_len "
+            f"prompt {prompt_len} + new {max_new_tokens} needs "
+            f"{total - 1} positions, exceeding max_seq_len "
             f"{cfg.max_seq_len}"
         )
     if temperature < 0:
@@ -196,8 +202,10 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
     Greedy when ``temperature == 0`` (no key needed), else samples from
     ``softmax(logits / temperature)`` using ``key``, optionally
     restricted to the ``top_k`` highest-probability tokens and/or the
-    ``top_p`` nucleus. Total length ``T + max_new_tokens`` must fit
-    ``cfg.max_seq_len`` (positional table). jit-compatible: static
+    ``top_p`` nucleus. ``T + max_new_tokens - 1`` positions must fit
+    ``cfg.max_seq_len`` (the final sampled token is never embedded, so
+    the positional table needs one row fewer than the total length).
+    jit-compatible: static
     ``max_new_tokens``/``temperature``/``top_k``/``top_p``.
     """
     prompt = jnp.asarray(prompt, jnp.int32)

@@ -1,0 +1,50 @@
+"""Observability: process-wide metrics registry + Prometheus exposition.
+
+The reference stack's only telemetry is printed wall-clock spans
+(``run_grpc_inference.py:195-216``) and the repo's own
+:mod:`tpu_dist_nn.utils.profiling` counters — neither is visible while
+the system RUNS. This package is the dependency-free (stdlib-only)
+metrics layer the serving/training hot paths publish into:
+
+  - :mod:`tpu_dist_nn.obs.registry` — ``Counter`` / ``Gauge`` /
+    ``Histogram`` families with label support behind one process-wide
+    :data:`~tpu_dist_nn.obs.registry.REGISTRY`, plus the bridge that
+    teaches existing :class:`~tpu_dist_nn.utils.profiling.LatencyStats`
+    objects to feed a histogram.
+  - :mod:`tpu_dist_nn.obs.exposition` — Prometheus text-format
+    rendering and the stdlib ``/metrics`` + ``/healthz`` HTTP endpoint
+    (``tdn ... --metrics-port``).
+  - :mod:`tpu_dist_nn.obs.runtime` — a background sampler publishing
+    queue depth, in-flight rows, coalesce ratio, and host/device
+    memory gauges.
+
+Every metric this framework publishes is prefixed ``tdn_``; the
+catalog lives in ``docs/OBSERVABILITY.md``. All updates are plain
+host-side dict/float operations — nothing here ever touches a device
+buffer or forces a fetch, so instrumentation stays off the XLA hot
+path by construction.
+"""
+
+from tpu_dist_nn.obs.registry import (  # noqa: F401
+    REGISTRY,
+    Registry,
+    bridge_latency_stats,
+)
+from tpu_dist_nn.obs.exposition import (  # noqa: F401
+    MetricsServer,
+    parse_prometheus_text,
+    render,
+    start_http_server,
+)
+from tpu_dist_nn.obs.runtime import RuntimeSampler  # noqa: F401
+
+__all__ = [
+    "REGISTRY",
+    "Registry",
+    "bridge_latency_stats",
+    "MetricsServer",
+    "parse_prometheus_text",
+    "render",
+    "start_http_server",
+    "RuntimeSampler",
+]

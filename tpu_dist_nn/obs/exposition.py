@@ -1,0 +1,201 @@
+"""Prometheus text-format exposition + the stdlib /metrics endpoint.
+
+Renders the process registry in text format 0.0.4 (the format every
+scraper speaks) and serves it from a ``http.server`` daemon thread —
+no web framework, no asyncio, startable NEXT TO the gRPC server on a
+second port (``tdn up --grpc-port 5101 --metrics-port 9100``).
+
+``/healthz`` mirrors :meth:`tpu_dist_nn.api.engine.Engine.health`
+(structured readiness, the reference's TCP poll as JSON): HTTP 200
+when ``ready``, 503 when not — so the same probe a human curls is the
+one a load balancer gates on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpu_dist_nn.obs.registry import REGISTRY, Registry
+
+log = logging.getLogger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", r"\\").replace("\n", r"\n").replace('"', r"\"")
+    )
+
+
+def _fmt(v: float) -> str:
+    # Integral values print bare (the common counter case); floats keep
+    # repr fidelity so scrape->parse round-trips exactly. Non-finite
+    # values use the text format's literals — a diverged-loss NaN gauge
+    # must not make the whole endpoint unscrapable.
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _labelstr(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+def render(registry: Registry | None = None) -> str:
+    """The whole registry in Prometheus text format 0.0.4."""
+    reg = registry if registry is not None else REGISTRY
+    out = []
+    for m in reg.collect():
+        samples = m.samples()
+        if not samples:
+            continue
+        if m.help:
+            out.append(f"# HELP {m.name} {_escape_help(m.help)}")
+        out.append(f"# TYPE {m.name} {m.kind}")
+        for values, child in samples:
+            if m.kind == "histogram":
+                # Cumulative le-buckets, then +Inf == _count.
+                cum = 0
+                for edge, n in zip(m.buckets, child.counts):
+                    cum += n
+                    out.append(
+                        f"{m.name}_bucket"
+                        + _labelstr(
+                            m.labelnames + ("le",), values + (_fmt(edge),)
+                        )
+                        + f" {cum}"
+                    )
+                total = cum + child.counts[-1]
+                out.append(
+                    f"{m.name}_bucket"
+                    + _labelstr(m.labelnames + ("le",), values + ("+Inf",))
+                    + f" {total}"
+                )
+                ls = _labelstr(m.labelnames, values)
+                out.append(f"{m.name}_sum{ls} {_fmt(child.sum)}")
+                out.append(f"{m.name}_count{ls} {total}")
+            else:
+                out.append(
+                    f"{m.name}{_labelstr(m.labelnames, values)} "
+                    f"{_fmt(child.value)}"
+                )
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Text format -> ``{series_name_with_labels: float}`` (plus
+    ``__type__:<name>`` entries). The inverse of :func:`render` for the
+    ``tdn metrics`` pretty-printer and tests — not a general parser,
+    but it round-trips everything render emits."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            out[f"__type__:{name}"] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        try:
+            out[series] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+class MetricsServer:
+    """The /metrics + /healthz endpoint on a daemon thread.
+
+    ``health_fn`` is polled per /healthz request (``Engine.health`` in
+    the serving wiring); omit it for processes with no engine — the
+    endpoint then reports ``{"ready": true}`` for liveness.
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
+                 registry: Registry | None = None, health_fn=None):
+        reg = registry if registry is not None else REGISTRY
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = render(reg).encode()
+                    self._reply(200, CONTENT_TYPE, body)
+                elif path == "/healthz":
+                    status, body = outer._health_body()
+                    self._reply(status, "application/json", body)
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, status, ctype, body):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # scrapes are not news
+                log.debug("metrics http: " + fmt, *args)
+
+        self._health_fn = health_fn
+        self._closed = False
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tdn-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("metrics endpoint on :%d (/metrics, /healthz)", self.port)
+
+    def _health_body(self):
+        if self._health_fn is None:
+            return 200, b'{"ready": true}\n'
+        try:
+            health = self._health_fn()
+        except Exception as e:  # noqa: BLE001 — a failing probe IS the report
+            return 503, json.dumps(
+                {"ready": False, "error": repr(e)}
+            ).encode() + b"\n"
+        status = 200 if health.get("ready") else 503
+        return status, json.dumps(health).encode() + b"\n"
+
+    def close(self) -> None:
+        """Idempotent — a second close is a no-op, not a hang (stdlib
+        shutdown() blocks forever if serve_forever already exited)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port: int = 0, host: str = "0.0.0.0", *,
+                      registry: Registry | None = None,
+                      health_fn=None) -> MetricsServer:
+    """Start the /metrics endpoint; returns the server (``.port`` holds
+    the bound port when ``port=0`` picked an ephemeral one)."""
+    return MetricsServer(port, host, registry=registry, health_fn=health_fn)

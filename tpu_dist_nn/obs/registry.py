@@ -1,0 +1,256 @@
+"""Process-wide metric registry: Counter, Gauge, Histogram.
+
+Design constraints (the serving/training hot paths publish here):
+
+* **Dependency-free** — stdlib only; the container has no
+  prometheus_client and must not grow one.
+* **Lock-cheap** — one ``threading.Lock`` per family, held only for a
+  dict lookup + float add. No allocation on the repeat-update path:
+  ``labels(...)`` returns a cached child whose update methods touch
+  pre-bound slots.
+* **Host-side only** — values are python floats; updating a metric
+  never touches a jax array (a device fetch on the batcher thread
+  would serialize the launch pipeline — the exact failure the r4
+  forensics rules exist to catch).
+
+Get-or-create semantics: asking the registry for an existing family
+name returns the SAME family (so module-level instrumentation in
+server/engine/trainer modules converges on one set of series), and
+asking with a conflicting kind or label schema raises — a typo must
+not silently fork a second family.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+# Latency-shaped default: sub-ms serving spans up to multi-second
+# compile/step outliers. "+Inf" is implicit (rendered by exposition).
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+# Row-count-shaped buckets: the batcher pads coalesced batches to
+# powers of two, so bucket edges ON the powers make the histogram an
+# exact per-bucket launch count.
+POW2_BUCKETS = tuple(float(1 << i) for i in range(17))  # 1 .. 65536
+
+
+class _Child:
+    """One labeled series. Value semantics depend on the family kind."""
+
+    __slots__ = ("kind", "value", "sum", "counts", "_buckets", "_lock")
+
+    def __init__(self, kind, buckets, lock):
+        self.kind = kind
+        self.value = 0.0
+        self.sum = 0.0
+        self._buckets = buckets
+        self._lock = lock
+        self.counts = [0] * (len(buckets) + 1) if buckets is not None else None
+
+    def _expect(self, *kinds) -> None:
+        if self.kind not in kinds:
+            raise ValueError(f"operation not valid for a {self.kind}")
+
+    # -- counter / gauge ------------------------------------------------
+    def inc(self, amount: float = 1.0) -> None:
+        self._expect("counter", "gauge")
+        if self.kind == "counter" and amount < 0:
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._expect("gauge")
+        with self._lock:
+            self.value -= amount
+
+    def set(self, value: float) -> None:
+        self._expect("gauge")
+        with self._lock:
+            self.value = float(value)
+
+    # -- histogram ------------------------------------------------------
+    def observe(self, value: float) -> None:
+        self._expect("histogram")
+        v = float(value)
+        i = bisect.bisect_left(self._buckets, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.value += 1  # total count
+
+
+class Metric:
+    """One metric family: a name, a kind, a label schema, N children."""
+
+    def __init__(self, name: str, help: str, kind: str,
+                 labelnames: tuple = (), buckets=None):
+        _validate_name(name)
+        for ln in labelnames:
+            _validate_name(ln)
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets) if buckets is not None else None
+        if kind == "histogram" and self.buckets is None:
+            self.buckets = DEFAULT_BUCKETS
+        if self.buckets is not None and list(self.buckets) != sorted(
+            set(self.buckets)
+        ):
+            raise ValueError(
+                f"{name}: buckets must be strictly increasing, got "
+                f"{self.buckets}"
+            )
+        self._lock = threading.Lock()
+        self._children: dict[tuple, _Child] = {}
+        if not self.labelnames:
+            # Unlabeled families materialize at 0 immediately: an
+            # error-class counter born at its first increment is
+            # invisible to rate()/increase() alerts for exactly the
+            # event that mattered (labeled children stay lazy — the
+            # label space is open-ended).
+            self._children[()] = _Child(self.kind, self.buckets, self._lock)
+
+    def labels(self, **labels) -> _Child:
+        """The child series for this label-value assignment (cached)."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got "
+                f"{tuple(labels)}"
+            )
+        key = tuple(str(labels[ln]) for ln in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    key, _Child(self.kind, self.buckets, self._lock)
+                )
+        return child
+
+    # Unlabeled convenience: metric.inc() == metric.labels().inc().
+    def _default(self) -> _Child:
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} has labels {self.labelnames}; use "
+                ".labels(...)"
+            )
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def samples(self):
+        """-> [(label_values_tuple, child)] snapshot for exposition."""
+        with self._lock:
+            return list(self._children.items())
+
+
+def _validate_name(name: str) -> None:
+    import re
+
+    if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name):
+        raise ValueError(f"invalid metric/label name: {name!r}")
+
+
+class Registry:
+    """Name -> Metric map with get-or-create family factories."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Metric] = {}
+
+    def _get_or_create(self, name, help, kind, labelnames, buckets=None):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.kind != kind or existing.labelnames != tuple(
+                    labelnames
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.labelnames}; requested "
+                        f"{kind}{tuple(labelnames)}"
+                    )
+                if buckets is not None and tuple(buckets) != existing.buckets:
+                    # Silently keeping the first schema would bucket the
+                    # caller's observations on edges it never asked for.
+                    raise ValueError(
+                        f"metric {name!r} already registered with buckets "
+                        f"{existing.buckets}; requested {tuple(buckets)}"
+                    )
+                return existing
+            m = Metric(name, help, kind, labelnames, buckets)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "", labels: tuple = ()) -> Metric:
+        return self._get_or_create(name, help, "counter", labels)
+
+    def gauge(self, name: str, help: str = "", labels: tuple = ()) -> Metric:
+        return self._get_or_create(name, help, "gauge", labels)
+
+    def histogram(self, name: str, help: str = "", labels: tuple = (),
+                  buckets=None) -> Metric:
+        return self._get_or_create(name, help, "histogram", labels, buckets)
+
+    def collect(self) -> list[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def get(self, name: str) -> Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Drop every family — test isolation only; production callers
+        hold Metric references that would silently detach."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# The process-wide registry every built-in instrumentation site
+# publishes into and ``/metrics`` renders from.
+REGISTRY = Registry()
+
+
+def bridge_latency_stats(stats, name: str | None = None,
+                         registry: Registry | None = None,
+                         buckets=None, **labels):
+    """Teach an existing :class:`~tpu_dist_nn.utils.profiling.LatencyStats`
+    to ALSO feed a registry histogram — current callers (``summary()``,
+    ``percentile()``, ``step_latency``) keep working unchanged, and
+    every span they record from now on lands in
+    ``{name}`` (default ``tdn_<stats.name>_seconds``).
+
+    Returns ``stats`` (for chaining at construction sites).
+    """
+    reg = registry if registry is not None else REGISTRY
+    metric = reg.histogram(
+        name or f"tdn_{stats.name}_seconds",
+        f"bridged from LatencyStats({stats.name!r})",
+        labels=tuple(labels),
+        buckets=buckets,
+    )
+    child = metric.labels(**labels) if labels else metric.labels()
+    inner = stats.record
+
+    def record(seconds: float) -> None:
+        inner(seconds)
+        child.observe(seconds)
+
+    stats.record = record
+    return stats

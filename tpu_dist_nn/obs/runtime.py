@@ -1,0 +1,155 @@
+"""Background runtime sampler: the gauges nobody increments.
+
+Counters and histograms are pushed by the code paths that own the
+events; STATE (queue depth, rows in flight on the device, coalescing
+efficiency, memory) has no event to hook, so a daemon thread samples
+it on an interval. Everything read here is a plain python attribute
+or a host syscall — sampling never blocks the batcher or dispatches
+device work (``device.memory_stats()`` is a local runtime query, not
+a computation).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tpu_dist_nn.obs.registry import REGISTRY, Registry
+
+log = logging.getLogger(__name__)
+
+
+def _read_rss_bytes() -> int | None:
+    """Resident set size from /proc (linux); None where unavailable."""
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        import resource
+
+        return int(fields[1]) * resource.getpagesize()
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+class RuntimeSampler:
+    """Samples registered sources into gauges every ``interval`` s.
+
+    Sources attach after construction (``add_batcher`` from the
+    serving wiring, ``add_engine`` where one exists); host RSS and —
+    when the backend exposes them — per-device memory stats are
+    sampled unconditionally. ``start()`` publishes one immediate
+    sample so a scrape right after bring-up is never empty.
+    """
+
+    def __init__(self, interval: float = 5.0, *,
+                 registry: Registry | None = None):
+        reg = registry if registry is not None else REGISTRY
+        self._interval = float(interval)
+        self._batchers: list[tuple[str, object]] = []
+        self._engines: list[object] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._g_queue = reg.gauge(
+            "tdn_batcher_queue_depth",
+            "requests waiting in the coalescing queue", labels=("method",),
+        )
+        self._g_inflight = reg.gauge(
+            "tdn_batcher_inflight_rows",
+            "rows in the batch currently on the device", labels=("method",),
+        )
+        self._g_ratio = reg.gauge(
+            "tdn_batcher_coalesce_ratio",
+            "requests served per device launch (cumulative)",
+            labels=("method",),
+        )
+        self._g_rss = reg.gauge(
+            "tdn_host_rss_bytes", "resident set size of this process",
+        )
+        self._g_dev = reg.gauge(
+            "tdn_device_memory_bytes",
+            "per-device memory from the backend allocator",
+            labels=("device", "kind"),
+        )
+        self._g_ready = reg.gauge(
+            "tdn_engine_ready",
+            "1 when every registered engine would report ready",
+        )
+
+    # ------------------------------------------------------------ wiring
+
+    def add_batcher(self, batcher, method: str = "Process") -> None:
+        self._batchers.append((method, batcher))
+
+    def add_engine(self, engine) -> None:
+        self._engines.append(engine)
+
+    # ------------------------------------------------------------ loop
+
+    def start(self) -> "RuntimeSampler":
+        if self._thread is not None:
+            return self
+        self._safe_sample()
+        self._thread = threading.Thread(
+            target=self._run, name="tdn-obs-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._safe_sample()
+
+    def _safe_sample(self) -> None:
+        try:
+            self.sample_once()
+        except Exception:  # noqa: BLE001 — sampling must never kill serving
+            log.exception("runtime sample failed")
+
+    def sample_once(self) -> None:
+        """One synchronous sample of every source (also used by tests)."""
+        for method, b in self._batchers:
+            self._g_queue.labels(method=method).set(len(b._pending))
+            self._g_inflight.labels(method=method).set(
+                getattr(b, "inflight_rows", 0)
+            )
+            launches = max(b.batches_total, 1)
+            self._g_ratio.labels(method=method).set(
+                b.requests_total / launches
+            )
+        if self._engines:
+            # Engine.is_ready is attribute-only (health()'s probe would
+            # launch a device program per sample). All engines must be
+            # up: a per-engine overwrite would let the last-registered
+            # one mask a dead sibling.
+            ready = all(
+                bool(getattr(e, "is_ready", False)) for e in self._engines
+            )
+            self._g_ready.set(1.0 if ready else 0.0)
+        rss = _read_rss_bytes()
+        if rss is not None:
+            self._g_rss.set(rss)
+        self._sample_devices()
+
+    def _sample_devices(self) -> None:
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                stats = getattr(d, "memory_stats", lambda: None)()
+                if not stats:
+                    continue
+                name = f"{d.platform}:{d.id}"
+                for kind in ("bytes_in_use", "peak_bytes_in_use",
+                             "bytes_limit"):
+                    if kind in stats:
+                        self._g_dev.labels(device=name, kind=kind).set(
+                            stats[kind]
+                        )
+        except Exception:  # noqa: BLE001 — no backend / no stats: skip quietly
+            log.debug("device memory stats unavailable", exc_info=True)

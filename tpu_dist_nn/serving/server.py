@@ -30,11 +30,13 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent import futures
 
 import grpc
 import numpy as np
 
+from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
 from tpu_dist_nn.serving.wire import (
     GENERATE_METHOD,
     PROCESS_METHOD,
@@ -44,6 +46,39 @@ from tpu_dist_nn.serving.wire import (
 )
 
 log = logging.getLogger(__name__)
+
+# Serving metric families (docs/OBSERVABILITY.md catalog). All updates
+# are host-side float adds — never a device touch on the hot path.
+_RPC_REQUESTS = REGISTRY.counter(
+    "tdn_rpc_requests_total", "RPCs received, per method",
+    labels=("method",),
+)
+_RPC_ERRORS = REGISTRY.counter(
+    "tdn_rpc_errors_total", "RPCs aborted, per method and status code",
+    labels=("method", "code"),
+)
+_BATCH_ROWS = REGISTRY.histogram(
+    "tdn_batch_rows", "coalesced rows per device launch (pre-padding)",
+    labels=("method",), buckets=POW2_BUCKETS,
+)
+_BATCH_WAIT = REGISTRY.histogram(
+    "tdn_batch_wait_seconds",
+    "time a request spent in the batcher (submit to result)",
+    labels=("method",),
+)
+_SUBMITS = REGISTRY.counter(
+    "tdn_batcher_submits_total", "requests entering the coalescing queue",
+    labels=("method",),
+)
+_ABANDONED = REGISTRY.counter(
+    "tdn_batcher_abandoned_total",
+    "requests that timed out waiting for their batch",
+    labels=("method",),
+)
+_LAUNCHES = REGISTRY.counter(
+    "tdn_batch_launches_total", "device launches issued by the batcher",
+    labels=("method",),
+)
 
 
 class _Batcher:
@@ -58,7 +93,8 @@ class _Batcher:
     """
 
     def __init__(self, engine, max_batch_rows: int = 65536,
-                 submit_timeout: float | None = 120.0, run_fn=None):
+                 submit_timeout: float | None = 120.0, run_fn=None,
+                 method: str = "Process"):
         self._engine = engine
         # The device launch the batcher owns: engine.infer by default,
         # or any ``rows (n, ...) -> rows (n, ...)`` closure (the LM
@@ -79,6 +115,17 @@ class _Batcher:
         self.requests_total = 0
         self.batches_total = 0
         self.rows_total = 0
+        # Rows of the batch currently on the device (the runtime
+        # sampler's in-flight gauge reads this attribute).
+        self.inflight_rows = 0
+        self.method = method
+        # Pre-bound registry children: the hot path does a float add,
+        # not a label lookup.
+        self._m_submits = _SUBMITS.labels(method=method)
+        self._m_abandoned = _ABANDONED.labels(method=method)
+        self._m_launches = _LAUNCHES.labels(method=method)
+        self._m_rows = _BATCH_ROWS.labels(method=method)
+        self._m_wait = _BATCH_WAIT.labels(method=method)
         self._thread = threading.Thread(
             target=self._loop, name="tdn-serve-batcher", daemon=True
         )
@@ -97,12 +144,14 @@ class _Batcher:
 
         item = {"x": x, "done": threading.Event(), "out": None, "err": None,
                 "abandoned": False}
+        t_submit = time.monotonic()
         with self._cond:
             if self._closed:
                 raise UnavailableError("server is shutting down")
             self._pending.append(item)
             self.requests_total += 1
             self._cond.notify()
+        self._m_submits.inc()
         bounds = [t for t in (self._submit_timeout, timeout) if t is not None]
         wait = min(bounds) if bounds else None
         # Bounded wait: if the engine wedges mid-batch (the tunneled-TPU
@@ -119,10 +168,12 @@ class _Batcher:
             # first launches computing rows nobody is waiting for.
             with self._cond:
                 item["abandoned"] = True
+            self._m_abandoned.inc()
             raise DeadlineExceededError(
                 f"coalesced batch did not complete within {wait}s "
                 "(engine wedged or request backlogged?)"
             )
+        self._m_wait.observe(time.monotonic() - t_submit)
         if item["err"] is not None:
             raise item["err"]
         return item["out"]
@@ -157,12 +208,14 @@ class _Batcher:
                 groups.setdefault(it["x"].shape[1:], []).append(it)
             for group in groups.values():
                 self.batches_total += 1
+                self._m_launches.inc()
                 try:
                     xs = (
                         group[0]["x"]
                         if len(group) == 1
                         else np.concatenate([it["x"] for it in group], axis=0)
                     )
+                    self._m_rows.observe(len(xs))
                     # Pad rows up to a power-of-two bucket: every
                     # distinct row count is a distinct jit shape, so
                     # unbucketed coalescing would recompile on nearly
@@ -175,6 +228,10 @@ class _Batcher:
                         xs = np.concatenate(
                             [xs, np.zeros((n_pad - n, *xs.shape[1:]), xs.dtype)]
                         )
+                    # AFTER padding: the gauge reports what the device
+                    # is actually running (tdn_batch_rows keeps the
+                    # pre-padding count — the useful-rows view).
+                    self.inflight_rows = len(xs)
                     out = np.asarray(self._run_fn(xs))
                     ofs = 0
                     for it in group:
@@ -185,6 +242,7 @@ class _Batcher:
                     for it in group:
                         it["err"] = e
                 finally:
+                    self.inflight_rows = 0
                     for it in group:
                         it["done"].set()
 
@@ -195,7 +253,14 @@ class _Batcher:
         self._thread.join(timeout=10)
 
 
-def _abort_for_exception(context, e, what: str):
+def _abort(context, method: str, code, message: str):
+    """Count, then abort: context.abort raises, so the error counter
+    must tick first (one funnel for every handler's abort)."""
+    _RPC_ERRORS.labels(method=method, code=code.name).inc()
+    context.abort(code, message)
+
+
+def _abort_for_exception(context, e, what: str, method: str = "Process"):
     """Map framework exceptions to the reference's gRPC status taxonomy
     (grpc_node.py:149-158) — ONE mapping for every method so a new
     status cannot land in Process and miss Generate."""
@@ -207,17 +272,17 @@ def _abort_for_exception(context, e, what: str):
 
     if isinstance(e, InvalidArgumentError):
         # The reference's dim-check path (grpc_node.py:149-153).
-        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        _abort(context, method, grpc.StatusCode.INVALID_ARGUMENT, str(e))
     if isinstance(e, DeadlineExceededError):
         # Batcher wait expired (wedged engine): the reference's
         # per-RPC timeout semantics (grpc_node.py:133).
-        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+        _abort(context, method, grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
     if isinstance(e, UnavailableError):
         # Engine torn down mid-flight: the reference's dead-channel
         # semantics (clients may retry elsewhere).
-        context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        _abort(context, method, grpc.StatusCode.UNAVAILABLE, str(e))
     log.exception("%s failed", what)
-    context.abort(grpc.StatusCode.INTERNAL, f"{what} failed: {e}")
+    _abort(context, method, grpc.StatusCode.INTERNAL, f"{what} failed: {e}")
 
 
 def _new_grpc_server(max_workers: int):
@@ -273,10 +338,12 @@ def _make_handler(engine, batcher: _Batcher | None):
     expected_dim = getattr(getattr(engine, "model", None), "input_dim", None)
 
     def process(request_bytes: bytes, context) -> bytes:
+        _RPC_REQUESTS.labels(method="Process").inc()
         try:
             x = decode_matrix(request_bytes)
         except ValueError as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad Matrix: {e}")
+            _abort(context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
+                   f"bad Matrix: {e}")
         if (
             batcher is not None
             and expected_dim is not None
@@ -284,8 +351,8 @@ def _make_handler(engine, batcher: _Batcher | None):
         ):
             # The reference's dim-check path (grpc_node.py:149-153),
             # message shape matching pipeline.pad_batch's error.
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
+            _abort(
+                context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
                 f"expected input of shape (N, {expected_dim}), got "
                 f"{tuple(x.shape)}",
             )
@@ -298,7 +365,7 @@ def _make_handler(engine, batcher: _Batcher | None):
                 with lock:
                     out = engine.infer(x)
         except Exception as e:  # noqa: BLE001 — map to status codes
-            _abort_for_exception(context, e, "inference")
+            _abort_for_exception(context, e, "inference", "Process")
         return encode_matrix(np.asarray(out, np.float64))
 
     rpc = grpc.unary_unary_rpc_method_handler(
@@ -370,29 +437,31 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
     status taxonomy as Process."""
 
     def generate(request_bytes: bytes, context) -> bytes:
+        _RPC_REQUESTS.labels(method="Generate").inc()
         try:
             x = decode_matrix(request_bytes)
         except ValueError as e:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad Matrix: {e}")
+            _abort(context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
+                   f"bad Matrix: {e}")
         if x.ndim != 2 or x.shape[1] != prompt_len:
             # The decode program is compiled for ONE static prompt
             # length per endpoint (static shapes under jit); clients
             # pad/pack to it.
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
+            _abort(
+                context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
                 f"expected prompts of shape (N, {prompt_len}), got "
                 f"{tuple(x.shape)}",
             )
         ids = x.astype(np.int64)
         if (ids != x).any() or (ids < 0).any() or (ids >= vocab_size).any():
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
+            _abort(
+                context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
                 f"prompts must be integer token ids in [0, {vocab_size})",
             )
         try:
             out = run_submit(ids.astype(np.int32), context.time_remaining())
         except Exception as e:  # noqa: BLE001 — map to status codes
-            _abort_for_exception(context, e, "generation")
+            _abort_for_exception(context, e, "generation", "Generate")
         return encode_matrix(np.asarray(out, np.float64))
 
     rpc = grpc.unary_unary_rpc_method_handler(
@@ -436,16 +505,21 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
 
     import jax
 
+    from tpu_dist_nn.models.generate import validate_generate_args
+
     params = cfg.cast_params(params)
     N = int(max_new_tokens)
     T = int(prompt_len)
-    if T + N > cfg.max_seq_len:
-        raise ValueError(
-            f"prompt_len {T} + max_new_tokens {N} exceeds max_seq_len "
-            f"{cfg.max_seq_len}"
-        )
     counter = itertools.count()
     base_key = jax.random.key(seed)
+    # Validate the WHOLE decode contract (lengths, causality, sampling
+    # ranges, greedy-vs-top_k conflicts) ONCE at construction: a bad
+    # combination must fail fast here, not surface as a per-RPC
+    # INTERNAL from inside the decode runner (ADVICE r5).
+    validate_generate_args(
+        cfg, T, N, temperature, top_k, top_p,
+        base_key if temperature > 0 else None,
+    )
 
     if num_stages > 1:
         from tpu_dist_nn.parallel.mesh import MeshSpec, build_mesh
@@ -498,7 +572,7 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
 
     server = _new_grpc_server(max_workers)
     batcher = (
-        _Batcher(None, 65536, submit_timeout, run_fn=run)
+        _Batcher(None, 65536, submit_timeout, run_fn=run, method="Generate")
         if coalesce else None
     )
     lock = threading.Lock()

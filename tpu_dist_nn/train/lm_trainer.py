@@ -25,10 +25,40 @@ from tpu_dist_nn.models.transformer import (
     dot_product_attention,
     lm_loss,
 )
+from tpu_dist_nn.obs.registry import REGISTRY
 from tpu_dist_nn.parallel.transformer_pipeline import (
     make_pipeline_lm_loss,
     shard_blocks,
     unshard_blocks,
+)
+
+# LM training metric families (docs/OBSERVABILITY.md). Updated ONLY at
+# log/checkpoint boundaries — the points that already pay a host sync
+# (float(loss)) — so instrumentation adds zero fetch barriers to the
+# step loop (the r4 honest-timing rule).
+_LM_STEPS = REGISTRY.counter(
+    "tdn_train_steps_total", "optimizer steps completed", labels=("trainer",),
+)
+_LM_TOKENS = REGISTRY.counter(
+    "tdn_train_tokens_total", "training tokens consumed (targets)",
+    labels=("trainer",),
+)
+_LM_LOSS = REGISTRY.gauge(
+    "tdn_train_loss", "latest recorded training loss", labels=("trainer",),
+)
+_LM_TOKENS_PER_S = REGISTRY.gauge(
+    "tdn_train_tokens_per_second",
+    "training throughput between the last two log boundaries",
+    labels=("trainer",),
+)
+_LM_STEP_SECONDS = REGISTRY.histogram(
+    "tdn_train_step_seconds",
+    "mean wall time per optimizer step over a logging interval",
+    labels=("trainer",),
+)
+_LM_CHECKPOINTS = REGISTRY.counter(
+    "tdn_checkpoint_saves_total", "checkpoint save events",
+    labels=("trainer",),
 )
 
 
@@ -555,8 +585,10 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     completed steps, and on resume the batch stream is consumed up to
     that step so a deterministic stream (``lm_batches`` with a fixed
     seed) stays aligned. Saves every ``checkpoint_every`` steps
-    (default: ``log_every``). Returns ``(params, history)`` with params
-    in standard (unstaged) layout either way.
+    (default: ``log_every``; with ``steps_per_call=K > 1`` it must be
+    a multiple of K — mid-group steps could only save group-end
+    state). Returns ``(params, history)`` with params in standard
+    (unstaged) layout either way.
 
     ``step_fn``: ``optimizer -> step`` factory overriding the built-in
     step (used by the MoE family via :func:`make_moe_lm_train_step`);
@@ -624,6 +656,16 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
             f"log_every ({train_cfg.log_every}) must be a multiple of "
             f"steps_per_call ({k}): per-step timestamps inside one "
             "grouped device call are not fetch barriers"
+        )
+    if k > 1 and checkpoint_every and checkpoint_every % k != 0:
+        # Same contract as log_every: a mid-group matching step can
+        # only save the GROUP-END state, so a misaligned cadence would
+        # silently thin the requested checkpoints to group boundaries
+        # (fewer saves than asked, each at a different step than asked).
+        raise ValueError(
+            f"checkpoint_every ({checkpoint_every}) must be a multiple "
+            f"of steps_per_call ({k}): checkpoints inside one grouped "
+            "device call can only capture group-end state"
         )
     if k > 1 and (step_fn is not None or pipelined):
         raise ValueError(
@@ -696,10 +738,18 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
 
     history = []
     t0 = time.monotonic()
+    # Throughput bookkeeping between log boundaries (the existing host
+    # syncs): tokens/steps since the last logged entry.
+    obs = {"tokens": 0, "steps": 0, "t_last": t0}
 
     def _flush_group(group):
         """Run the buffered (index, batch) group as ONE device call."""
         nonlocal params, opt_state
+        n_history_before = len(history)
+        obs["steps"] += len(group)
+        obs["tokens"] += sum(
+            int(b.shape[0]) * max(int(b.shape[1]) - 1, 0) for _, b in group
+        )
         if len(group) == 1 and multi is None:
             i, batch = group[0]
             gb = (
@@ -722,6 +772,18 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
                     {"step": i + 1, "loss": float(losses[j]),
                      "seconds": time.monotonic() - t0}
                 )
+        if len(history) > n_history_before:
+            # A log boundary: the float(loss) above was a true fetch,
+            # so wall time here measures completed device work. Publish
+            # the interval's throughput, then reset the window.
+            now = time.monotonic()
+            dt = max(now - obs["t_last"], 1e-9)
+            _LM_LOSS.labels(trainer="lm").set(history[-1]["loss"])
+            _LM_STEPS.labels(trainer="lm").inc(obs["steps"])
+            _LM_TOKENS.labels(trainer="lm").inc(obs["tokens"])
+            _LM_TOKENS_PER_S.labels(trainer="lm").set(obs["tokens"] / dt)
+            _LM_STEP_SECONDS.labels(trainer="lm").observe(dt / obs["steps"])
+            obs.update(tokens=0, steps=0, t_last=now)
         if checkpoints is not None and any(
             (i + 1) % every == 0 or i == train_cfg.steps - 1
             for i, _ in group
@@ -731,6 +793,7 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
                 i_last + 1, {"params": params, "opt_state": opt_state},
                 metadata={"step": i_last + 1, "loss": float(losses[-1])},
             )
+            _LM_CHECKPOINTS.labels(trainer="lm").inc()
 
     try:
         group = []

@@ -25,9 +25,29 @@ from tpu_dist_nn.data.datasets import Dataset
 from tpu_dist_nn.data.feed import batch_iterator
 from tpu_dist_nn.models.fcnn import forward, forward_logits, spec_from_params
 from tpu_dist_nn.checkpoint.store import flush
+from tpu_dist_nn.obs.registry import REGISTRY
 from tpu_dist_nn.train.metrics import classification_metrics
 
 log = logging.getLogger("tpu_dist_nn.train")
+
+# Trainer metric families (docs/OBSERVABILITY.md), shared with the LM
+# loop via the ``trainer`` label. Updated at epoch/log boundaries only
+# — the step loop itself stays untouched.
+_EPOCH_SECONDS = REGISTRY.histogram(
+    "tdn_train_epoch_seconds", "wall time per training epoch",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+_TRAIN_LOSS = REGISTRY.gauge(
+    "tdn_train_loss", "latest recorded training loss", labels=("trainer",),
+)
+_TRAIN_STEPS = REGISTRY.counter(
+    "tdn_train_steps_total", "optimizer steps completed",
+    labels=("trainer",),
+)
+_CHECKPOINT_SAVES = REGISTRY.counter(
+    "tdn_checkpoint_saves_total", "checkpoint save events",
+    labels=("trainer",),
+)
 
 
 @dataclasses.dataclass
@@ -168,6 +188,11 @@ def run_training_loop(
                 "loss": float(jnp.stack(losses).mean()),
                 "seconds": time.monotonic() - t0,
             }
+            # Epoch boundary: the loss float() above already synced, so
+            # these host-side updates time nothing and fetch nothing.
+            _EPOCH_SECONDS.observe(record["seconds"])
+            _TRAIN_LOSS.labels(trainer="classifier").set(record["loss"])
+            _TRAIN_STEPS.labels(trainer="classifier").inc(len(losses))
             if eval_fn is not None:
                 record["eval"] = eval_fn(params)
             history.append(record)
@@ -177,6 +202,7 @@ def run_training_loop(
                     {"params": params, "opt_state": opt_state},
                     metadata=record,
                 )
+                _CHECKPOINT_SAVES.labels(trainer="classifier").inc()
     except BaseException:
         # Enqueued async saves become durable even when the loop
         # raises — the crash-resume guarantee is the point. On this
