@@ -683,6 +683,164 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
     return out
 
 
+def _registry_counter_total(name: str) -> float:
+    """Sum of a registry counter family across its labeled children
+    (0 when the family does not exist yet)."""
+    from tpu_dist_nn.obs.registry import REGISTRY
+
+    m = REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return float(sum(child.value for _, child in m.samples()))
+
+
+def overlap_bench(jax, *, clients: int = 8, rpcs_per_client: int = 20,
+                  rows_per_rpc: int = 16, engine=None,
+                  warm_rows: int | None = None) -> dict:
+    """Serial-vs-overlapped batcher A/B through the full loopback wire
+    path (the ISSUE 2 acceptance measurement, and the CI smoke's
+    engine-injectable harness).
+
+    Serves the SAME engine twice — ``pipeline_depth=1`` (the strictly
+    serial legacy loop: stage, launch, fetch, fan out, repeat) vs the
+    default double-buffered pipeline — under the same concurrent
+    multi-row client load, and reports aggregate throughput for each
+    plus the structural evidence: ``overlap_ratio`` (> 0 means batches
+    really launched while a prior batch was materializing) and the
+    compile-cache miss delta during the timed windows (0 after warmup
+    = no live request ate an XLA compile).
+    """
+    import threading
+
+    from tpu_dist_nn.serving.server import GrpcClient, serve_engine
+
+    if engine is None:
+        from tpu_dist_nn.api.engine import Engine
+        from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
+
+        params = init_fcnn(jax.random.key(0), [64, 32, 10])
+        model = spec_from_params(params, ["relu", "softmax"])
+        engine = Engine.up(model)
+    dim = engine.model.input_dim
+    if warm_rows is None:
+        # Cover the WORST-CASE coalesce: every client's one outstanding
+        # RPC fused into a single batch (clients * rows_per_rpc rows,
+        # padding into that size's pow2 bucket — warm_buckets warms
+        # through the ceiling). An unwarmed top bucket would drop a
+        # ~0.7s compile into whichever timed arm first hits it.
+        warm_rows = clients * rows_per_rpc
+    rng = np.random.default_rng(0)
+    xs = [
+        rng.uniform(0.0, 1.0, (rows_per_rpc, dim)) for _ in range(clients)
+    ]
+
+    def measure(depth: int) -> dict:
+        server, port = serve_engine(
+            engine, 0, host="127.0.0.1", coalesce=True,
+            warm_rows=warm_rows, pipeline_depth=depth,
+        )
+        b = server.batcher
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def worker(i):
+            try:
+                c = GrpcClient(f"127.0.0.1:{port}")
+                for _ in range(rpcs_per_client):
+                    c.process(xs[i])
+                c.close()
+            except Exception as e:  # noqa: BLE001 — recorded, not hidden
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+
+        # One untimed volley so every bucket the mix hits is compiled
+        # before the window (the "zero misses during the timed window"
+        # criterion measures steady state, not first contact).
+        warm_threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(min(2, clients))
+        ]
+        for th in warm_threads:
+            th.start()
+        for th in warm_threads:
+            th.join()
+        req0, bat0, ovl0 = b.requests_total, b.batches_total, b.overlapped_total
+        miss0 = _registry_counter_total(
+            "tdn_engine_compile_cache_misses_total"
+        )
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(clients)
+        ]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t0
+        server.stop(0)
+        if errors:
+            raise RuntimeError(f"overlap bench workers failed: {errors[:3]}")
+        batches = b.batches_total - bat0
+        return {
+            "rps": round(clients * rpcs_per_client / wall, 1),
+            "rows_per_sec": round(
+                clients * rpcs_per_client * rows_per_rpc / wall, 1
+            ),
+            "requests": b.requests_total - req0,
+            "batches": batches,
+            "overlapped_batches": b.overlapped_total - ovl0,
+            "overlap_ratio": round(
+                (b.overlapped_total - ovl0) / max(batches, 1), 3
+            ),
+            "compile_misses_in_window": _registry_counter_total(
+                "tdn_engine_compile_cache_misses_total"
+            ) - miss0,
+        }
+
+    serial = measure(1)
+    overlapped = measure(2)
+    return {
+        "serial": serial,
+        "overlapped": overlapped,
+        "overlapped_vs_serial": round(
+            overlapped["rows_per_sec"] / serial["rows_per_sec"], 3
+        ),
+        "clients": clients,
+        "rpcs_per_client": rpcs_per_client,
+        "rows_per_rpc": rows_per_rpc,
+    }
+
+
+def overlap_main() -> int:
+    """``bench.py --overlap``: the serial-vs-double-buffered batcher
+    A/B as one JSON line (flagship model, loopback wire path)."""
+    jax, _jnp, backend, device_kind, _ = _bring_up()
+    from tpu_dist_nn.api.engine import Engine
+    from tpu_dist_nn.models.fcnn import init_fcnn, spec_from_params
+
+    params = init_fcnn(jax.random.key(0), [784, 128, 64, 10])
+    model = spec_from_params(params, ["relu", "relu", "softmax"])
+    engine = Engine.up(model)
+    ab = overlap_bench(
+        jax, clients=10, rpcs_per_client=30, rows_per_rpc=32, engine=engine,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serving batcher overlapped-vs-serial A/B "
+                          "(gRPC loopback, flagship FCNN)",
+                "value": ab["overlapped"]["rows_per_sec"],
+                "unit": "rows/sec",
+                "backend": backend,
+                "device_kind": device_kind or "host cpu",
+                **ab,
+            }
+        )
+    )
+    return 0
+
+
 def mfu_bench(jax, jnp, device_kind: str | None, on_accel: bool) -> dict:
     """Compute-bound single-chip training step: achieved FLOP/s and MFU.
 
@@ -915,7 +1073,11 @@ def main() -> int:
 
 if __name__ == "__main__":
     try:
-        sys.exit(serving_main() if "--serving" in sys.argv else main())
+        if "--serving" in sys.argv:
+            sys.exit(serving_main())
+        if "--overlap" in sys.argv:
+            sys.exit(overlap_main())
+        sys.exit(main())
     except BaseException as e:  # noqa: BLE001 — JSON error record, not a traceback
         if isinstance(e, SystemExit):
             raise

@@ -76,6 +76,10 @@ import pytest  # noqa: E402
 # tests/test_quick_tier.py asserts every module has an entry and every
 # entry resolves, so the list cannot rot silently.
 QUICK_TESTS = {
+    "test_batcher_pipeline": [
+        "test_batches_launch_while_prior_fetch_in_flight",
+        "test_warm_buckets_ladder_gauge_and_no_misses_after_warm",
+        "test_bench_overlap_smoke_overlapped_at_least_serial"],
     "test_checkpoint": ["test_async_manager_saves_and_restores",
                         "test_manager_latest_and_retention",
                         "test_resume_noop_when_complete"],

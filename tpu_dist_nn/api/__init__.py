@@ -1,1 +1,5 @@
-from tpu_dist_nn.api.engine import Engine, InferenceResult  # noqa: F401
+from tpu_dist_nn.api.engine import (  # noqa: F401
+    Engine,
+    InferenceResult,
+    PendingInference,
+)

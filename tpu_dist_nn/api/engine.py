@@ -93,6 +93,28 @@ _TRAIN_SECONDS = REGISTRY.histogram(
 _TRAIN_CALLS = REGISTRY.counter(
     "tdn_engine_train_calls_total", "Engine.train invocations",
 )
+# Warm state of the pow2 row-bucket ladder (warm_buckets): how many
+# bucket programs this process has already compiled and executed, so an
+# operator can tell "no live request will eat a compile" from a scrape.
+_WARM_BUCKETS = REGISTRY.gauge(
+    "tdn_engine_warm_buckets",
+    "precompiled pow2 row-bucket programs resident in the jit cache",
+)
+
+
+@dataclasses.dataclass
+class PendingInference:
+    """Handle from :meth:`Engine.infer_async`: a dispatched-but-not-
+    materialized result. ``value`` is whatever the placement's executor
+    returned (a device array on the async paths); ``materialize`` is
+    the path-correct host read (``np.asarray`` for addressable arrays,
+    the replicating collective for process-spanning ones). Pass to
+    :meth:`Engine.fetch` — the fetch is the host sync, so everything
+    between dispatch and fetch overlaps with device execution."""
+
+    value: object
+    materialize: object
+    t0: float
 
 
 @dataclasses.dataclass
@@ -183,9 +205,17 @@ class Engine:
                 self._params = jax.device_put(self._params, replicated(self.mesh))
         self._q = None  # int8 serving path, single-program placement
         self._q_pp = None  # int8 serving path, pipelined placement
-        # Batch shapes this engine has served — the compile-cache
+        # Batch shapes this engine has LAUNCHED — the compile-cache
         # hit/miss proxy (jit compiles one program per input shape).
+        # Keys are the device-launch shape recorded by _infer_impl
+        # (after any internal padding), not the caller's row count.
         self._seen_infer_shapes: set[tuple] = set()
+        # The numpy view of the engine dtype: the hot path casts input
+        # ONCE, straight to this (the float64 wire contract stops at
+        # the serving boundary).
+        self._np_dtype = np.dtype(dtype)
+        # Pow2 row buckets already compiled+executed by warm_buckets.
+        self._warm_buckets: set[int] = set()
         # Static activation names: passed explicitly on the hot path so
         # infer() never reads act ids back from the device.
         self._act_names = tuple(l.activation for l in model.layers)
@@ -217,6 +247,7 @@ class Engine:
         warmup: bool = True,
         quantize: str | None = None,
         virtual_stages: int = 1,
+        warm_rows: int = 0,
     ) -> "Engine":
         """Validate, place, compile; returns a ready engine.
 
@@ -224,6 +255,10 @@ class Engine:
         ``engine.setup_seconds`` (run_grpc_fcnn.py:321-322 parity).
         ``quantize="int8"`` serves the dense chain through the fused
         int8 Pallas path (f32 masters kept for train/export).
+
+        ``warm_rows > 0`` precompiles the whole pow2 row-bucket ladder
+        up to that many rows at bring-up (:meth:`warm_buckets`), so a
+        served engine never pays an XLA compile on a live request mix.
 
         ``virtual_stages=v > 1`` selects the INTERLEAVED (virtual-stage)
         inference placement: the distribution's ``V`` entries become
@@ -306,10 +341,12 @@ class Engine:
                      devices, quantize=quantize,
                      virtual_stages=virtual_stages)
         engine.requested_virtual_stages = requested_virtual
-        if warmup:
+        if warmup or warm_rows > 0:
             # Compilation is the readiness check (the analogue of the
-            # orchestrator's TCP poll, run_grpc_fcnn.py:157-172).
-            engine.infer(np.zeros((1, model.input_dim)))
+            # orchestrator's TCP poll, run_grpc_fcnn.py:157-172); with
+            # warm_rows the whole bucket ladder compiles here instead
+            # of on the first unlucky live request mix.
+            engine.warm_buckets(max(warm_rows, 1 if warmup else 0))
         engine.setup_seconds = time.monotonic() - t0
         log.info("engine up in %.2fs: %s", engine.setup_seconds, engine.placement())
         return engine
@@ -349,43 +386,124 @@ class Engine:
         :class:`~tpu_dist_nn.utils.errors.UnavailableError` after
         :meth:`down` (the reference's dead-channel UNAVAILABLE).
         """
+        return self.fetch(self.infer_async(x))
+
+    def infer_async(self, x) -> PendingInference:
+        """Validate, stage, and LAUNCH a batch without waiting for it.
+
+        Returns a :class:`PendingInference` whose device result is
+        still materializing (JAX async dispatch); :meth:`fetch` is the
+        host sync. The serving batcher's dispatch stage launches batch
+        N+1 through this while batch N's fetch is in flight — the
+        double-buffered fast path. Validation errors raise HERE (at
+        dispatch), so a bad request fails before it occupies the
+        pipeline.
+        """
         t0 = time.monotonic()
         try:
-            out = self._infer_impl(x)
+            out, materialize, shape = self._infer_impl(x)
         except Exception:
             _INFER_ERRORS.inc()
             raise
-        _INFER_SECONDS.observe(time.monotonic() - t0)
-        _INFER_ROWS.inc(len(out))
-        # Shape-set bookkeeping AFTER the call: jit compiles per input
-        # shape, so a first-seen shape is the honest proxy for an XLA
-        # compile on this engine's programs.
-        shape = tuple(np.shape(out)[:1]) + tuple(np.shape(x)[-1:])
+        # Compile-cache proxy keyed on the DEVICE-LAUNCH shape returned
+        # by _infer_impl (after internal padding — e.g. the data-sharded
+        # path pads rows to the shard count): jit compiles one program
+        # per launch shape, so keying on the caller's unpadded row count
+        # would overcount misses. Returned, not read off instance state:
+        # concurrent infer callers (batcher dispatch + a health probe)
+        # must not read each other's shapes.
         seen = self._seen_infer_shapes
         if shape in seen:
             _COMPILE_HITS.inc()
         else:
             seen.add(shape)
             _COMPILE_MISSES.inc()
+        return PendingInference(out, materialize, t0)
+
+    def fetch(self, pending: PendingInference) -> np.ndarray:
+        """Materialize an :meth:`infer_async` handle as host numpy —
+        the ONE host sync of an inference. Wall time from dispatch to
+        materialized result lands in ``tdn_engine_infer_seconds``."""
+        try:
+            out = pending.materialize(pending.value)
+        except Exception:
+            _INFER_ERRORS.inc()
+            raise
+        _INFER_SECONDS.observe(time.monotonic() - pending.t0)
+        _INFER_ROWS.inc(len(out))
         return out
 
-    def _infer_impl(self, x) -> np.ndarray:
+    def warm_buckets(self, max_rows: int) -> list[int]:
+        """Precompile the pow2 row-bucket ladder (1, 2, 4, … up to the
+        pow2 CEILING of ``max_rows`` — a coalesced batch of
+        ``max_rows`` rows pads into that bucket, so stopping at the
+        last pow2 below it would leave exactly the top bucket cold)
+        so no live request ever eats an XLA compile.
+
+        Each bucket runs one real zeros-batch inference rather than an
+        AOT ``lower().compile()``: executing through the jit call site
+        is the only warm that seeds the dispatch cache the live path
+        actually hits (an AOT Compiled object is a separate executable),
+        and it additionally lands the program in the persistent compile
+        cache when ``JAX_COMPILATION_CACHE_DIR`` is set — which is what
+        makes a standalone ``tdn warmup`` run pay off across processes.
+
+        Already-warm buckets are skipped (idempotent); the warm-state
+        count is published as the ``tdn_engine_warm_buckets`` gauge.
+        Returns the bucket sizes newly warmed by THIS call.
+        """
+        warmed: list[int] = []
+        if max_rows < 1:
+            return warmed
+        dim = self.model.input_dim
+        top = 1 << (max_rows - 1).bit_length() if max_rows > 1 else 1
+        n = 1
+        while n <= top:
+            if n not in self._warm_buckets:
+                self.infer(np.zeros((n, dim), self._np_dtype))
+                self._warm_buckets.add(n)
+                warmed.append(n)
+                # Per-bucket, not once at the end: a scrape DURING a
+                # long warm (tdn warmup --metrics-port) sees progress.
+                # This method is the gauge's ONLY writer — one-engine-
+                # per-process semantics; a second engine's warm
+                # overwrites with its own count.
+                _WARM_BUCKETS.set(len(self._warm_buckets))
+            n *= 2
+        return warmed
+
+    @property
+    def warm_bucket_count(self) -> int:
+        """Attribute-only warm state (the obs runtime sampler reads
+        this — no device work, mirroring ``is_ready``)."""
+        return len(self._warm_buckets)
+
+    def _infer_impl(self, x):
         from tpu_dist_nn.utils.errors import UnavailableError, check_input_dim
 
         if self._pp is None and self._params is None and self._hp is None:
             raise UnavailableError(
                 "engine is down; relaunch with Engine.up from the model JSON"
             )
-        x = np.asarray(x, dtype=np.float64)
+        x = np.asarray(x)
         in_dim = self.model.input_dim
         if x.ndim >= 2:
             check_input_dim(in_dim, int(x.shape[-1]), stage=0)
         elif x.size != in_dim:
             check_input_dim(in_dim, int(x.size), stage=0)
         x = x.reshape(-1, in_dim)
+        # ONE cast, straight to the engine dtype (no float64 staging
+        # array): the float64 wire contract lives at the serving
+        # boundary only, and the dtype-aware decoder usually lands
+        # rows here already converted — this is then a no-op view.
+        if x.dtype != self._np_dtype:
+            x = x.astype(self._np_dtype)
+        # The shape the device actually launches (the compile-cache
+        # proxy key); branches that pad internally override it.
+        launch = (len(x), in_dim)
         if self._hp is not None:
             mb = max(1, len(x) // self.num_microbatches)
-            return self._hp.forward(x, microbatch_size=mb)
+            return self._hp.forward(x, microbatch_size=mb), np.asarray, launch
         if self.pipelined:
             from tpu_dist_nn.parallel.multihost import to_host_numpy
 
@@ -399,7 +517,7 @@ class Engine:
                     num_virtual=self.virtual_stages,
                     num_microbatches=self.num_microbatches,
                 )
-                return to_host_numpy(out)
+                return out, to_host_numpy, launch
             if self._q_pp is not None:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_quantized,
@@ -409,7 +527,7 @@ class Engine:
                     self.mesh, self._q_pp, self._pp.meta, x,
                     num_microbatches=self.num_microbatches,
                 )
-                return to_host_numpy(out)
+                return out, to_host_numpy, launch
             if self.virtual_stages > 1:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_interleaved,
@@ -420,19 +538,21 @@ class Engine:
                     num_virtual=self.virtual_stages,
                     num_microbatches=self.num_microbatches,
                 )
-                return to_host_numpy(out)
+                return out, to_host_numpy, launch
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
-            return to_host_numpy(out)
+            return out, to_host_numpy, launch
         if self._q is not None and not self.data_sharded:
             from tpu_dist_nn.kernels.quantized import fcnn_quantized_forward
 
-            return np.asarray(
+            return (
                 fcnn_quantized_forward(
                     self._q, jnp.asarray(x, jnp.float32),
                     activations=self._act_names,
-                )
+                ),
+                np.asarray,
+                launch,
             )
         if self._q is not None:
             # Data-sharded int8: the jnp quantized chain under jit on the
@@ -450,7 +570,9 @@ class Engine:
 
             n = len(x)
             shards = self.mesh_spec.data
-            xb = np.pad(x, ((0, -n % shards), (0, 0))).astype(self.dtype)
+            xb = np.pad(x, ((0, -n % shards), (0, 0)))
+            # jit sees the PADDED batch: that is the compiled shape.
+            launch = (len(xb), in_dim)
             if jax.process_count() > 1:
                 # Every host computed the same padded batch; each device
                 # receives exactly the chunk the sharding assigns it.
@@ -465,10 +587,10 @@ class Engine:
                 xb = global_from_replicated(self.mesh, P(AXIS_DATA), xb)
             else:
                 xb = jax.device_put(xb, batch_sharding(self.mesh))
-            out = apply(self._params, xb)[:n]
-            return to_host_numpy(out)
-        out = apply(self._params, jnp.asarray(x, self.dtype))
-        return np.asarray(out)
+            # The [:n] slice is a lazy device op: the unpadded view
+            # materializes at fetch, the launch stays padded.
+            return apply(self._params, xb)[:n], to_host_numpy, launch
+        return apply(self._params, jnp.asarray(x, self.dtype)), np.asarray, launch
 
     def _quantized_apply(self):
         """Cached jitted (params, xb) -> logits closure over the int8

@@ -1544,6 +1544,35 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_warmup(args) -> int:
+    """Precompile the serving bucket ladder AHEAD of traffic: bring the
+    engine up, run the pow2 row buckets up to --rows, report what got
+    warm. With a persistent XLA compile cache configured
+    (JAX_COMPILATION_CACHE_DIR), the compiles land on disk and a later
+    `tdn up --grpc-port` on the same model skips them entirely;
+    without one, this is the in-process warm `--serve-warm-rows`
+    performs at serve time (reported so the operator knows which)."""
+    import jax
+
+    metrics_server = _start_metrics_server(args)
+    t0 = time.monotonic()
+    engine = _engine_from_args(args)
+    warmed = engine.warm_buckets(args.rows)
+    cache_dir = jax.config.jax_compilation_cache_dir
+    print(json.dumps({
+        "warmed_buckets": warmed,
+        "warm_bucket_count": engine.warm_bucket_count,
+        "max_rows": args.rows,
+        "seconds": round(time.monotonic() - t0, 3),
+        "persistent_cache_dir": cache_dir,
+        "persists_across_processes": bool(cache_dir),
+        "placement": engine.placement(),
+    }))
+    engine.down()
+    _stop_metrics_server(metrics_server)
+    return 0
+
+
 def cmd_oracle(args) -> int:
     """Single-process float64 baseline (scripts/manual_nn.py:88-99)."""
     from tpu_dist_nn.core.schema import load_examples, load_model
@@ -2088,6 +2117,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", required=True)
     p.add_argument("--inputs", required=True)
     p.set_defaults(fn=cmd_oracle)
+
+    p = sub.add_parser("warmup",
+                       help="precompile the serving pow2 bucket ladder "
+                            "(no port opened; pairs with "
+                            "JAX_COMPILATION_CACHE_DIR to pre-warm "
+                            "across processes)")
+    _add_up_args(p)
+    _add_multihost_args(p)
+    p.add_argument("--rows", type=int, default=64,
+                   help="warm every power-of-two bucket up to this many "
+                        "rows (default 64, matching --serve-warm-rows)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="expose /metrics during the warm (0 = ephemeral, "
+                        "printed as a JSON line) — the "
+                        "tdn_engine_warm_buckets gauge tracks progress")
+    p.set_defaults(fn=cmd_warmup)
 
     p = sub.add_parser("metrics",
                        help="one-shot scrape of a --metrics-port "

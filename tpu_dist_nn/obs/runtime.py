@@ -62,6 +62,13 @@ class RuntimeSampler:
             "requests served per device launch (cumulative)",
             labels=("method",),
         )
+        self._g_overlap = reg.gauge(
+            "tdn_batcher_overlap_ratio",
+            "fraction of launches issued while a prior batch was still "
+            "materializing (cumulative; > 0 means the double-buffered "
+            "pipeline is actually overlapping)",
+            labels=("method",),
+        )
         self._g_rss = reg.gauge(
             "tdn_host_rss_bytes", "resident set size of this process",
         )
@@ -122,7 +129,14 @@ class RuntimeSampler:
             self._g_ratio.labels(method=method).set(
                 b.requests_total / launches
             )
+            self._g_overlap.labels(method=method).set(
+                getattr(b, "overlapped_total", 0) / launches
+            )
         if self._engines:
+            # (tdn_engine_warm_buckets is NOT sampled here: the engine's
+            # warm_buckets method is its single writer — a second writer
+            # with aggregate semantics would flap the series between
+            # per-engine and summed values.)
             # Engine.is_ready is attribute-only (health()'s probe would
             # launch a device program per sample). All engines must be
             # up: a per-engine overwrite would let the last-registered

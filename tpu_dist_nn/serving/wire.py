@@ -124,10 +124,18 @@ def _decode_row(buf: memoryview) -> np.ndarray:
     return np.concatenate(values)
 
 
-def decode_matrix(data: bytes) -> np.ndarray:
-    """``Matrix`` bytes -> ``(N, D) float64`` (ragged rows rejected —
-    the reference's per-layer dim check, grpc_node.py:83-84, applies to
-    whole matrices)."""
+def decode_matrix(data: bytes, dtype=np.float64) -> np.ndarray:
+    """``Matrix`` bytes -> ``(N, D) dtype`` array (ragged rows rejected
+    — the reference's per-layer dim check, grpc_node.py:83-84, applies
+    to whole matrices).
+
+    ``dtype`` lands rows DIRECTLY in the consumer's dtype: the serving
+    path decodes into the engine's compute dtype, so the only float64
+    in the process is the per-row zero-copy ``frombuffer`` view of the
+    wire bytes — the (N, D) float64 staging matrix the old
+    decode-then-cast pipeline materialized never exists. The wire
+    format itself stays the reference's packed float64 contract.
+    """
     buf = memoryview(data)
     rows: list[np.ndarray] = []
     pos = 0
@@ -142,11 +150,14 @@ def decode_matrix(data: bytes) -> np.ndarray:
         else:
             pos = _skip_field(buf, pos, wt)
     if not rows:
-        return np.empty((0, 0), dtype=np.float64)
+        return np.empty((0, 0), dtype=dtype)
     width = {r.shape[0] for r in rows}
     if len(width) != 1:
         raise ValueError(f"ragged matrix rows: widths {sorted(width)}")
-    return np.stack(rows)
+    out = np.empty((len(rows), width.pop()), dtype=dtype)
+    for i, r in enumerate(rows):
+        out[i] = r  # casts the f8 row view on assignment, no f64 matrix
+    return out
 
 
 #: The fully-qualified method the reference's stubs call — the proto
