@@ -153,6 +153,9 @@ QUICK_TESTS = {
     "test_tensor_parallel": ["test_forward_matches_single_chip[spec1]",
                              "test_shard_roundtrip"],
     "test_tpu_hardware": ["*"],
+    "test_trace": ["test_chrome_trace_export_schema",
+                   "test_loopback_round_trip_is_one_trace_tree",
+                   "test_sampling_rate_edge_cases"],
     "test_train": ["test_single_chip_training_learns",
                    "test_train_lm_does_not_invalidate_caller_params"],
     "test_transformer": ["test_loss_descends_on_copy_task",

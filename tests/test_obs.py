@@ -515,7 +515,7 @@ def test_cli_metrics_connection_error_is_user_error(capsys):
 
     rc = cli_main(["metrics", "--target", "127.0.0.1:1", "--timeout", "0.5"])
     assert rc == 2
-    assert "could not scrape" in capsys.readouterr().err
+    assert "could not fetch" in capsys.readouterr().err
 
 
 def _free_port():

@@ -40,6 +40,44 @@ def test_latency_stats_empty_and_timer():
     assert len(stats) == 1 and stats.samples_s[0] >= 0
 
 
+def test_latency_stats_window_bounds_growth():
+    # The long-lived-serving satellite (ISSUE 3): a window cap turns
+    # the sample list into a sliding window — unbounded record()
+    # traffic retains at most `window` samples, percentiles cover the
+    # most recent ones, and summary() says so.
+    stats = LatencyStats("serve", window=4)
+    for s in [9.0, 9.0, 9.0, 0.1, 0.2, 0.3, 0.4]:
+        stats.record(s)
+    assert len(stats) == 4
+    assert list(stats.samples_s) == [0.1, 0.2, 0.3, 0.4]
+    out = stats.summary()
+    assert out["window"] == 4 and out["count"] == 4
+    np.testing.assert_allclose(out["p50_s"], 0.25)  # the 9s are gone
+    np.testing.assert_allclose(out["total_s"], 1.0)
+    np.testing.assert_allclose(stats.percentile(50), 0.25)
+    # with-timer and empty-summary behavior carry the cap too.
+    empty = LatencyStats("e", window=2)
+    assert empty.summary() == {"name": "e", "count": 0, "window": 2}
+    with empty.time():
+        pass
+    assert len(empty) == 1
+    # Seed samples beyond the window truncate to the newest, like any
+    # other overflow.
+    seeded = LatencyStats("s", [1.0, 2.0, 3.0], 2)
+    assert list(seeded.samples_s) == [2.0, 3.0]
+    with pytest.raises(ValueError):
+        LatencyStats("bad", window=0)
+
+
+def test_latency_stats_uncapped_behavior_unchanged():
+    stats = LatencyStats("default")
+    for s in [0.1, 0.2]:
+        stats.record(s)
+    out = stats.summary()
+    assert "window" not in out and out["count"] == 2
+    assert isinstance(stats.samples_s, list)
+
+
 def test_timed_span():
     with timed() as t:
         assert t["seconds"] is None

@@ -55,6 +55,7 @@ from tpu_dist_nn.parallel.pipeline import (
     pipeline_forward,
     pipeline_spec_summary,
 )
+from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.registry import REGISTRY
 from tpu_dist_nn.train.metrics import classification_metrics
 from tpu_dist_nn.train.trainer import TrainConfig, train_fcnn
@@ -405,6 +406,15 @@ class Engine:
         except Exception:
             _INFER_ERRORS.inc()
             raise
+        # Trace annotations attach to whatever request span is active
+        # on this thread (the batcher's launch span, a handler span, or
+        # nothing) — the active() guard keeps the f-strings off the
+        # untraced path entirely.
+        if _trace.active():
+            _trace.annotate(
+                f"engine.infer_async launch_shape={shape} "
+                f"dispatch_s={time.monotonic() - t0:.6f}"
+            )
         # Compile-cache proxy keyed on the DEVICE-LAUNCH shape returned
         # by _infer_impl (after internal padding — e.g. the data-sharded
         # path pads rows to the shard count): jit compiles one program
@@ -418,6 +428,11 @@ class Engine:
         else:
             seen.add(shape)
             _COMPILE_MISSES.inc()
+            if _trace.active():
+                # The event a slow-request trace most wants named: this
+                # launch shape was new, so the request likely paid an
+                # XLA compile (hundreds of ms) nothing else explains.
+                _trace.annotate(f"engine.compile_cache_miss shape={shape}")
         return PendingInference(out, materialize, t0)
 
     def fetch(self, pending: PendingInference) -> np.ndarray:
@@ -431,6 +446,11 @@ class Engine:
             raise
         _INFER_SECONDS.observe(time.monotonic() - pending.t0)
         _INFER_ROWS.inc(len(out))
+        if _trace.active():
+            _trace.annotate(
+                f"engine.fetch rows={len(out)} "
+                f"since_dispatch_s={time.monotonic() - pending.t0:.6f}"
+            )
         return out
 
     def warm_buckets(self, max_rows: int) -> list[int]:

@@ -12,6 +12,9 @@
                  forward with per-example latency printout
 ``tdn metrics``— one-shot scrape/pretty-print of a ``--metrics-port``
                  /metrics endpoint (obs/exposition.py)
+``tdn trace``  — pull a ``--metrics-port`` endpoint's recorded request
+                 spans as a Chrome trace-event file (obs/trace.py);
+                 the output opens directly in Perfetto/chrome://tracing
 """
 
 from __future__ import annotations
@@ -214,6 +217,22 @@ def _drain_metrics_servers() -> None:
         _stop_metrics_server(server, sampler)
 
 
+def _apply_trace_sample_rate(args) -> None:
+    """Configure the process tracer's head-sampling rate from
+    ``--trace-sample-rate`` (fail-fast: an out-of-range rate is a user
+    error before any expensive bring-up). Unset leaves the tracer
+    default (1.0, or TDN_TRACE_SAMPLE_RATE)."""
+    rate = getattr(args, "trace_sample_rate", None)
+    if rate is None:
+        return
+    from tpu_dist_nn.obs import TRACER
+
+    try:
+        TRACER.configure(sample_rate=rate)
+    except ValueError as e:
+        raise ValueError(f"--trace-sample-rate: {e}") from e
+
+
 def _parse_distribution(text):
     if text is None:
         return None
@@ -272,6 +291,7 @@ def _serve_loop(engine, max_seconds: float | None = None, teardown=None) -> None
 
 
 def cmd_up(args) -> int:
+    _apply_trace_sample_rate(args)
     if args.grpc_port is not None and _jax_process_count() > 1:
         # Before engine bring-up: minutes of pod warmup for a flag
         # combination knowable up front.
@@ -312,12 +332,13 @@ def cmd_up(args) -> int:
         )
         print(json.dumps({"grpc_port": bound}), flush=True)
         if metrics_server is not None:
-            from tpu_dist_nn.obs import RuntimeSampler
+            from tpu_dist_nn.obs import RuntimeSampler, TRACER
 
             sampler = RuntimeSampler()
             if server.batcher is not None:
                 sampler.add_batcher(server.batcher, method="Process")
             sampler.add_engine(engine)
+            sampler.add_tracer(TRACER)
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
 
@@ -453,6 +474,7 @@ def _infer_over_grpc(args) -> int:
 
 
 def cmd_train(args) -> int:
+    _apply_trace_sample_rate(args)
     _validate_checkpoint_flags(args)
     _validate_metrics_out(args)
     from tpu_dist_nn.core.schema import load_model
@@ -640,6 +662,7 @@ def cmd_lm(args) -> int:
         train_lm,
     )
 
+    _apply_trace_sample_rate(args)
     moe = args.experts > 0
     # (MoE x --seq-parallel is rejected below with the other
     # seq-parallel compatibility checks, with or without --stages.)
@@ -1457,10 +1480,11 @@ def cmd_lm(args) -> int:
         }
         sampler = None
         if metrics_server is not None and server.batcher is not None:
-            from tpu_dist_nn.obs import RuntimeSampler
+            from tpu_dist_nn.obs import RuntimeSampler, TRACER
 
             sampler = RuntimeSampler()
             sampler.add_batcher(server.batcher, method="Generate")
+            sampler.add_tracer(TRACER)
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
         print(json.dumps(report), flush=True)
@@ -1479,6 +1503,30 @@ def cmd_lm(args) -> int:
     return 0
 
 
+def _endpoint_base(target: str) -> str:
+    """Normalize a --target (host:port or URL) to a base URL — ONE
+    copy shared by every verb that talks to a --metrics-port endpoint
+    (`tdn metrics`, `tdn trace`), so scheme/trailing-slash handling
+    cannot drift between them."""
+    if "://" not in target:
+        target = f"http://{target}"
+    return target.rstrip("/")
+
+
+def _endpoint_get(base: str, path: str, timeout: float) -> bytes:
+    """GET one endpoint route, mapping connection failures to the
+    CLI's user-error convention (ValueError -> clean rc 2)."""
+    import urllib.error
+    import urllib.request
+
+    url = base + path
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise ValueError(f"could not fetch {url}: {e}") from e
+
+
 def cmd_metrics(args) -> int:
     """One-shot scrape of a running --metrics-port endpoint: fetch
     /metrics, pretty-print the tdn_* families (or dump raw text) —
@@ -1487,17 +1535,8 @@ def cmd_metrics(args) -> int:
     import urllib.error
     import urllib.request
 
-    target = args.target
-    if "://" not in target:
-        target = f"http://{target}"
-    base = target.rstrip("/")
-    try:
-        with urllib.request.urlopen(
-            base + "/metrics", timeout=args.timeout
-        ) as resp:
-            text = resp.read().decode()
-    except (urllib.error.URLError, OSError) as e:
-        raise ValueError(f"could not scrape {base}/metrics: {e}") from e
+    base = _endpoint_base(args.target)
+    text = _endpoint_get(base, "/metrics", args.timeout).decode()
     if args.raw:
         print(text, end="")
         return 0
@@ -1541,6 +1580,45 @@ def cmd_metrics(args) -> int:
         print(f"healthz [{e.code}]: {e.read().decode().strip()}")
     except (urllib.error.URLError, OSError) as e:
         print(f"healthz: unavailable ({e})")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Pull a running endpoint's recorded request spans as a Chrome
+    trace-event file: ``tdn trace --target host:metrics-port -o
+    trace.json`` then open the file in Perfetto (ui.perfetto.dev) or
+    ``chrome://tracing`` — where a ``jax.profiler`` capture of the same
+    window can be overlaid for the request-to-device view."""
+    base = _endpoint_base(args.target)
+    path = "/trace"
+    if args.limit is not None:
+        path += f"?limit={args.limit}"
+    body = _endpoint_get(base, path, args.timeout)
+    try:
+        doc = json.loads(body)
+        events = doc["traceEvents"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise ValueError(
+            f"{base}{path} did not return a Chrome trace-event "
+            f"document: {e}"
+        ) from e
+    with open(args.out, "wb") as f:
+        f.write(body)
+    spans = [e for e in events if e.get("ph") == "X"]
+    traces = {e["args"]["trace_id"] for e in spans if "trace_id" in e.get("args", {})}
+    slowest = sorted(spans, key=lambda e: e.get("dur", 0), reverse=True)[:3]
+    print(json.dumps({
+        "out": args.out,
+        "events": len(events),
+        "spans": len(spans),
+        "traces": len(traces),
+        "slowest": [
+            {"name": e["name"], "dur_ms": round(e.get("dur", 0) / 1000, 3),
+             "trace_id": e.get("args", {}).get("trace_id")}
+            for e in slowest
+        ],
+        "open_with": "https://ui.perfetto.dev or chrome://tracing",
+    }))
     return 0
 
 
@@ -1841,9 +1919,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "until interrupted; bounds --serve/--grpc-port "
                         "runs for drivers and tests)")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
-                   help="also expose /metrics (Prometheus text) and "
-                        "/healthz (Engine.health as JSON) on this port "
+                   help="also expose /metrics (Prometheus text), "
+                        "/healthz (Engine.health as JSON), and /trace "
+                        "(Chrome trace-event spans) on this port "
                         "(0 = ephemeral, printed as a JSON line)")
+    p.add_argument("--trace-sample-rate", type=float, default=None,
+                   metavar="RATE",
+                   help="head-sampling rate for request-scoped tracing "
+                        "in [0, 1]: 1 traces every request (default), "
+                        "0 disables recording entirely (env: "
+                        "TDN_TRACE_SAMPLE_RATE)")
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
@@ -1942,6 +2027,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expose /metrics + /healthz for the duration of "
                         "the training run (0 = ephemeral, printed as a "
                         "JSON line)")
+    p.add_argument("--trace-sample-rate", type=float, default=None,
+                   metavar="RATE",
+                   help="head-sampling rate for the run trace "
+                        "(epoch spans on /trace) in [0, 1]")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("lm", help="train + eval the Tiny-Transformer LM")
@@ -2095,6 +2184,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "training counters during the loop, serving "
                         "counters under --serve-generate (0 = "
                         "ephemeral, printed as a JSON line)")
+    p.add_argument("--trace-sample-rate", type=float, default=None,
+                   metavar="RATE",
+                   help="head-sampling rate for request-scoped tracing "
+                        "in [0, 1] (log-interval spans during the "
+                        "loop, per-request spans under "
+                        "--serve-generate)")
     p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("doctor",
@@ -2144,6 +2239,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("trace",
+                       help="pull recorded request spans from a "
+                            "--metrics-port endpoint as a Chrome "
+                            "trace-event file (Perfetto-loadable)")
+    p.add_argument("--target", required=True,
+                   help="host:port of a running --metrics-port endpoint")
+    p.add_argument("-o", "--out", default="trace.json",
+                   help="output path (default trace.json); open in "
+                        "https://ui.perfetto.dev or chrome://tracing")
+    p.add_argument("--limit", type=int, default=None,
+                   help="at most N most-recent ring-buffer spans "
+                        "(slowest-trace exemplars always included)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="HTTP timeout in seconds (default 5)")
+    p.set_defaults(fn=cmd_trace)
 
     return parser
 

@@ -17,6 +17,11 @@ metrics layer the serving/training hot paths publish into:
   - :mod:`tpu_dist_nn.obs.runtime` — a background sampler publishing
     queue depth, in-flight rows, coalesce ratio, and host/device
     memory gauges.
+  - :mod:`tpu_dist_nn.obs.trace` — request-scoped distributed tracing
+    (Dapper-style): a span recorder behind one process-wide
+    :data:`~tpu_dist_nn.obs.trace.TRACER`, ``x-tdn-trace`` wire
+    propagation across the gRPC hop, and Chrome trace-event export
+    served from ``GET /trace`` (``tdn trace`` pulls and saves it).
 
 Every metric this framework publishes is prefixed ``tdn_``; the
 catalog lives in ``docs/OBSERVABILITY.md``. All updates are plain
@@ -37,6 +42,12 @@ from tpu_dist_nn.obs.exposition import (  # noqa: F401
     start_http_server,
 )
 from tpu_dist_nn.obs.runtime import RuntimeSampler  # noqa: F401
+from tpu_dist_nn.obs.trace import (  # noqa: F401
+    SpanContext,
+    TRACE_HEADER,
+    TRACER,
+    Tracer,
+)
 
 __all__ = [
     "REGISTRY",
@@ -47,4 +58,8 @@ __all__ = [
     "render",
     "start_http_server",
     "RuntimeSampler",
+    "SpanContext",
+    "TRACE_HEADER",
+    "TRACER",
+    "Tracer",
 ]

@@ -124,26 +124,36 @@ def parse_prometheus_text(text: str) -> dict:
 
 
 class MetricsServer:
-    """The /metrics + /healthz endpoint on a daemon thread.
+    """The /metrics + /healthz + /trace endpoint on a daemon thread.
 
     ``health_fn`` is polled per /healthz request (``Engine.health`` in
     the serving wiring); omit it for processes with no engine — the
     endpoint then reports ``{"ready": true}`` for liveness.
+
+    ``GET /trace?limit=N`` exports the process tracer's completed spans
+    (plus its slowest-trace exemplars) as Chrome trace-event JSON —
+    save the body and open it in Perfetto / ``chrome://tracing``, or
+    let ``tdn trace`` do both. ``tracer`` overrides the process-wide
+    :data:`tpu_dist_nn.obs.trace.TRACER` (tests).
     """
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
-                 registry: Registry | None = None, health_fn=None):
+                 registry: Registry | None = None, health_fn=None,
+                 tracer=None):
         reg = registry if registry is not None else REGISTRY
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = render(reg).encode()
                     self._reply(200, CONTENT_TYPE, body)
                 elif path == "/healthz":
                     status, body = outer._health_body()
+                    self._reply(status, "application/json", body)
+                elif path == "/trace":
+                    status, body = outer._trace_body(query)
                     self._reply(status, "application/json", body)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
@@ -159,6 +169,7 @@ class MetricsServer:
                 log.debug("metrics http: " + fmt, *args)
 
         self._health_fn = health_fn
+        self._tracer = tracer
         self._closed = False
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -181,6 +192,22 @@ class MetricsServer:
             ).encode() + b"\n"
         status = 200 if health.get("ready") else 503
         return status, json.dumps(health).encode() + b"\n"
+
+    def _trace_body(self, query: str):
+        tracer = self._tracer
+        if tracer is None:
+            from tpu_dist_nn.obs.trace import TRACER
+
+            tracer = TRACER
+        limit = None
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "limit" and v:
+                try:
+                    limit = int(v)
+                except ValueError:
+                    return 400, b'{"error": "limit must be an integer"}\n'
+        return 200, tracer.render_json(limit).encode() + b"\n"
 
     def close(self) -> None:
         """Idempotent — a second close is a no-op, not a hang (stdlib

@@ -81,6 +81,23 @@ class RuntimeSampler:
             "tdn_engine_ready",
             "1 when every registered engine would report ready",
         )
+        # The tracer observing itself: buffer occupancy plus an
+        # eviction counter, so "why is my slow request's trace gone"
+        # has a scrapeable answer (dropped > 0: raise the buffer or
+        # lower the sample rate).
+        self._g_trace_buf = reg.gauge(
+            "tdn_trace_buffer_spans",
+            "completed spans resident in the trace ring buffer",
+        )
+        self._c_trace_dropped = reg.counter(
+            "tdn_trace_spans_dropped_total",
+            "spans evicted from the trace ring buffer before export",
+        )
+        self._tracers: list = []
+        # Last dropped_total seen per tracer (by position): counters
+        # tick by DELTA at sample time, so the drop path itself stays a
+        # plain int increment with no registry work.
+        self._trace_dropped_seen: list[float] = []
 
     # ------------------------------------------------------------ wiring
 
@@ -89,6 +106,10 @@ class RuntimeSampler:
 
     def add_engine(self, engine) -> None:
         self._engines.append(engine)
+
+    def add_tracer(self, tracer) -> None:
+        self._tracers.append(tracer)
+        self._trace_dropped_seen.append(float(tracer.dropped_total))
 
     # ------------------------------------------------------------ loop
 
@@ -145,6 +166,16 @@ class RuntimeSampler:
                 bool(getattr(e, "is_ready", False)) for e in self._engines
             )
             self._g_ready.set(1.0 if ready else 0.0)
+        if self._tracers:
+            self._g_trace_buf.set(
+                sum(t.buffer_len() for t in self._tracers)
+            )
+            for i, t in enumerate(self._tracers):
+                now = float(t.dropped_total)
+                delta = now - self._trace_dropped_seen[i]
+                if delta > 0:
+                    self._c_trace_dropped.inc(delta)
+                    self._trace_dropped_seen[i] = now
         rss = _read_rss_bytes()
         if rss is not None:
             self._g_rss.set(rss)

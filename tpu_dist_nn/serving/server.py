@@ -38,6 +38,7 @@ from concurrent import futures
 import grpc
 import numpy as np
 
+from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
 from tpu_dist_nn.serving.wire import (
     GENERATE_METHOD,
@@ -185,19 +186,30 @@ class _Batcher:
         self._dispatch_thread.start()
 
     def submit(self, x: np.ndarray,
-               timeout: float | None = None) -> np.ndarray:
+               timeout: float | None = None,
+               ctx=None) -> np.ndarray:
         """Block until this request's rows are served.
 
         ``timeout`` is the CALLER's remaining budget (the RPC deadline);
         the effective wait is ``min(timeout, submit_timeout)`` — there
         is no point holding a worker thread past the moment its client
         gave up.
+
+        ``ctx`` is the request's :class:`~tpu_dist_nn.obs.trace
+        .SpanContext`: when sampled, this entry's passage through the
+        pipeline is recorded as queue_wait / stage / launch / fetch
+        spans under it (each batch-level stage appears once per member
+        request, so every trace tree is complete on its own).
         """
         from tpu_dist_nn.utils.errors import UnavailableError
 
         item = {"x": x, "done": threading.Event(), "out": None, "err": None,
-                "abandoned": False}
+                "abandoned": False,
+                # Only a SAMPLED context is worth carrying: the per-item
+                # skip below is then one None check.
+                "ctx": ctx if ctx is not None and ctx.sampled else None}
         t_submit = time.monotonic()
+        item["t_submit"] = t_submit
         with self._cond:
             if self._closed:
                 raise UnavailableError("server is shutting down")
@@ -276,17 +288,42 @@ class _Batcher:
 
     def _drain_one(self, group, handle, key, buf, launched_rows) -> None:
         """Fetch one launched batch and fan results out per request."""
+        t_fetch = time.monotonic()
+        err = None
+        notes: list = []
+        traced = any(it["ctx"] is not None for it in group)
         try:
-            out = self._fetch_fn(handle)
+            if traced:
+                with _trace.annotation_sink() as notes:
+                    out = self._fetch_fn(handle)
+            else:
+                out = self._fetch_fn(handle)
             ofs = 0
             for it in group:
                 k = len(it["x"])
                 it["out"] = out[ofs:ofs + k]
                 ofs += k
         except Exception as e:  # noqa: BLE001 — per request
+            err = e
             for it in group:
                 it["err"] = e
         finally:
+            dur = time.monotonic() - t_fetch
+            if err is not None:
+                notes = notes + [
+                    (time.monotonic(), f"error: {type(err).__name__}: {err}")
+                ]
+            for it in group:
+                if it["ctx"] is not None:
+                    # The one host sync of the request's batch — the
+                    # span that separates "device was slow" from "queue
+                    # was long" in a trace.
+                    _trace.TRACER.record_span(
+                        "fetch", it["ctx"], t_fetch, dur,
+                        attrs={"rows": len(it["x"]),
+                               "batch_rows": launched_rows},
+                        annotations=notes,
+                    )
             with self._stats_lock:
                 self.inflight_batches -= 1
                 self.inflight_rows -= launched_rows
@@ -317,6 +354,16 @@ class _Batcher:
                 if not batch:
                     continue
                 self.rows_total += rows
+            # Queue wait ends the moment the dispatch stage owns the
+            # request (recorded outside the condition lock — tracing
+            # must not extend the producers' critical section).
+            t_pop = time.monotonic()
+            for it in batch:
+                if it["ctx"] is not None:
+                    _trace.TRACER.record_span(
+                        "queue_wait", it["ctx"], it["t_submit"],
+                        t_pop - it["t_submit"],
+                    )
             # Group by feature width: engines without a declared
             # input_dim cannot be pre-validated in the handler, and a
             # mixed-width concatenation would fail EVERY request in the
@@ -333,9 +380,34 @@ class _Batcher:
                 # here when pipeline_depth batches are outstanding).
                 self._slots.acquire()
                 key = buf = None
+                traced = [it for it in group if it["ctx"] is not None]
                 try:
+                    t_stage = time.monotonic()
                     xs, key, buf = self._stage(group)
-                    handle = self._dispatch_fn(xs)
+                    t_launch = time.monotonic()
+                    if traced:
+                        # Collect engine-side annotations (async
+                        # dispatch, compile-cache misses) emitted while
+                        # the launch runs; they attach to every member
+                        # request's launch span below.
+                        with _trace.annotation_sink() as notes:
+                            handle = self._dispatch_fn(xs)
+                    else:
+                        handle = self._dispatch_fn(xs)
+                    t_launched = time.monotonic()
+                    for it in traced:
+                        _trace.TRACER.record_span(
+                            "stage", it["ctx"], t_stage, t_launch - t_stage,
+                            attrs={"rows": len(it["x"]),
+                                   "batch_rows": len(xs),
+                                   "zero_copy": buf is None},
+                        )
+                        _trace.TRACER.record_span(
+                            "launch", it["ctx"], t_launch,
+                            t_launched - t_launch,
+                            attrs={"batch_rows": len(xs)},
+                            annotations=notes,
+                        )
                 except Exception as e:  # noqa: BLE001 — per request
                     # Dispatch-time failure (validation, trace error):
                     # fail the group here — it never reached the device,
@@ -383,6 +455,48 @@ class _Batcher:
         self._dispatch_thread.join(timeout=10)
         if self._drain_thread is not None:
             self._drain_thread.join(timeout=10)
+
+
+def _request_span(context, method: str):
+    """Begin the handler span for one RPC and derive its wait budget.
+
+    Honors an inbound ``x-tdn-trace`` header (the remote parent makes
+    this handler a child in the caller's trace — and inherits the
+    caller's sampling decision); without one this is a new locally
+    sampled root. Always names the trace back to the caller in
+    trailing metadata so a failed RPC tells the client which trace to
+    pull from ``/trace``. Returns ``(span, budget_seconds)`` where the
+    budget is ``min(grpc deadline remaining, x-tdn-timeout-ms hint)``
+    — whichever bounds exist.
+    """
+    md = {}
+    try:
+        for k, v in context.invocation_metadata() or ():
+            md[k] = v
+    except Exception:  # noqa: BLE001 — tracing must never fail an RPC
+        pass
+    parent = _trace.SpanContext.from_header(md.get(_trace.TRACE_HEADER))
+    span = _trace.TRACER.start(f"rpc.{method}", parent=parent)
+    try:
+        context.set_trailing_metadata(
+            ((_trace.TRACE_ID_HEADER, span.ctx.trace_id),)
+        )
+    except Exception:  # noqa: BLE001 — in-process fakes may not have it
+        pass
+    bounds = []
+    try:
+        rem = context.time_remaining()
+        if rem is not None:
+            bounds.append(rem)
+    except Exception:  # noqa: BLE001
+        pass
+    hint = md.get(_trace.TIMEOUT_HEADER)
+    if hint is not None:
+        try:
+            bounds.append(float(hint) / 1000.0)
+        except ValueError:
+            pass  # a garbled hint must not fail the RPC
+    return span, (min(bounds) if bounds else None)
 
 
 def _abort(context, method: str, code, message: str):
@@ -486,34 +600,46 @@ def _make_handler(engine, batcher: _Batcher | None):
 
     def process(request_bytes: bytes, context) -> bytes:
         _RPC_REQUESTS.labels(method="Process").inc()
+        span, budget = _request_span(context, "Process")
         try:
-            x = decode_matrix(request_bytes, dtype=wire_dtype)
-        except ValueError as e:
-            _abort(context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
-                   f"bad Matrix: {e}")
-        if (
-            batcher is not None
-            and expected_dim is not None
-            and x.shape[1] != expected_dim
-        ):
-            # The reference's dim-check path (grpc_node.py:149-153),
-            # message shape matching pipeline.pad_batch's error.
-            _abort(
-                context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
-                f"expected input of shape (N, {expected_dim}), got "
-                f"{tuple(x.shape)}",
-            )
-        try:
-            if batcher is not None:
-                # Pass the RPC's remaining deadline so the worker never
-                # waits for a client that already gave up.
-                out = batcher.submit(x, timeout=context.time_remaining())
-            else:
-                with lock:
-                    out = engine.infer(x)
-        except Exception as e:  # noqa: BLE001 — map to status codes
-            _abort_for_exception(context, e, "inference", "Process")
-        return encode_matrix(np.asarray(out, np.float64))
+            try:
+                with _trace.TRACER.span("decode", span.ctx):
+                    x = decode_matrix(request_bytes, dtype=wire_dtype)
+            except ValueError as e:
+                span.annotate(f"abort INVALID_ARGUMENT: bad Matrix: {e}")
+                _abort(context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
+                       f"bad Matrix: {e}")
+            span.set("rows", len(x))
+            if (
+                batcher is not None
+                and expected_dim is not None
+                and x.shape[1] != expected_dim
+            ):
+                # The reference's dim-check path (grpc_node.py:149-153),
+                # message shape matching pipeline.pad_batch's error.
+                span.annotate("abort INVALID_ARGUMENT: width mismatch")
+                _abort(
+                    context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
+                    f"expected input of shape (N, {expected_dim}), got "
+                    f"{tuple(x.shape)}",
+                )
+            try:
+                if batcher is not None:
+                    # Pass the RPC's remaining budget (deadline and/or
+                    # client hint) so the worker never waits for a
+                    # client that already gave up; the span context
+                    # rides the pending entry through the pipeline.
+                    out = batcher.submit(x, timeout=budget, ctx=span.ctx)
+                else:
+                    with lock, _trace.TRACER.activate(span):
+                        out = engine.infer(x)
+            except Exception as e:  # noqa: BLE001 — map to status codes
+                span.annotate(f"error: {type(e).__name__}: {e}")
+                _abort_for_exception(context, e, "inference", "Process")
+            with _trace.TRACER.span("encode", span.ctx):
+                return encode_matrix(np.asarray(out, np.float64))
+        finally:
+            span.end()
 
     rpc = grpc.unary_unary_rpc_method_handler(
         process,
@@ -596,31 +722,42 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
 
     def generate(request_bytes: bytes, context) -> bytes:
         _RPC_REQUESTS.labels(method="Generate").inc()
+        span, budget = _request_span(context, "Generate")
         try:
-            x = decode_matrix(request_bytes)
-        except ValueError as e:
-            _abort(context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
-                   f"bad Matrix: {e}")
-        if x.ndim != 2 or x.shape[1] != prompt_len:
-            # The decode program is compiled for ONE static prompt
-            # length per endpoint (static shapes under jit); clients
-            # pad/pack to it.
-            _abort(
-                context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
-                f"expected prompts of shape (N, {prompt_len}), got "
-                f"{tuple(x.shape)}",
-            )
-        ids = x.astype(np.int64)
-        if (ids != x).any() or (ids < 0).any() or (ids >= vocab_size).any():
-            _abort(
-                context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
-                f"prompts must be integer token ids in [0, {vocab_size})",
-            )
-        try:
-            out = run_submit(ids.astype(np.int32), context.time_remaining())
-        except Exception as e:  # noqa: BLE001 — map to status codes
-            _abort_for_exception(context, e, "generation", "Generate")
-        return encode_matrix(np.asarray(out, np.float64))
+            try:
+                with _trace.TRACER.span("decode", span.ctx):
+                    x = decode_matrix(request_bytes)
+            except ValueError as e:
+                span.annotate(f"abort INVALID_ARGUMENT: bad Matrix: {e}")
+                _abort(context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
+                       f"bad Matrix: {e}")
+            span.set("rows", len(x))
+            if x.ndim != 2 or x.shape[1] != prompt_len:
+                # The decode program is compiled for ONE static prompt
+                # length per endpoint (static shapes under jit); clients
+                # pad/pack to it.
+                span.annotate("abort INVALID_ARGUMENT: prompt shape")
+                _abort(
+                    context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
+                    f"expected prompts of shape (N, {prompt_len}), got "
+                    f"{tuple(x.shape)}",
+                )
+            ids = x.astype(np.int64)
+            if (ids != x).any() or (ids < 0).any() or (ids >= vocab_size).any():
+                span.annotate("abort INVALID_ARGUMENT: token id range")
+                _abort(
+                    context, "Generate", grpc.StatusCode.INVALID_ARGUMENT,
+                    f"prompts must be integer token ids in [0, {vocab_size})",
+                )
+            try:
+                out = run_submit(ids.astype(np.int32), budget, span.ctx)
+            except Exception as e:  # noqa: BLE001 — map to status codes
+                span.annotate(f"error: {type(e).__name__}: {e}")
+                _abort_for_exception(context, e, "generation", "Generate")
+            with _trace.TRACER.span("encode", span.ctx):
+                return encode_matrix(np.asarray(out, np.float64))
+        finally:
+            span.end()
 
     rpc = grpc.unary_unary_rpc_method_handler(
         generate, request_deserializer=bytes, response_serializer=bytes
@@ -746,9 +883,9 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
     )
     lock = threading.Lock()
 
-    def run_submit(ids: np.ndarray, time_remaining):
+    def run_submit(ids: np.ndarray, time_remaining, ctx=None):
         if batcher is not None:
-            return batcher.submit(ids, timeout=time_remaining)
+            return batcher.submit(ids, timeout=time_remaining, ctx=ctx)
         with lock:
             return run(ids)
 
@@ -803,18 +940,59 @@ class GrpcClient:
             response_deserializer=bytes,
         )
 
+    def _traced_call(self, call, method: str, payload: bytes) -> bytes:
+        """One RPC under a client span: the trace context and the
+        remaining-budget hint ride the metadata out; a failure comes
+        back NAMING the server-side trace (``e.server_trace_id``) so
+        the operator pulls exactly the right span tree from
+        ``/trace`` instead of guessing from timestamps."""
+        span = _trace.TRACER.start(f"client.{method}")
+        metadata = ((_trace.TRACE_HEADER, span.ctx.header()),)
+        if self.timeout is not None:
+            # Deadline-derived remaining-time hint: the whole client
+            # budget at send time (the grpc-timeout analogue, readable
+            # by the batcher even where a proxy rewrites deadlines).
+            metadata += (
+                (_trace.TIMEOUT_HEADER, str(int(self.timeout * 1000))),
+            )
+        try:
+            return call(payload, timeout=self.timeout, metadata=metadata)
+        except grpc.RpcError as e:
+            trace_id = span.ctx.trace_id  # the id we propagated
+            try:
+                for k, v in e.trailing_metadata() or ():
+                    if k == _trace.TRACE_ID_HEADER:
+                        trace_id = v  # the server's own root, if any
+            except Exception:  # noqa: BLE001 — best-effort enrichment
+                pass
+            e.server_trace_id = trace_id
+            code = None
+            try:
+                code = e.code()
+            except Exception:  # noqa: BLE001
+                pass
+            span.annotate(f"rpc error {code}: server trace {trace_id}")
+            log.warning("%s RPC to %s failed (%s) — server trace id %s; "
+                        "pull it with `tdn trace --target <metrics-port>`",
+                        method, self.target, code, trace_id)
+            raise
+        finally:
+            span.end()
+
     def process(self, x: np.ndarray) -> np.ndarray:
-        reply = self._call(encode_matrix(np.asarray(x, np.float64)),
-                           timeout=self.timeout)
+        reply = self._traced_call(
+            self._call, "Process",
+            encode_matrix(np.asarray(x, np.float64)),
+        )
         return decode_matrix(reply)
 
     def generate(self, prompts: np.ndarray) -> np.ndarray:
         """Token-id prompts ``(N, prompt_len)`` -> full sequences
         ``(N, prompt_len + max_new_tokens)`` (ids ride the Matrix wire
         as doubles — exact)."""
-        reply = self._call_generate(
+        reply = self._traced_call(
+            self._call_generate, "Generate",
             encode_matrix(np.asarray(prompts, np.float64)),
-            timeout=self.timeout,
         )
         return decode_matrix(reply).astype(np.int64)
 

@@ -741,6 +741,16 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
     # Throughput bookkeeping between log boundaries (the existing host
     # syncs): tokens/steps since the last logged entry.
     obs = {"tokens": 0, "steps": 0, "t_last": t0}
+    # One trace per run, log-interval spans hanging off the root —
+    # recorded retroactively AT the log boundary, where float(loss)
+    # already paid the host sync (the r4 honest-timing rule: tracing
+    # adds zero fetch barriers to the step loop).
+    from tpu_dist_nn.obs import trace as _trace
+
+    run_span = _trace.TRACER.start(
+        "train.lm", attrs={"steps": train_cfg.steps,
+                           "batch_size": train_cfg.batch_size},
+    )
 
     def _flush_group(group):
         """Run the buffered (index, batch) group as ONE device call."""
@@ -778,6 +788,13 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
             # the interval's throughput, then reset the window.
             now = time.monotonic()
             dt = max(now - obs["t_last"], 1e-9)
+            if run_span.sampled:
+                _trace.TRACER.record_span(
+                    "log_interval", run_span.ctx, obs["t_last"], dt,
+                    attrs={"step": history[-1]["step"],
+                           "steps": obs["steps"], "tokens": obs["tokens"],
+                           "loss": history[-1]["loss"]},
+                )
             _LM_LOSS.labels(trainer="lm").set(history[-1]["loss"])
             _LM_STEPS.labels(trainer="lm").inc(obs["steps"])
             _LM_TOKENS.labels(trainer="lm").inc(obs["tokens"])
@@ -824,6 +841,8 @@ def train_lm(params, cfg: TransformerConfig, batches: Iterable[np.ndarray],
         raise
     else:
         flush(checkpoints)
+    finally:
+        run_span.end()
     if pipelined:
         if schedule == "zb-v":
             from tpu_dist_nn.parallel.transformer_pipeline import (

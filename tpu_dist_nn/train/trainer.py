@@ -166,6 +166,14 @@ def run_training_loop(
         checkpoints, {"params": params, "opt_state": opt_state}
     )
     params, opt_state = state["params"], state["opt_state"]
+    # One trace per training run: epoch spans hang off this root, and
+    # are recorded retroactively at the epoch boundary — the float()
+    # host sync already happened, so tracing adds no fetch barriers.
+    from tpu_dist_nn.obs import trace as _trace
+
+    run_span = _trace.TRACER.start(
+        "train.classifier", attrs={"epochs": config.epochs}
+    )
     try:
         for epoch in range(start_epoch, config.epochs):
             t0 = time.monotonic()
@@ -190,6 +198,11 @@ def run_training_loop(
             }
             # Epoch boundary: the loss float() above already synced, so
             # these host-side updates time nothing and fetch nothing.
+            if run_span.sampled:
+                _trace.TRACER.record_span(
+                    "epoch", run_span.ctx, t0, record["seconds"],
+                    attrs={"epoch": epoch, "loss": record["loss"]},
+                )
             _EPOCH_SECONDS.observe(record["seconds"])
             _TRAIN_LOSS.labels(trainer="classifier").set(record["loss"])
             _TRAIN_STEPS.labels(trainer="classifier").inc(len(losses))
@@ -214,6 +227,8 @@ def run_training_loop(
         raise
     else:
         flush(checkpoints)
+    finally:
+        run_span.end()
     return params, history
 
 

@@ -12,6 +12,7 @@ reference could not have: XLA device-level traces via ``jax.profiler``
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import time
@@ -27,10 +28,33 @@ class LatencyStats:
 
     The structured replacement for the reference's printed per-batch
     seconds (``run_grpc_inference.py:195,211,213-215``).
+
+    ``window`` bounds the retained samples to the most recent N (a
+    sliding window): a long-lived serving process can record spans
+    forever without the sample list growing without limit, at the cost
+    of percentiles covering the window rather than all time.
+    ``summary()`` reports the cap so a windowed p99 is never mistaken
+    for an all-time one. ``None`` (the default) keeps everything — the
+    bounded-run behavior existing callers rely on.
     """
 
     name: str = "latency"
     samples_s: list[float] = dataclasses.field(default_factory=list)
+    window: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.window is not None:
+            if self.window < 1:
+                raise ValueError(
+                    f"{self.name}: window must be >= 1, got {self.window}"
+                )
+            # A deque with maxlen IS the sliding window: append is O(1)
+            # and eviction is automatic. Everything downstream only
+            # iterates (np.asarray, sum, len), so the container swap is
+            # invisible to summary()/percentile() callers.
+            self.samples_s = collections.deque(
+                self.samples_s, maxlen=self.window
+            )
 
     def record(self, seconds: float) -> None:
         self.samples_s.append(float(seconds))
@@ -56,12 +80,20 @@ class LatencyStats:
         return float(np.percentile(np.asarray(self.samples_s), q))
 
     def summary(self) -> dict:
-        """p50/p90/p99/mean/min/max/total over the recorded spans."""
+        """p50/p90/p99/mean/min/max/total over the recorded spans.
+
+        When a ``window`` cap is configured the summary includes it —
+        the numbers then cover (at most) the last ``window`` spans.
+        """
         if not self.samples_s:
-            return {"name": self.name, "count": 0}
+            base = {"name": self.name, "count": 0}
+            if self.window is not None:
+                base["window"] = self.window
+            return base
         arr = np.asarray(self.samples_s)
         return {
             "name": self.name,
+            **({"window": self.window} if self.window is not None else {}),
             "count": int(arr.size),
             "total_s": float(arr.sum()),
             "mean_s": float(arr.mean()),
