@@ -140,6 +140,10 @@ QUICK_TESTS = {
     "test_quantized": ["test_weight_quantization_roundtrip_error_bounded",
                        "test_quantized_forward_close_to_f32",
                        "test_quantize_honors_metadata_distribution"],
+    "test_resilience": [
+        "test_chaos_smoke_quick_tier_recovers_via_retries",
+        "test_breaker_cycle_closed_open_half_open_closed",
+        "test_shed_at_watermark_surfaces_resource_exhausted"],
     "test_real_data": ["test_real_digits_load_shapes_and_content",
                        "test_realtext_corpus_supports_valid_heldout_at_scale",
                        "test_cli_train_digits_end_to_end"],

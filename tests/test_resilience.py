@@ -1,0 +1,755 @@
+"""Resilient serving (ISSUE 4): retries with jittered backoff, circuit
+breaker, admission control, graceful drain — all proven under the
+deterministic fault-injection harness (tpu_dist_nn/testing/faults.py).
+
+Conventions: no injected sleep exceeds 0.05 s, every jitter draw is
+seeded, and fault schedules are call-indexed plans — a failure here
+replays bit-for-bit. Engine paths use the mesh-free-constructed REAL
+Engine (this container's jax lacks the mesh API Engine.up needs —
+test_batcher_pipeline's convention); wire behavior runs over a real
+loopback gRPC hop.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.obs.registry import REGISTRY
+from tpu_dist_nn.serving import (
+    CircuitBreaker,
+    GracefulDrain,
+    GrpcClient,
+    RetryPolicy,
+    serve_engine,
+)
+from tpu_dist_nn.testing import faults
+from tpu_dist_nn.utils.errors import (
+    FrameworkError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+from tests.test_batcher_pipeline import AsyncFakeEngine, _mesh_free_engine
+
+
+def _fast_policy(**kw):
+    """Default classification/attempts, test-speed delays, seeded
+    jitter (the suite's no-sleeps-over-0.05s rule)."""
+    kw.setdefault("base_delay", 0.002)
+    kw.setdefault("max_delay", 0.02)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def _counter(name, **labels):
+    m = REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return m.labels(**labels).value
+
+
+def _bg(fn):
+    """Run ``fn`` on a daemon thread, capturing result or exception."""
+    out = {}
+
+    def run():
+        try:
+            out["val"] = fn()
+        except Exception as e:  # noqa: BLE001 — the test inspects it
+            out["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, out
+
+
+# ------------------------------------------------------------ RetryPolicy
+
+
+def test_retry_policy_backoff_full_jitter_deterministic():
+    a = RetryPolicy(base_delay=0.05, max_delay=0.4, seed=7)
+    b = RetryPolicy(base_delay=0.05, max_delay=0.4, seed=7)
+    seq_a = [a.backoff(i) for i in range(1, 8)]
+    seq_b = [b.backoff(i) for i in range(1, 8)]
+    assert seq_a == seq_b, "seeded jitter must replay exactly"
+    for i, d in enumerate(seq_a, start=1):
+        cap = min(0.4, 0.05 * 2 ** (i - 1))
+        assert 0.0 <= d <= cap, (i, d, cap)
+    # A different seed draws a different schedule (it IS jitter).
+    assert seq_a != [RetryPolicy(base_delay=0.05, max_delay=0.4,
+                                 seed=8).backoff(i) for i in range(1, 8)]
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+
+
+def test_retry_policy_classification():
+    import grpc
+
+    p = RetryPolicy()
+    assert p.retryable(grpc.StatusCode.UNAVAILABLE)
+    assert p.retryable(grpc.StatusCode.DEADLINE_EXCEEDED)
+    assert not p.retryable(grpc.StatusCode.INVALID_ARGUMENT)
+    assert not p.retryable(grpc.StatusCode.INTERNAL)
+    assert not p.retryable(grpc.StatusCode.RESOURCE_EXHAUSTED)
+    # String codes (the FrameworkError taxonomy) classify identically.
+    assert p.retryable("UNAVAILABLE") and not p.retryable("INTERNAL")
+    assert not p.retryable(None)
+
+
+def test_resource_exhausted_error_taxonomy():
+    e = ResourceExhaustedError("queue full", stage=1)
+    assert e.code == "RESOURCE_EXHAUSTED"
+    assert isinstance(e, FrameworkError) and isinstance(e, RuntimeError)
+    assert "[stage 1]" in str(e)
+
+
+# ------------------------------------------------------------- fault plans
+
+
+def test_fault_plan_is_deterministic_and_validates():
+    plan = faults.FaultPlan(at={2: faults.delay(0.0)}, every=3,
+                            fault=faults.unavailable())
+    kinds = [plan.next_fault() for _ in range(6)]
+    assert kinds[0] is None and kinds[3] is None and kinds[4] is None
+    assert kinds[1].kind == "delay"
+    assert kinds[2].error is UnavailableError
+    assert kinds[5].error is UnavailableError
+    assert plan.calls == 6 and plan.fired == 3
+    with pytest.raises(ValueError, match="every"):
+        faults.FaultPlan(every=0, fault=faults.unavailable())
+    with pytest.raises(ValueError, match="fault"):
+        faults.FaultPlan(every=2)
+
+
+def test_fault_wrap_and_engine_hooks_fire():
+    plan = faults.FaultPlan(every=2, fault=faults.internal("boom"))
+    calls = []
+    fn = faults.wrap(lambda x: calls.append(x) or x, plan)
+    assert fn(1) == 1
+    with pytest.raises(Exception, match="boom"):
+        fn(2)
+    assert calls == [1]  # the faulted call never reached the wrapped fn
+
+    # Engine hook points are first class: attach, fire, clear.
+    eng = _mesh_free_engine()
+    launch = faults.FaultPlan(every=1, fault=faults.unavailable())
+    faults.inject_engine_faults(eng, launch=launch)
+    with pytest.raises(UnavailableError):
+        eng.infer(np.zeros((1, 8)))
+    faults.clear_engine_faults(eng)
+    assert eng.infer(np.zeros((1, 8))).shape == (1, 4)
+    assert launch.calls == 1
+
+
+# ------------------------------------------------- client retries (loopback)
+
+
+def test_client_retries_complete_100_of_100_with_faulty_launches():
+    """The acceptance gate: every 3rd engine launch dies UNAVAILABLE,
+    yet a retrying client completes 100/100 requests against the real
+    loopback server, with the recovery visible in
+    tdn_client_retries_total."""
+    eng = _mesh_free_engine()
+    eng.infer(np.zeros((1, 8)))  # compile before injecting faults
+    plan = faults.FaultPlan(every=3, fault=faults.unavailable())
+    faults.inject_engine_faults(eng, launch=plan)
+    server, port = serve_engine(eng, 0, host="127.0.0.1", coalesce=True)
+    before = _counter("tdn_client_retries_total", method="Process")
+    try:
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                            retry=_fast_policy(), breaker=None)
+        for i in range(100):
+            out = client.process(np.full((1, 8), float(i % 5)))
+            assert out.shape == (1, 4) and np.isfinite(out).all()
+        client.close()
+    finally:
+        server.stop(0)
+    retried = _counter("tdn_client_retries_total", method="Process") - before
+    # 100 successes need ~50 extra launch attempts (every 3rd dies).
+    assert plan.fired >= 30
+    assert retried >= plan.fired, (retried, plan.fired)
+
+
+def test_same_faults_without_retries_fail():
+    """The control arm: identical 1-in-3 fault plan, retries disabled —
+    the run must NOT complete (what the retry layer is buying)."""
+    import grpc
+
+    eng = _mesh_free_engine()
+    eng.infer(np.zeros((1, 8)))
+    plan = faults.FaultPlan(every=3, fault=faults.unavailable())
+    faults.inject_engine_faults(eng, launch=plan)
+    server, port = serve_engine(eng, 0, host="127.0.0.1", coalesce=True)
+    try:
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                            retry=None, breaker=None)
+        codes = []
+        for i in range(9):
+            try:
+                client.process(np.zeros((1, 8)))
+                codes.append(None)
+            except grpc.RpcError as e:
+                codes.append(e.code())
+        client.close()
+    finally:
+        server.stop(0)
+    assert codes.count(grpc.StatusCode.UNAVAILABLE) == 3, codes
+    # Deterministic plan: exactly every 3rd launch (requests are serial).
+    assert codes[2] == codes[5] == codes[8] == grpc.StatusCode.UNAVAILABLE
+
+
+def test_retry_budget_never_exceeds_original_timeout():
+    """Budget exhaustion mid-retry: against a permanently-UNAVAILABLE
+    target, attempts stop when the CALLER's timeout is spent — long
+    before max_attempts — and the last real status surfaces."""
+    import grpc
+
+    plan = faults.FaultPlan(every=1, fault=faults.unavailable())
+    server, port = serve_engine(
+        AsyncFakeEngine(), 0, host="127.0.0.1", coalesce=True,
+        interceptors=(faults.FaultInterceptor(plan),),
+    )
+    try:
+        client = GrpcClient(
+            f"127.0.0.1:{port}", timeout=0.3,
+            retry=RetryPolicy(max_attempts=50, base_delay=0.02,
+                              max_delay=0.02, seed=1),
+            breaker=None,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(grpc.RpcError) as e:
+            client.process(np.zeros((1, 8)))
+        elapsed = time.monotonic() - t0
+        client.close()
+    finally:
+        server.stop(0)
+    assert e.value.code() in (grpc.StatusCode.UNAVAILABLE,
+                              grpc.StatusCode.DEADLINE_EXCEEDED)
+    # Stopped by the 0.3s budget (with scheduler slack), not by the
+    # 50-attempt limit.
+    assert elapsed < 1.5, elapsed
+    assert 2 <= plan.calls < 50, plan.calls
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+def test_breaker_cycle_closed_open_half_open_closed():
+    clk = [0.0]
+    br = CircuitBreaker("unit-target", failure_threshold=3,
+                        cooldown_seconds=5.0, clock=lambda: clk[0])
+    gauge = REGISTRY.get("tdn_breaker_state").labels(target="unit-target")
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    for _ in range(3):
+        br.record_failure()
+    assert br.state == CircuitBreaker.OPEN and gauge.value == 2.0
+    assert not br.allow(), "open breaker must fail fast"
+    clk[0] = 5.0  # cooldown elapsed: next caller becomes the probe
+    assert br.allow()
+    assert br.state == CircuitBreaker.HALF_OPEN and gauge.value == 1.0
+    assert not br.allow(), "one probe at a time while half-open"
+    br.record_failure()  # probe failed: re-open for a fresh cooldown
+    assert br.state == CircuitBreaker.OPEN and not br.allow()
+    clk[0] = 10.0
+    assert br.allow()
+    br.record_success()  # probe succeeded: close
+    assert br.state == CircuitBreaker.CLOSED and gauge.value == 0.0
+    assert br.allow()
+
+
+def test_breaker_fails_fast_through_client():
+    """After threshold consecutive retryable failures the NEXT call
+    fails fast with UnavailableError and never touches the wire."""
+    import grpc
+
+    plan = faults.FaultPlan(every=1, fault=faults.unavailable())
+    server, port = serve_engine(
+        AsyncFakeEngine(), 0, host="127.0.0.1", coalesce=True,
+        interceptors=(faults.FaultInterceptor(plan),),
+    )
+    try:
+        br = CircuitBreaker(f"bft-{port}", failure_threshold=2,
+                            cooldown_seconds=60.0)
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=5.0,
+                            retry=None, breaker=br)
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError):
+                client.process(np.zeros((1, 8)))
+        wire_calls = plan.calls
+        with pytest.raises(UnavailableError, match="circuit breaker open"):
+            client.process(np.zeros((1, 8)))
+        assert plan.calls == wire_calls, "open breaker must not hit the wire"
+        client.close()
+    finally:
+        server.stop(0)
+
+
+def test_breaker_ignores_non_retryable_failures():
+    """INVALID_ARGUMENT says nothing about target health: it must not
+    trip the breaker (a bad client would otherwise open the circuit
+    for every well-formed one)."""
+    import grpc
+
+    eng = AsyncFakeEngine(dim=8)
+    server, port = serve_engine(eng, 0, host="127.0.0.1", coalesce=True)
+    try:
+        br = CircuitBreaker(f"nrf-{port}", failure_threshold=2,
+                            cooldown_seconds=60.0)
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=5.0,
+                            retry=None, breaker=br)
+        for _ in range(4):
+            with pytest.raises(grpc.RpcError) as e:
+                client.process(np.zeros((1, 5)))  # engine wants 8
+            assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert br.state == CircuitBreaker.CLOSED
+        out = client.process(np.zeros((2, 8)))  # still flows
+        assert out.shape == (2, 8)
+        client.close()
+    finally:
+        server.stop(0)
+
+
+def test_breaker_half_open_probe_answered_non_transiently_recovers():
+    """A half-open probe answered with a NON-transient status proves the
+    target is reachable: the breaker must close, not wedge in half-open
+    with the probe slot held forever."""
+    import grpc
+
+    plan = faults.FaultPlan(at={1: faults.unavailable(),
+                                2: faults.unavailable()})
+    server, port = serve_engine(
+        AsyncFakeEngine(dim=8), 0, host="127.0.0.1",
+        interceptors=(faults.FaultInterceptor(plan),),
+    )
+    try:
+        br = CircuitBreaker(f"hop-{port}", failure_threshold=2,
+                            cooldown_seconds=0.0)  # half-open immediately
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=5.0,
+                            retry=None, breaker=br)
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError):
+                client.process(np.zeros((1, 8)))
+        assert br.state == CircuitBreaker.OPEN
+        # The probe: a bad request → INVALID_ARGUMENT from a live server.
+        with pytest.raises(grpc.RpcError) as e:
+            client.process(np.zeros((1, 5)))
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert br.state == CircuitBreaker.CLOSED
+        assert client.process(np.ones((1, 8))).shape == (1, 8)
+        client.close()
+    finally:
+        server.stop(0)
+
+
+def test_for_target_shares_one_instance_first_config_wins():
+    a = CircuitBreaker.for_target("ft-shared", failure_threshold=3)
+    b = CircuitBreaker.for_target("ft-shared", failure_threshold=9)
+    assert a is b and b.failure_threshold == 3  # cache hit keeps config
+    CircuitBreaker.evict("ft-shared")
+    c = CircuitBreaker.for_target("ft-shared", failure_threshold=9)
+    assert c is not a and c.failure_threshold == 9
+
+
+def test_half_open_probe_slot_ages_out_if_prober_vanishes():
+    """A prober that dies between allow() and record_* must not wedge
+    the breaker: the probe slot expires after a cooldown and the next
+    caller becomes the probe."""
+    clk = [0.0]
+    br = CircuitBreaker("vanish", failure_threshold=1,
+                        cooldown_seconds=2.0, clock=lambda: clk[0])
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clk[0] = 2.0
+    assert br.allow()  # probe granted... and the prober vanishes
+    assert not br.allow()  # slot held while the probe is fresh
+    clk[0] = 4.0  # probe aged out: the slot is reclaimable
+    assert br.allow()
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_overshot_backoff_reraises_last_real_error():
+    """A backoff sleep that overshoots the budget must re-raise the last
+    REAL outcome instead of issuing a ~0ms phantom attempt (which would
+    fail client-side and count a failure the server never saw)."""
+    import grpc
+
+    plan = faults.FaultPlan(every=1, fault=faults.unavailable())
+    server, port = serve_engine(
+        AsyncFakeEngine(dim=8), 0, host="127.0.0.1",
+        interceptors=(faults.FaultInterceptor(plan),),
+    )
+    try:
+        client = GrpcClient(
+            f"127.0.0.1:{port}", timeout=0.05,
+            retry=RetryPolicy(max_attempts=5, base_delay=0.001,
+                              max_delay=0.001, seed=0,
+                              sleep=lambda d: time.sleep(0.05)),
+            breaker=None,
+        )
+        with pytest.raises(grpc.RpcError) as e:
+            client.process(np.zeros((1, 8)))
+        assert e.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert plan.calls == 1, "no phantom near-zero-deadline attempt"
+        client.close()
+    finally:
+        server.stop(0)
+
+
+# -------------------------------------------------------- admission control
+
+
+def test_shed_at_watermark_surfaces_resource_exhausted():
+    """Past --max-pending-rows the server fast-fails RESOURCE_EXHAUSTED
+    through the real gRPC hop instead of queueing unboundedly; admitted
+    requests still complete once the device unwedges."""
+    import grpc
+
+    eng = AsyncFakeEngine(dim=8)
+    eng.gate.clear()  # wedge the fetch: batches stall 'on the device'
+    server, port = serve_engine(
+        eng, 0, host="127.0.0.1", coalesce=True, max_pending_rows=4,
+        submit_timeout=10.0, pipeline_depth=1,
+    )
+    before = _counter("tdn_batcher_shed_total", method="Process")
+    clients, threads = [], []
+    try:
+        def call(value):
+            c = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                           retry=None, breaker=None)
+            clients.append(c)
+            return c.process(np.full((2, 8), value))
+
+        # r1 occupies the (serial) batcher inside the wedged fetch...
+        t1, o1 = _bg(lambda: call(1.0))
+        assert eng.fetch_entered.wait(5.0)
+        # ...r2 + r3 fill the queue exactly to the 4-row watermark.
+        t2, o2 = _bg(lambda: call(2.0))
+        t3, o3 = _bg(lambda: call(3.0))
+        deadline = time.monotonic() + 5.0
+        while (server.batcher.pending_rows < 4
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert server.batcher.pending_rows == 4
+        threads.extend([t1, t2, t3])
+
+        # The runtime sampler publishes the ledger the watermark gates.
+        from tpu_dist_nn.obs import RuntimeSampler
+        from tpu_dist_nn.obs.registry import Registry
+
+        reg = Registry()
+        sampler = RuntimeSampler(interval=30.0, registry=reg)
+        sampler.add_batcher(server.batcher, method="Process")
+        sampler.sample_once()
+        g = reg.get("tdn_batcher_pending_rows").labels(method="Process")
+        assert g.value == 4.0
+
+        # r4 would pass the watermark: shed NOW, not queued.
+        c4 = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                        retry=None, breaker=None)
+        clients.append(c4)
+        with pytest.raises(grpc.RpcError) as e:
+            c4.process(np.full((2, 8), 4.0))
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "watermark" in e.value.details()
+        assert server.batcher.shed_total == 1
+        assert _counter("tdn_batcher_shed_total",
+                        method="Process") == before + 1
+
+        # Unwedge: every ADMITTED request completes correctly.
+        eng.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for o, v in ((o1, 1.0), (o2, 2.0), (o3, 3.0)):
+            assert "err" not in o, o
+            np.testing.assert_array_equal(o["val"], np.full((2, 8), v * 2.0))
+    finally:
+        eng.gate.set()
+        server.stop(0)
+        for c in clients:
+            c.close()
+
+
+def test_oversized_request_admitted_when_queue_empty():
+    # The watermark bounds BACKLOG, not batch size: a lone request
+    # larger than the watermark must still be servable.
+    from tpu_dist_nn.serving.server import _Batcher
+
+    eng = AsyncFakeEngine(dim=8)
+    b = _Batcher(eng, max_pending_rows=4)
+    try:
+        out = b.submit(np.ones((16, 8)), timeout=5.0)
+        assert out.shape == (16, 8)
+    finally:
+        b.close()
+
+
+# ----------------------------------------------------------- graceful drain
+
+
+def test_graceful_drain_completes_inflight_and_flips_health():
+    import grpc
+
+    eng = AsyncFakeEngine(dim=8)
+    eng.gate.clear()
+    server, port = serve_engine(eng, 0, host="127.0.0.1", coalesce=True)
+    drain = GracefulDrain(grace_seconds=5.0)
+    drain.add_server(server)
+    health = drain.wrap_health(lambda: {"ready": True, "devices": 1})
+    assert health() == {"ready": True, "devices": 1, "draining": False}
+    client = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                        retry=None, breaker=None)
+    try:
+        t, o = _bg(lambda: client.process(np.full((3, 8), 2.0)))
+        assert eng.fetch_entered.wait(5.0)  # request is in flight
+
+        ev = drain.begin()
+        # 1. /healthz flips NOT_SERVING the moment draining starts.
+        h = health()
+        assert h["ready"] is False and h["draining"] is True
+        assert _counter("tdn_server_draining") == 1.0
+        # begin() is idempotent (signal handler + teardown both call).
+        assert drain.begin() is ev
+
+        # 2. NEW work is refused while draining.
+        c2 = GrpcClient(f"127.0.0.1:{port}", timeout=2.0,
+                        retry=None, breaker=None)
+        with pytest.raises(grpc.RpcError) as e:
+            c2.process(np.zeros((1, 8)))
+        assert e.value.code() in (grpc.StatusCode.UNAVAILABLE,
+                                  grpc.StatusCode.CANCELLED)
+        c2.close()
+
+        # 3. The in-flight request COMPLETES (the drain's whole point).
+        eng.gate.set()
+        assert drain.wait(5.0), "drain never completed"
+        t.join(timeout=5.0)
+        assert "err" not in o, o.get("err")
+        np.testing.assert_array_equal(o["val"], np.full((3, 8), 4.0))
+        assert _counter("tdn_server_draining") == 0.0
+    finally:
+        eng.gate.set()
+        client.close()
+        server.stop(0)
+
+
+def test_drain_without_servers_completes_immediately():
+    drain = GracefulDrain(grace_seconds=0.1)
+    assert not drain.draining.is_set()
+    drain.begin()
+    assert drain.wait(1.0) and drain.draining.is_set()
+
+
+def test_wrap_health_keeps_draining_marker_when_probe_raises():
+    """Mid-drain the engine may already be down; a raising health probe
+    must not erase the draining marker the load balancer keys on."""
+
+    def boom():
+        raise RuntimeError("engine is down")
+
+    drain = GracefulDrain(grace_seconds=0.1)
+    health = drain.wrap_health(boom)
+    with pytest.raises(RuntimeError):
+        health()  # not draining: the probe's failure IS the report
+    drain.begin()
+    body = health()
+    assert body["ready"] is False and body["draining"] is True
+    assert "error" in body
+
+
+# ------------------------------------------------------- batcher close fix
+
+
+def test_post_close_submit_raises_immediately():
+    from tpu_dist_nn.serving.server import _Batcher
+
+    b = _Batcher(AsyncFakeEngine(dim=8))
+    b.close()
+    t0 = time.monotonic()
+    with pytest.raises(UnavailableError):
+        b.submit(np.zeros((1, 8)), timeout=30.0)
+    assert time.monotonic() - t0 < 0.5, "post-close submit must not wait"
+
+
+def test_close_fails_pending_entries_over_to_waiters():
+    """A wedged dispatch at close time: entries still queued must fail
+    over to their waiters as UNAVAILABLE now — not sit out their full
+    submit timeout against a batcher that is already gone."""
+    import dataclasses
+
+    from tpu_dist_nn.serving.server import _Batcher
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class WedgedLaunchEngine:
+        model = dataclasses.make_dataclass("M", ["input_dim"])(8)
+
+        def infer(self, x):
+            entered.set()
+            release.wait(10.0)
+            return np.asarray(x)
+
+    b = _Batcher(WedgedLaunchEngine(), submit_timeout=30.0)
+    try:
+        t1, o1 = _bg(lambda: b.submit(np.full((1, 8), 1.0), timeout=30.0))
+        assert entered.wait(5.0)  # r1 popped, wedged inside the launch
+        t2, o2 = _bg(lambda: b.submit(np.full((1, 8), 2.0), timeout=30.0))
+        deadline = time.monotonic() + 5.0
+        while not b._pending and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b._pending, "r2 never queued"
+
+        t0 = time.monotonic()
+        b.close(timeout=0.2)  # dispatch is wedged: join times out
+        t2.join(timeout=2.0)
+        assert time.monotonic() - t0 < 3.0
+        assert isinstance(o2.get("err"), UnavailableError), o2
+        assert b.pending_rows == 0
+    finally:
+        release.set()
+        t1.join(timeout=5.0)
+
+
+# ------------------------------------------------------------ wait_for_ready
+
+
+def test_wait_for_ready_maps_to_unavailable_on_dead_target():
+    t0 = time.monotonic()
+    with pytest.raises(UnavailableError, match="not ready"):
+        GrpcClient("127.0.0.1:1", wait_for_ready=True, ready_timeout=0.3,
+                   retry=None, breaker=None)
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_wait_for_ready_connects_to_live_server():
+    server, port = serve_engine(AsyncFakeEngine(dim=8), 0, host="127.0.0.1")
+    try:
+        client = GrpcClient(f"127.0.0.1:{port}", wait_for_ready=True,
+                            ready_timeout=5.0, retry=None, breaker=None)
+        out = client.process(np.ones((2, 8)))
+        np.testing.assert_array_equal(out, np.full((2, 8), 2.0))
+        client.close()
+    finally:
+        server.stop(0)
+
+
+# -------------------------------------------------------- interceptor seam
+
+
+def test_fault_interceptor_errors_exactly_the_nth_request():
+    import grpc
+
+    plan = faults.FaultPlan(every=2, fault=faults.unavailable())
+    server, port = serve_engine(
+        AsyncFakeEngine(dim=8), 0, host="127.0.0.1",
+        interceptors=(faults.FaultInterceptor(plan),),
+    )
+    try:
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=5.0,
+                            retry=None, breaker=None)
+        assert client.process(np.ones((1, 8))).shape == (1, 8)
+        with pytest.raises(grpc.RpcError) as e:
+            client.process(np.ones((1, 8)))
+        assert e.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert client.process(np.ones((1, 8))).shape == (1, 8)
+        client.close()
+    finally:
+        server.stop(0)
+
+
+# --------------------------------------------------- quick-tier chaos smoke
+
+
+def test_chaos_smoke_quick_tier_recovers_via_retries():
+    """The < 10 s chaos gate: in-process server with a 1-in-3 launch
+    fault plan; a default-policy retrying client finishes 30/30 and the
+    recovery is scrapeable on the REAL /metrics endpoint."""
+    import urllib.request
+
+    from tpu_dist_nn.obs import parse_prometheus_text, start_http_server
+
+    eng = _mesh_free_engine()
+    eng.infer(np.zeros((1, 8)))  # compile before injecting faults
+    plan = faults.FaultPlan(every=3, fault=faults.unavailable())
+    faults.inject_engine_faults(eng, launch=plan)
+    server, port = serve_engine(eng, 0, host="127.0.0.1", coalesce=True)
+    metrics = start_http_server(0, host="127.0.0.1")
+
+    def scrape():
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics.port}/metrics", timeout=5.0
+        ) as r:
+            return parse_prometheus_text(r.read().decode())
+
+    key = 'tdn_client_retries_total{method="Process"}'
+    before = scrape().get(key, 0)
+    try:
+        client = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                            retry=_fast_policy(), breaker=None)
+        for i in range(30):
+            out = client.process(np.full((1, 8), float(i % 3)))
+            assert out.shape == (1, 4) and np.isfinite(out).all()
+        client.close()
+        after = scrape()
+        assert after.get(key, 0) > before, "retries must be scrapeable"
+        assert plan.fired >= 9
+    finally:
+        server.stop(0)
+        metrics.close()
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_help_lists_resilience_flags(capsys):
+    from tpu_dist_nn.cli import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["up", "--help"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    assert "--max-pending-rows" in out and "--drain-grace-seconds" in out
+    with pytest.raises(SystemExit) as e:
+        main(["infer", "--help"])
+    assert e.value.code == 0
+    assert "--retry-max-attempts" in capsys.readouterr().out
+    with pytest.raises(SystemExit) as e:
+        main(["lm", "--help"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    assert "--max-pending-rows" in out and "--drain-grace-seconds" in out
+
+
+def test_cli_infer_client_retries_through_faulty_server(tmp_path, capsys):
+    """`tdn infer --target --retry-max-attempts`: the CLI client rides
+    the retry policy through a server that kills every 3rd request."""
+    import json
+
+    from tpu_dist_nn.cli import main
+
+    plan = faults.FaultPlan(every=3, fault=faults.unavailable())
+    server, port = serve_engine(
+        AsyncFakeEngine(dim=8), 0, host="127.0.0.1",
+        interceptors=(faults.FaultInterceptor(plan),),
+    )
+    examples = {
+        "examples": [
+            {"input": list(np.full(8, float(i))), "label": -1}
+            for i in range(4)
+        ]
+    }
+    path = tmp_path / "ex.json"
+    path.write_text(json.dumps(examples))
+    try:
+        rc = main([
+            "infer", "--inputs", str(path),
+            "--target", f"127.0.0.1:{port}", "--batch-size", "1",
+            "--retry-max-attempts", "3",
+        ])
+    finally:
+        server.stop(0)
+    assert rc == 0
+    assert "Total inference time" in capsys.readouterr().out
+    assert plan.fired >= 1  # the 3rd RPC really was killed (and retried)

@@ -217,6 +217,12 @@ class Engine:
         self._np_dtype = np.dtype(dtype)
         # Pow2 row buckets already compiled+executed by warm_buckets.
         self._warm_buckets: set[int] = set()
+        # First-class fault-injection hook points (monkeypatch-free):
+        # when set, called at the top of infer_async / fetch with the
+        # batch / pending handle. tpu_dist_nn.testing.faults attaches
+        # deterministic plans here; None costs one attribute check.
+        self.launch_hook = None
+        self.fetch_hook = None
         # Static activation names: passed explicitly on the hot path so
         # infer() never reads act ids back from the device.
         self._act_names = tuple(l.activation for l in model.layers)
@@ -402,6 +408,11 @@ class Engine:
         """
         t0 = time.monotonic()
         try:
+            # getattr: hand-constructed engines (tests build the
+            # single-chip path via Engine.__new__) may predate the slot.
+            hook = getattr(self, "launch_hook", None)
+            if hook is not None:
+                hook(x)  # fault injection: may raise or delay
             out, materialize, shape = self._infer_impl(x)
         except Exception:
             _INFER_ERRORS.inc()
@@ -440,6 +451,9 @@ class Engine:
         the ONE host sync of an inference. Wall time from dispatch to
         materialized result lands in ``tdn_engine_infer_seconds``."""
         try:
+            hook = getattr(self, "fetch_hook", None)
+            if hook is not None:
+                hook(pending)  # fault injection: may raise or delay
             out = pending.materialize(pending.value)
         except Exception:
             _INFER_ERRORS.inc()

@@ -269,17 +269,22 @@ def _engine_from_args(args, warmup=True):
     )
 
 
-def _serve_loop(engine, max_seconds: float | None = None, teardown=None) -> None:
+def _serve_loop(engine, max_seconds: float | None = None, teardown=None,
+                stop_event=None) -> None:
     """Supervisor loop: stay up until SIGINT, then tear down cleanly —
     the reference orchestrator's main loop (run_grpc_fcnn.py:326-344).
     ``max_seconds`` bounds the loop for tests. ``teardown`` overrides
     the default ``engine.down()`` (the gRPC path must drain the server
     BEFORE downing the engine, or grace-period requests hit a dead
-    engine)."""
+    engine). ``stop_event`` ends the loop early — the graceful-drain
+    path sets it once SIGTERM has drained in-flight work."""
     t0 = time.monotonic()
     try:
         while max_seconds is None or time.monotonic() - t0 < max_seconds:
-            time.sleep(0.2)
+            if stop_event is not None and stop_event.wait(0.2):
+                break
+            if stop_event is None:
+                time.sleep(0.2)
     except KeyboardInterrupt:
         log.info("interrupt received; tearing down")
     finally:
@@ -306,9 +311,17 @@ def cmd_up(args) -> int:
     # late-binds `engine`; until it exists /healthz reports not-ready
     # 503 — which is exactly what bring-up IS. probe=False: a per-
     # request device probe from the HTTP thread would race the serving
-    # path and pay an XLA compile on the poller's first hit.
+    # path and pay an XLA compile on the poller's first hit. The drain
+    # controller wraps the closure so SIGTERM flips /healthz to
+    # NOT_SERVING the instant draining starts (load balancers must
+    # stop routing before the port refuses).
+    from tpu_dist_nn.serving.resilience import GracefulDrain
+
+    drain = GracefulDrain(grace_seconds=args.drain_grace_seconds)
     metrics_server = _start_metrics_server(
-        args, health_fn=lambda: engine.health(probe=False)
+        args, health_fn=drain.wrap_health(
+            lambda: engine.health(probe=False)
+        )
     )
     sampler = None
     engine = _engine_from_args(args)
@@ -328,8 +341,13 @@ def cmd_up(args) -> int:
         # warm_rows precompiles the request-coalescing bucket shapes so
         # the first concurrent burst doesn't pay XLA compiles mid-flight.
         server, bound = serve_engine(
-            engine, args.grpc_port, warm_rows=args.serve_warm_rows
+            engine, args.grpc_port, warm_rows=args.serve_warm_rows,
+            max_pending_rows=args.max_pending_rows,
         )
+        # SIGTERM → drain: healthz NOT_SERVING, stop accepting, finish
+        # in-flight within --drain-grace-seconds, then exit the loop.
+        drain.add_server(server)
+        drain.install_signal_handler()
         print(json.dumps({"grpc_port": bound}), flush=True)
         if metrics_server is not None:
             from tpu_dist_nn.obs import RuntimeSampler, TRACER
@@ -343,13 +361,15 @@ def cmd_up(args) -> int:
             _attach_metrics_sampler(metrics_server, sampler)
 
         def teardown():
-            # Drain in-flight RPCs before the engine goes away.
-            server.stop(grace=1.0).wait()
+            # Drain in-flight RPCs before the engine goes away
+            # (idempotent: a SIGTERM-initiated drain just gets joined).
+            drain.begin()
+            drain.wait(args.drain_grace_seconds + 10.0)
             engine.down()
             _stop_metrics_server(metrics_server, sampler)
 
         _serve_loop(engine, max_seconds=args.serve_seconds,
-                    teardown=teardown)
+                    teardown=teardown, stop_event=drain.drained)
         return 0
     if args.serve:
         _serve_loop(engine, max_seconds=args.serve_seconds)
@@ -438,7 +458,15 @@ def _infer_over_grpc(args) -> int:
     from tpu_dist_nn.train.metrics import classification_metrics
 
     x, y = load_examples(args.inputs)
-    client = GrpcClient(args.target, timeout=args.timeout or 30.0)
+    kwargs = {}
+    if getattr(args, "retry_max_attempts", None) is not None:
+        # Override the client's default retry policy: 1 = single
+        # attempt (the reference's behavior), N > 1 = up to N-1
+        # jittered-backoff retries within the --timeout budget.
+        from tpu_dist_nn.serving.resilience import RetryPolicy
+
+        kwargs["retry"] = RetryPolicy(max_attempts=args.retry_max_attempts)
+    client = GrpcClient(args.target, timeout=args.timeout or 30.0, **kwargs)
     try:
         if args.input_index is not None:
             t0 = time.monotonic()
@@ -1322,8 +1350,14 @@ def cmd_lm(args) -> int:
         num_virtual = 2 if args.schedule == "interleaved" else 1
     # Live telemetry for the whole run: training counters during the
     # loop, serving counters if --serve-generate follows. No engine
-    # here, so /healthz is a bare liveness probe.
-    metrics_server = _start_metrics_server(args)
+    # here, so /healthz is a bare liveness probe — gated by the drain
+    # controller so a SIGTERM mid-serve flips it to NOT_SERVING.
+    from tpu_dist_nn.serving.resilience import GracefulDrain
+
+    drain = GracefulDrain(grace_seconds=args.drain_grace_seconds)
+    metrics_server = _start_metrics_server(
+        args, health_fn=drain.wrap_health(None)
+    )
     t0 = time.monotonic()
     import contextlib
 
@@ -1471,7 +1505,12 @@ def cmd_lm(args) -> int:
             num_groups=args.serve_groups,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.seed,
+            max_pending_rows=args.max_pending_rows,
         )
+        # SIGTERM → graceful drain (healthz NOT_SERVING, stop
+        # accepting, finish in-flight) instead of hard-killing decodes.
+        drain.add_server(server)
+        drain.install_signal_handler()
         report["serving"] = {
             "port": bound,
             "prompt_len": args.serve_prompt_len,
@@ -1490,12 +1529,14 @@ def cmd_lm(args) -> int:
         print(json.dumps(report), flush=True)
         try:
             if args.serve_seconds is not None:
-                time.sleep(args.serve_seconds)
+                # A SIGTERM-initiated drain ends the wait early.
+                drain.wait(args.serve_seconds)
             else:
                 server.wait_for_termination()
         except KeyboardInterrupt:
             pass
-        server.stop(1).wait()
+        drain.begin()
+        drain.wait(args.drain_grace_seconds + 10.0)
         _stop_metrics_server(metrics_server, sampler)
         return 0
     print(json.dumps(report))
@@ -1918,6 +1959,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve for N seconds then tear down (default: "
                         "until interrupted; bounds --serve/--grpc-port "
                         "runs for drivers and tests)")
+    p.add_argument("--max-pending-rows", type=int, default=None,
+                   help="admission-control watermark: a request that "
+                        "would queue past this many pending rows is shed "
+                        "with RESOURCE_EXHAUSTED instead of backlogging "
+                        "unboundedly (default: unbounded; "
+                        "docs/ROBUSTNESS.md)")
+    p.add_argument("--drain-grace-seconds", type=float, default=5.0,
+                   help="graceful-drain window on SIGTERM: /healthz "
+                        "flips NOT_SERVING, new RPCs are refused, and "
+                        "in-flight requests get this long to finish "
+                        "before exit (docs/ROBUSTNESS.md)")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="also expose /metrics (Prometheus text), "
                         "/healthz (Engine.health as JSON), and /trace "
@@ -1947,6 +1999,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=None,
                    help="per-RPC timeout for --target (default 30s); "
                         "compat no-op locally")
+    p.add_argument("--retry-max-attempts", type=int, default=None,
+                   help="with --target: total attempts per RPC under the "
+                        "client retry policy (jittered backoff on "
+                        "UNAVAILABLE/DEADLINE_EXCEEDED within --timeout; "
+                        "1 = no retries, default 3; docs/ROBUSTNESS.md)")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace here")
     p.set_defaults(fn=cmd_infer)
@@ -2179,6 +2236,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-seconds", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                         "interrupted)")
+    p.add_argument("--max-pending-rows", type=int, default=None,
+                   help="admission-control watermark for --serve-generate: "
+                        "requests that would queue past this many pending "
+                        "rows are shed with RESOURCE_EXHAUSTED (default: "
+                        "unbounded; docs/ROBUSTNESS.md)")
+    p.add_argument("--drain-grace-seconds", type=float, default=5.0,
+                   help="graceful-drain window on SIGTERM while serving "
+                        "(--serve-generate): finish in-flight decodes "
+                        "within this long before exit")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="expose /metrics + /healthz for the run — "
                         "training counters during the loop, serving "

@@ -53,6 +53,12 @@ class RuntimeSampler:
             "tdn_batcher_queue_depth",
             "requests waiting in the coalescing queue", labels=("method",),
         )
+        self._g_pending_rows = reg.gauge(
+            "tdn_batcher_pending_rows",
+            "rows waiting in the coalescing queue (the admission-control "
+            "watermark ledger; sheds start when this would pass "
+            "--max-pending-rows)", labels=("method",),
+        )
         self._g_inflight = reg.gauge(
             "tdn_batcher_inflight_rows",
             "rows in the batch currently on the device", labels=("method",),
@@ -143,6 +149,9 @@ class RuntimeSampler:
         """One synchronous sample of every source (also used by tests)."""
         for method, b in self._batchers:
             self._g_queue.labels(method=method).set(len(b._pending))
+            self._g_pending_rows.labels(method=method).set(
+                getattr(b, "pending_rows", 0)
+            )
             self._g_inflight.labels(method=method).set(
                 getattr(b, "inflight_rows", 0)
             )

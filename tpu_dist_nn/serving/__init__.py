@@ -1,5 +1,10 @@
 """Wire-compatible gRPC serving (the reference's LayerService protocol)."""
 
+from tpu_dist_nn.serving.resilience import (  # noqa: F401
+    CircuitBreaker,
+    GracefulDrain,
+    RetryPolicy,
+)
 from tpu_dist_nn.serving.server import (  # noqa: F401
     GrpcClient,
     serve_engine,

@@ -82,6 +82,12 @@ _LAUNCHES = REGISTRY.counter(
     "tdn_batch_launches_total", "device launches issued by the batcher",
     labels=("method",),
 )
+_SHED = REGISTRY.counter(
+    "tdn_batcher_shed_total",
+    "submits fast-failed RESOURCE_EXHAUSTED at the pending-rows "
+    "watermark (admission control)",
+    labels=("method",),
+)
 
 
 class _Batcher:
@@ -112,7 +118,8 @@ class _Batcher:
 
     def __init__(self, engine, max_batch_rows: int = 65536,
                  submit_timeout: float | None = 120.0, run_fn=None,
-                 method: str = "Process", pipeline_depth: int = 2):
+                 method: str = "Process", pipeline_depth: int = 2,
+                 max_pending_rows: int | None = None):
         self._engine = engine
         # The device launch the batcher owns, split into the dispatch
         # half (launch, ideally non-blocking) and the fetch half (the
@@ -129,10 +136,20 @@ class _Batcher:
             self._dispatch_fn, self._fetch_fn = engine.infer, np.asarray
         self._max_rows = int(max_batch_rows)
         self._submit_timeout = submit_timeout
+        # Admission watermark: submits that would push the queued row
+        # count past this fast-fail RESOURCE_EXHAUSTED instead of
+        # queueing unboundedly (None = the old unbounded behavior).
+        self._max_pending_rows = (
+            int(max_pending_rows) if max_pending_rows is not None else None
+        )
         self._cond = threading.Condition()
         # deque: the dispatch stage pops from the head per item — O(1)
         # under backlog where list.pop(0) was O(n) per pop.
         self._pending: collections.deque[dict] = collections.deque()
+        # Rows currently queued (NOT yet popped by dispatch): the
+        # admission-control ledger and the sampler's
+        # tdn_batcher_pending_rows gauge. Updated only under _cond.
+        self.pending_rows = 0
         self._closed = False
         self._serial = pipeline_depth <= 1
         # Launched-but-not-drained hand-off. The SEMAPHORE is the
@@ -156,6 +173,8 @@ class _Batcher:
         self.requests_total = 0
         self.batches_total = 0
         self.rows_total = 0
+        # Submits refused at the admission watermark.
+        self.shed_total = 0
         # Launches issued while a previously launched batch had not
         # finished draining — the overlap evidence
         # (tdn_batcher_overlap_ratio = overlapped_total/batches_total).
@@ -171,6 +190,7 @@ class _Batcher:
         # not a label lookup.
         self._m_submits = _SUBMITS.labels(method=method)
         self._m_abandoned = _ABANDONED.labels(method=method)
+        self._m_shed = _SHED.labels(method=method)
         self._m_launches = _LAUNCHES.labels(method=method)
         self._m_rows = _BATCH_ROWS.labels(method=method)
         self._m_wait = _BATCH_WAIT.labels(method=method)
@@ -201,7 +221,10 @@ class _Batcher:
         spans under it (each batch-level stage appears once per member
         request, so every trace tree is complete on its own).
         """
-        from tpu_dist_nn.utils.errors import UnavailableError
+        from tpu_dist_nn.utils.errors import (
+            ResourceExhaustedError,
+            UnavailableError,
+        )
 
         item = {"x": x, "done": threading.Event(), "out": None, "err": None,
                 "abandoned": False,
@@ -210,10 +233,26 @@ class _Batcher:
                 "ctx": ctx if ctx is not None and ctx.sampled else None}
         t_submit = time.monotonic()
         item["t_submit"] = t_submit
+        n = len(x)
         with self._cond:
             if self._closed:
                 raise UnavailableError("server is shutting down")
+            # Admission control: past the watermark, shed NOW with a
+            # back-off signal instead of queueing work the device is
+            # already minutes behind on. An oversized request against
+            # an EMPTY queue is admitted — it could otherwise never
+            # run, and the watermark bounds backlog, not batch size.
+            if (self._max_pending_rows is not None and self._pending
+                    and self.pending_rows + n > self._max_pending_rows):
+                self.shed_total += 1
+                self._m_shed.inc()
+                raise ResourceExhaustedError(
+                    f"serving queue at capacity ({self.pending_rows} rows "
+                    f"pending, watermark {self._max_pending_rows}); "
+                    "back off and retry"
+                )
             self._pending.append(item)
+            self.pending_rows += n
             self.requests_total += 1
             self._cond.notify()
         self._m_submits.inc()
@@ -347,6 +386,9 @@ class _Batcher:
                     or rows + len(self._pending[0]["x"]) <= self._max_rows
                 ):
                     it = self._pending.popleft()
+                    # Popped (computed OR discarded): either way these
+                    # rows leave the admission ledger.
+                    self.pending_rows -= len(it["x"])
                     if it["abandoned"]:  # caller timed out; don't compute
                         continue
                     rows += len(it["x"])
@@ -445,16 +487,35 @@ class _Batcher:
                 return
             self._drain_one(*item)
 
-    def close(self) -> None:
+    def close(self, timeout: float = 10.0) -> None:
+        from tpu_dist_nn.utils.errors import UnavailableError
+
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         # Dispatch drains _pending then pills the drain queue; drain
         # finishes every launched batch before exiting — both stages
         # empty by the time close returns.
-        self._dispatch_thread.join(timeout=10)
+        self._dispatch_thread.join(timeout=timeout)
         if self._drain_thread is not None:
-            self._drain_thread.join(timeout=10)
+            self._drain_thread.join(timeout=timeout)
+        # Fail over anything STILL pending (a wedged dispatch never
+        # popped it): its waiters would otherwise sit out their full
+        # submit timeout against a batcher that is already gone. Pops
+        # under the lock, so a still-alive dispatch thread and this
+        # sweep never double-serve an entry.
+        leftovers = []
+        with self._cond:
+            while self._pending:
+                it = self._pending.popleft()
+                self.pending_rows -= len(it["x"])
+                if not it["abandoned"]:
+                    leftovers.append(it)
+        for it in leftovers:
+            it["err"] = UnavailableError(
+                "server shut down before this request was served"
+            )
+            it["done"].set()
 
 
 def _request_span(context, method: str):
@@ -513,6 +574,7 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
     from tpu_dist_nn.utils.errors import (
         DeadlineExceededError,
         InvalidArgumentError,
+        ResourceExhaustedError,
         UnavailableError,
     )
 
@@ -523,6 +585,10 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
         # Batcher wait expired (wedged engine): the reference's
         # per-RPC timeout semantics (grpc_node.py:133).
         _abort(context, method, grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+    if isinstance(e, ResourceExhaustedError):
+        # Admission-control shed: the queue is at its watermark — the
+        # server is healthy and asking this client to back off.
+        _abort(context, method, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
     if isinstance(e, UnavailableError):
         # Engine torn down mid-flight: the reference's dead-channel
         # semantics (clients may retry elsewhere).
@@ -531,15 +597,18 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
     _abort(context, method, grpc.StatusCode.INTERNAL, f"{what} failed: {e}")
 
 
-def _new_grpc_server(max_workers: int):
+def _new_grpc_server(max_workers: int, interceptors=()):
     """The reference's server shape: thread pool + unlimited messages
-    (grpc_node.py:169, run_grpc_inference.py:124-127)."""
+    (grpc_node.py:169, run_grpc_inference.py:124-127). ``interceptors``
+    is the fault-injection seam (testing/faults.FaultInterceptor) —
+    empty in production."""
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[
             ("grpc.max_send_message_length", -1),
             ("grpc.max_receive_message_length", -1),
         ],
+        interceptors=tuple(interceptors),
     )
 
 
@@ -656,7 +725,9 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
                  host: str = "0.0.0.0", coalesce: bool = True,
                  max_batch_rows: int = 65536, warm_rows: int = 0,
                  submit_timeout: float | None = 120.0,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 max_pending_rows: int | None = None,
+                 interceptors=()):
     """Start a gRPC server bound to ``host:port``; returns
     ``(server, bound_port)`` (``port=0`` picks an ephemeral port;
     ``host="127.0.0.1"`` keeps self-checks off the network).
@@ -685,11 +756,19 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
     double-buffered default: batch N+1 stages and launches while batch
     N materializes; 1 = the strictly serial legacy loop, kept as the
     A/B control arm for ``bench.py --overlap``).
+
+    ``max_pending_rows`` is the admission-control watermark (``tdn up
+    --max-pending-rows``): a submit that would queue past it is shed
+    with RESOURCE_EXHAUSTED instead of joining an unbounded backlog
+    (None = unbounded, the legacy behavior). ``interceptors`` are gRPC
+    server interceptors — the fault-injection seam
+    (:mod:`tpu_dist_nn.testing.faults`).
     """
-    server = _new_grpc_server(max_workers)
+    server = _new_grpc_server(max_workers, interceptors)
     batcher = (
         _Batcher(engine, max_batch_rows, submit_timeout,
-                 pipeline_depth=pipeline_depth)
+                 pipeline_depth=pipeline_depth,
+                 max_pending_rows=max_pending_rows)
         if coalesce else None
     )
     if coalesce and warm_rows > 0:
@@ -775,7 +854,9 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
                       host: str = "0.0.0.0", max_workers: int = 10,
                       coalesce: bool = True, warm_rows: int = 0,
                       submit_timeout: float | None = 120.0,
-                      pipeline_depth: int = 2):
+                      pipeline_depth: int = 2,
+                      max_pending_rows: int | None = None,
+                      interceptors=()):
     """Serve LM GENERATION over the reference wire (VERDICT r4 item 7:
     the continuous-batching decoder behind a serving endpoint).
 
@@ -875,10 +956,11 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
             # pipelined runner above).
             return jnp.concatenate([jnp.asarray(rows, out.dtype), out], axis=1)
 
-    server = _new_grpc_server(max_workers)
+    server = _new_grpc_server(max_workers, interceptors)
     batcher = (
         _Batcher(None, 65536, submit_timeout, run_fn=run, method="Generate",
-                 pipeline_depth=pipeline_depth)
+                 pipeline_depth=pipeline_depth,
+                 max_pending_rows=max_pending_rows)
         if coalesce else None
     )
     lock = threading.Lock()
@@ -913,15 +995,45 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
     return server, bound
 
 
+_CLIENT_DEFAULT = object()  # "use the built-in default" sentinel
+
+
 class GrpcClient:
     """Minimal client for the Process RPC — the ``tdn infer --target``
     transport (the reference client's ``run_batch_inference`` analogue,
     ``run_grpc_inference.py:112-158``: one persistent channel, unlimited
-    message sizes, float64 rows)."""
+    message sizes, float64 rows).
 
-    def __init__(self, target: str, timeout: float = 30.0):
+    Resilient by default (docs/ROBUSTNESS.md): a transient failure
+    (UNAVAILABLE / DEADLINE_EXCEEDED) is retried under a
+    :class:`~tpu_dist_nn.serving.resilience.RetryPolicy` with capped
+    jittered backoff, every attempt's deadline carved from the
+    REMAINING ``timeout`` (a retried call never exceeds the budget of
+    the original); a per-target
+    :class:`~tpu_dist_nn.serving.resilience.CircuitBreaker` fails fast
+    with :class:`~tpu_dist_nn.utils.errors.UnavailableError` while the
+    target is known-dead. Pass ``retry=None`` / ``breaker=None`` to
+    opt out (the reference's one-attempt behavior).
+
+    ``wait_for_ready=True`` blocks construction on channel readiness
+    (the reference orchestrator's TCP poll, run_grpc_fcnn.py:157-172)
+    for up to ``ready_timeout`` seconds, raising ``UnavailableError``
+    on expiry — instead of the first RPC silently eating the connect
+    latency or failing with an opaque UNAVAILABLE.
+    """
+
+    def __init__(self, target: str, timeout: float = 30.0, *,
+                 retry=_CLIENT_DEFAULT, breaker=_CLIENT_DEFAULT,
+                 wait_for_ready: bool = False, ready_timeout: float = 5.0):
+        from tpu_dist_nn.serving.resilience import CircuitBreaker, RetryPolicy
+
         self.target = target
         self.timeout = timeout
+        self._retry = RetryPolicy() if retry is _CLIENT_DEFAULT else retry
+        self._breaker = (
+            CircuitBreaker.for_target(target)
+            if breaker is _CLIENT_DEFAULT else breaker
+        )
         self._channel = grpc.insecure_channel(
             target,
             options=[
@@ -929,6 +1041,19 @@ class GrpcClient:
                 ("grpc.max_receive_message_length", -1),
             ],
         )
+        if wait_for_ready:
+            from tpu_dist_nn.utils.errors import UnavailableError
+
+            fut = grpc.channel_ready_future(self._channel)
+            try:
+                fut.result(timeout=ready_timeout)
+            except grpc.FutureTimeoutError:
+                fut.cancel()
+                self._channel.close()
+                raise UnavailableError(
+                    f"server at {target} not ready within {ready_timeout}s "
+                    "(readiness poll timed out; is it up?)"
+                ) from None
         self._call = self._channel.unary_unary(
             PROCESS_METHOD,
             request_serializer=bytes,
@@ -940,42 +1065,144 @@ class GrpcClient:
             response_deserializer=bytes,
         )
 
-    def _traced_call(self, call, method: str, payload: bytes) -> bytes:
-        """One RPC under a client span: the trace context and the
-        remaining-budget hint ride the metadata out; a failure comes
-        back NAMING the server-side trace (``e.server_trace_id``) so
-        the operator pulls exactly the right span tree from
-        ``/trace`` instead of guessing from timestamps."""
-        span = _trace.TRACER.start(f"client.{method}")
-        metadata = ((_trace.TRACE_HEADER, span.ctx.header()),)
-        if self.timeout is not None:
-            # Deadline-derived remaining-time hint: the whole client
-            # budget at send time (the grpc-timeout analogue, readable
-            # by the batcher even where a proxy rewrites deadlines).
-            metadata += (
-                (_trace.TIMEOUT_HEADER, str(int(self.timeout * 1000))),
-            )
+    @staticmethod
+    def _enrich(e, span) -> tuple:
+        """Attach ``server_trace_id`` + extract the status code from a
+        failed RPC (best-effort — in-process fakes may lack both)."""
+        trace_id = span.ctx.trace_id  # the id we propagated
         try:
-            return call(payload, timeout=self.timeout, metadata=metadata)
-        except grpc.RpcError as e:
-            trace_id = span.ctx.trace_id  # the id we propagated
-            try:
-                for k, v in e.trailing_metadata() or ():
-                    if k == _trace.TRACE_ID_HEADER:
-                        trace_id = v  # the server's own root, if any
-            except Exception:  # noqa: BLE001 — best-effort enrichment
-                pass
-            e.server_trace_id = trace_id
-            code = None
-            try:
-                code = e.code()
-            except Exception:  # noqa: BLE001
-                pass
-            span.annotate(f"rpc error {code}: server trace {trace_id}")
-            log.warning("%s RPC to %s failed (%s) — server trace id %s; "
-                        "pull it with `tdn trace --target <metrics-port>`",
-                        method, self.target, code, trace_id)
-            raise
+            for k, v in e.trailing_metadata() or ():
+                if k == _trace.TRACE_ID_HEADER:
+                    trace_id = v  # the server's own root, if any
+        except Exception:  # noqa: BLE001 — best-effort enrichment
+            pass
+        e.server_trace_id = trace_id
+        code = None
+        try:
+            code = e.code()
+        except Exception:  # noqa: BLE001
+            pass
+        return code, trace_id
+
+    def _traced_call(self, call, method: str, payload: bytes) -> bytes:
+        """One LOGICAL call (original attempt + bounded retries) under
+        one client span: the trace context and the remaining-budget
+        hint ride the metadata out on every attempt; a final failure
+        comes back NAMING the server-side trace (``e.server_trace_id``)
+        so the operator pulls exactly the right span tree from
+        ``/trace`` instead of guessing from timestamps. Retried
+        attempts are annotated onto the span and counted in
+        ``tdn_client_retries_total``."""
+        from tpu_dist_nn.serving.resilience import CLIENT_RETRIES
+        from tpu_dist_nn.utils.errors import UnavailableError
+
+        policy, breaker = self._retry, self._breaker
+        span = _trace.TRACER.start(f"client.{method}")
+        deadline = (
+            time.monotonic() + self.timeout if self.timeout is not None
+            else None
+        )
+        attempt = 0
+        last_err = None
+        try:
+            while True:
+                attempt += 1
+                if breaker is not None and not breaker.allow():
+                    span.annotate(f"breaker open for {self.target}: fail-fast")
+                    raise UnavailableError(
+                        f"circuit breaker open for {self.target} (too many "
+                        "consecutive failures; cooling down)"
+                    )
+                remaining = None
+                if deadline is not None:
+                    # Budget carving: this attempt gets whatever the
+                    # ORIGINAL call has left, never a fresh window.
+                    remaining = deadline - time.monotonic()
+                    if last_err is not None and remaining <= 0.001:
+                        # A backoff sleep overshot the budget: re-raise
+                        # the last REAL outcome instead of issuing a
+                        # ~0ms attempt that fails client-side and
+                        # counts a phantom failure against the breaker.
+                        span.annotate(
+                            f"retry budget exhausted before attempt {attempt}"
+                        )
+                        raise last_err
+                metadata = ((_trace.TRACE_HEADER, span.ctx.header()),)
+                if remaining is not None:
+                    # Remaining-budget hint (the grpc-timeout analogue,
+                    # readable by the batcher even where a proxy
+                    # rewrites deadlines).
+                    metadata += (
+                        (_trace.TIMEOUT_HEADER,
+                         str(max(0, int(remaining * 1000)))),
+                    )
+                try:
+                    reply = call(payload, timeout=remaining,
+                                 metadata=metadata)
+                    if breaker is not None:
+                        breaker.record_success()
+                    if attempt > 1:
+                        span.annotate(f"succeeded on attempt {attempt}")
+                    return reply
+                except grpc.RpcError as e:
+                    from tpu_dist_nn.serving.resilience import (
+                        RETRYABLE_CODES,
+                        _code_name,
+                    )
+
+                    code, trace_id = self._enrich(e, span)
+                    last_err = e
+                    # Transience classification feeds the breaker even
+                    # with retries disabled (a no-retry client still
+                    # learns the target is down); only TRANSIENT
+                    # statuses say anything about target health —
+                    # INVALID_ARGUMENT must not trip the breaker.
+                    transient = (
+                        policy.retryable(code) if policy is not None
+                        else _code_name(code) in RETRYABLE_CODES
+                    )
+                    if breaker is not None:
+                        if transient:
+                            breaker.record_failure()
+                        else:
+                            # A non-transient status means the target
+                            # RESPONDED — reachability evidence. This
+                            # also closes the half-open probe instead
+                            # of leaving it wedged (a probe answered
+                            # INVALID_ARGUMENT proves the server is
+                            # back even though the request was bad).
+                            breaker.record_success()
+                    retryable = policy is not None and transient
+                    out_of_attempts = (
+                        policy is None or attempt >= policy.max_attempts
+                    )
+                    delay = 0.0 if out_of_attempts else policy.backoff(attempt)
+                    out_of_budget = (
+                        deadline is not None
+                        and time.monotonic() + delay >= deadline
+                    )
+                    if not retryable or out_of_attempts or out_of_budget:
+                        why = (
+                            "not retryable" if not retryable
+                            else "attempts exhausted" if out_of_attempts
+                            else "retry budget exhausted"
+                        )
+                        span.annotate(
+                            f"rpc error {code} on attempt {attempt} ({why}): "
+                            f"server trace {trace_id}"
+                        )
+                        log.warning(
+                            "%s RPC to %s failed (%s, attempt %d, %s) — "
+                            "server trace id %s; pull it with `tdn trace "
+                            "--target <metrics-port>`",
+                            method, self.target, code, attempt, why, trace_id,
+                        )
+                        raise
+                    CLIENT_RETRIES.labels(method=method).inc()
+                    span.annotate(
+                        f"retry {attempt} after {code}: backoff {delay:.4f}s"
+                    )
+                    policy.sleep(delay)
         finally:
             span.end()
 

@@ -1,0 +1,212 @@
+"""Deterministic fault injection for the serving resilience tests.
+
+Chaos tooling that needs no monkeypatching: the hook points are
+first-class — :class:`~tpu_dist_nn.api.engine.Engine` exposes
+``launch_hook`` / ``fetch_hook`` attributes called at the top of
+``infer_async`` / ``fetch``, and the gRPC servers accept
+``interceptors=`` — so a test (or a staging chaos run) attaches a
+:class:`FaultPlan` and every "the Nth request fails UNAVAILABLE"
+scenario replays bit-for-bit.
+
+A plan is a call-counting schedule: explicit ``{n: fault}`` entries
+and/or an ``every=k`` cadence, evaluated in call order under a lock so
+concurrent callers still see one deterministic global sequence.
+Faults are built by the small factories below::
+
+    from tpu_dist_nn.testing import faults
+
+    plan = faults.FaultPlan(every=3, fault=faults.unavailable())
+    faults.inject_engine_faults(engine, launch=plan)     # Nth launch dies
+    server, port = serve_engine(engine, 0,
+                                interceptors=(faults.FaultInterceptor(
+                                    faults.FaultPlan(at={2: faults.delay(0.02)})),))
+
+``tests/test_resilience.py`` and the quick-tier chaos smoke drive the
+retry / breaker / shed / drain proofs through exactly these hooks;
+docs/ROBUSTNESS.md has the operator-facing how-to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+
+from tpu_dist_nn.utils.errors import (
+    DeadlineExceededError,
+    InternalError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One injected behavior: optionally hold for ``seconds``, then
+    raise ``error(message)`` (or pass, for a pure delay). ``kind`` is
+    cosmetic except for ``drop``, which the gRPC interceptor renders as
+    hold-then-kill-without-processing (the connection-cut analogue —
+    a client with a shorter deadline sees DEADLINE_EXCEEDED)."""
+
+    kind: str = "error"  # "error" | "delay" | "drop"
+    error: type | None = None
+    message: str = ""
+    seconds: float = 0.0
+
+    def fire(self) -> None:
+        """The engine-hook form: delay and/or raise, in-process."""
+        if self.seconds:
+            time.sleep(self.seconds)
+        if self.error is not None:
+            raise self.error(self.message or f"injected {self.kind}")
+
+    def grpc_code(self):
+        """The status the interceptor aborts with (lazy import keeps
+        this module importable where grpc is absent)."""
+        import grpc
+
+        name = getattr(self.error, "code", "UNAVAILABLE")
+        return getattr(grpc.StatusCode, name, grpc.StatusCode.UNAVAILABLE)
+
+
+def unavailable(message: str = "injected UNAVAILABLE") -> Fault:
+    return Fault(error=UnavailableError, message=message)
+
+
+def deadline_exceeded(message: str = "injected DEADLINE_EXCEEDED") -> Fault:
+    return Fault(error=DeadlineExceededError, message=message)
+
+
+def internal(message: str = "injected INTERNAL") -> Fault:
+    return Fault(error=InternalError, message=message)
+
+
+def resource_exhausted(message: str = "injected RESOURCE_EXHAUSTED") -> Fault:
+    return Fault(error=ResourceExhaustedError, message=message)
+
+
+def delay(seconds: float) -> Fault:
+    return Fault(kind="delay", seconds=seconds)
+
+
+def drop(hold: float = 0.2) -> Fault:
+    """Hold the request ``hold`` seconds, then kill it unprocessed —
+    pair with a client deadline shorter than ``hold`` to model a
+    dropped/blackholed request deterministically."""
+    return Fault(kind="drop", error=UnavailableError,
+                 message="injected drop (request never processed)",
+                 seconds=hold)
+
+
+class FaultPlan:
+    """Deterministic call-indexed schedule of :class:`Fault`\\ s.
+
+    ``at={n: fault}`` names exact 1-based call numbers; ``every=k``
+    (with ``fault=``) additionally faults every k-th call not already
+    named. The counter is global to the plan and lock-protected, so a
+    plan shared by concurrent request threads still yields ONE
+    reproducible sequence (call order is the only nondeterminism, and
+    tests that need strict ordering drive requests serially).
+    """
+
+    def __init__(self, at: dict[int, Fault] | None = None,
+                 every: int | None = None, fault: Fault | None = None):
+        if every is not None and every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        if every is not None and fault is None:
+            raise ValueError("every= needs fault= (what to inject)")
+        self._at = dict(at or {})
+        self._every = every
+        self._fault = fault
+        self._count = itertools.count(1)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.fired = 0
+
+    def next_fault(self) -> Fault | None:
+        """Advance the call counter; the fault for THIS call, if any."""
+        with self._lock:
+            n = next(self._count)
+            self.calls = n
+            f = self._at.get(n)
+            if f is None and self._every is not None and n % self._every == 0:
+                f = self._fault
+            if f is not None:
+                self.fired += 1
+            return f
+
+    def fire(self, *_args, **_kwargs) -> None:
+        """Count one call and fire its fault (if scheduled). Signature
+        swallows arguments so a plan attaches DIRECTLY as an engine
+        ``launch_hook`` / ``fetch_hook``."""
+        f = self.next_fault()
+        if f is not None:
+            f.fire()
+
+
+def inject_engine_faults(engine, launch: FaultPlan | None = None,
+                         fetch: FaultPlan | None = None):
+    """Attach plans to an engine's first-class hook points (no
+    monkeypatching — the attributes exist for exactly this). Returns
+    the engine for chaining; pass ``None`` to leave a hook unset, and
+    reset with ``clear_engine_faults``."""
+    if launch is not None:
+        engine.launch_hook = launch.fire
+    if fetch is not None:
+        engine.fetch_hook = fetch.fire
+    return engine
+
+
+def clear_engine_faults(engine) -> None:
+    engine.launch_hook = None
+    engine.fetch_hook = None
+
+
+def wrap(fn, plan: FaultPlan):
+    """Fault-wrap any callable (e.g. the ``run_fn`` the LM generation
+    batcher uses where there is no Engine): count, maybe fire, then
+    delegate."""
+
+    def faulty(*args, **kwargs):
+        plan.fire()
+        return fn(*args, **kwargs)
+
+    return faulty
+
+
+def make_interceptor(plan: FaultPlan):
+    """The gRPC server interceptor form: drops/delays/errors the Nth
+    REQUEST (before the handler runs, so the batcher never sees it).
+    Built lazily so this module imports without grpc installed."""
+    import grpc
+
+    class FaultInterceptor(grpc.ServerInterceptor):
+        def __init__(self, p: FaultPlan):
+            self._plan = p
+
+        def intercept_service(self, continuation, handler_call_details):
+            f = self._plan.next_fault()
+            if f is None:
+                return continuation(handler_call_details)
+            if f.kind == "delay" and f.error is None:
+                if f.seconds:
+                    time.sleep(f.seconds)
+                return continuation(handler_call_details)
+            code = f.grpc_code()
+
+            def aborting(request, context):
+                if f.seconds:
+                    time.sleep(f.seconds)
+                context.abort(code, f.message or "injected fault")
+
+            return grpc.unary_unary_rpc_method_handler(
+                aborting, request_deserializer=bytes,
+                response_serializer=bytes,
+            )
+
+    return FaultInterceptor(plan)
+
+
+# Alias matching the class-style spelling used in docs/tests.
+FaultInterceptor = make_interceptor

@@ -61,6 +61,17 @@ class UnavailableError(FrameworkError, RuntimeError):
     code = "UNAVAILABLE"
 
 
+class ResourceExhaustedError(FrameworkError, RuntimeError):
+    """Admission control shed: the serving queue is at its pending-rows
+    watermark. Distinct from UNAVAILABLE ("retry elsewhere" — the
+    target is gone) and DEADLINE_EXCEEDED (admitted but too slow): the
+    server is healthy and explicitly asking this client to back off
+    and retry HERE later. The reference had no backpressure story at
+    all — overload just queued until something timed out."""
+
+    code = "RESOURCE_EXHAUSTED"
+
+
 def check_full_batch(num_examples: int, batch_size: int) -> None:
     """Fail fast when ``drop_remainder`` batching would yield zero
     batches — shared by every trainer's epoch loop."""
