@@ -624,6 +624,7 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
             grpcs = 4
             lock = _th.Lock()
             done: list[int] = []
+            glats: list[float] = []
             gerrors: list[str] = []
             # Prompts drawn on THIS thread: np.random.Generator is not
             # thread-safe (run_concurrent follows the same rule).
@@ -634,10 +635,13 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
 
             def gworker(i):
                 ok = 0
+                mine: list[float] = []
                 try:
                     c = GrpcClient(f"127.0.0.1:{gport}")
                     for _ in range(grpcs):
+                        t0 = time.monotonic()
                         c.generate(gprompts[i])
+                        mine.append(time.monotonic() - t0)
                         ok += 1
                     c.close()
                 except Exception as e:  # noqa: BLE001 — recorded
@@ -646,6 +650,7 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
                 finally:
                     with lock:
                         done.append(ok)
+                        glats.extend(mine)
 
             threads = [
                 _th.Thread(target=gworker, args=(i,))
@@ -663,14 +668,41 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
                     f"all generate workers failed: {gerrors[:3]}"
                 )
             gb = gsrv.batcher
+            lat = np.asarray(glats)
             out["generate"] = {
                 "model": "d128/h4/L4 byte-vocab toy",
                 "prompt_len": t_len, "max_new_tokens": n_new,
+                "scheduler": (
+                    "continuous" if getattr(gsrv, "scheduler", None)
+                    is not None else "static"
+                ),
                 "requests_per_s": round(n_req / wall, 1),
                 "generated_tokens_per_s": round(n_req * n_new / wall, 1),
+                # Per-request wire latency (decode + queueing), the
+                # figure run-to-completion batching could never break
+                # down per request.
+                "request_p50_ms": round(
+                    float(np.percentile(lat, 50)) * 1e3, 2
+                ),
+                "request_p99_ms": round(
+                    float(np.percentile(lat, 99)) * 1e3, 2
+                ),
                 "requests": gb.requests_total,
                 "batches": gb.batches_total,
             }
+            sched = getattr(gsrv, "scheduler", None)
+            if sched is not None and len(sched.ttft_recent):
+                ttft = np.asarray(sched.ttft_recent)
+                out["generate"]["ttft_p50_ms"] = round(
+                    float(np.percentile(ttft, 50)) * 1e3, 2
+                )
+                out["generate"]["ttft_p99_ms"] = round(
+                    float(np.percentile(ttft, 99)) * 1e3, 2
+                )
+                out["generate"]["slot_occupancy"] = round(
+                    sched.slot_steps_total
+                    / max(sched.steps_total * sched.slots, 1), 3
+                )
             if gerrors:
                 out["generate"]["completed"] = n_req
                 out["generate"]["errors"] = gerrors[:3]
@@ -832,6 +864,257 @@ def overlap_main() -> int:
                           "(gRPC loopback, flagship FCNN)",
                 "value": ab["overlapped"]["rows_per_sec"],
                 "unit": "rows/sec",
+                "backend": backend,
+                "device_kind": device_kind or "host cpu",
+                **ab,
+            }
+        )
+    )
+    return 0
+
+
+def gen_ab_bench(jax=None, *, slots: int = 8, requests: int = 16,
+                 prompt_len: int = 16, max_new: int = 32,
+                 short_budget: int = 4, arrival_gap_s: float = 0.02,
+                 controlled_step_cost: float | None = None,
+                 model=None, eos_id=None) -> dict:
+    """Static-vs-continuous generation scheduler A/B under STAGGERED
+    arrivals with MIXED per-request token budgets (the ISSUE 5
+    acceptance measurement, and the CI smoke's injectable harness).
+
+    ``requests`` one-row requests arrive ``arrival_gap_s`` apart; odd
+    arrivals want only ``short_budget`` tokens, even ones the full
+    ``max_new``. The static arm is the legacy run-to-completion path
+    (``_Batcher`` in front of one ``generate()`` scan): every batch
+    decodes ALL ``max_new`` steps and late arrivals convoy behind it,
+    so a short request pays for its longest neighbor. The continuous
+    arm admits at step granularity and retires each row at its own
+    budget. Reported per arm: throughput (requests/s and USEFUL
+    tokens/s — the tokens callers asked for), per-request latency
+    p50/p99, and TTFT p50/p99 (continuous: submit → first sampled
+    token; static: run-to-completion delivers all tokens at once, so
+    its TTFT *is* the full request latency — the number this PR
+    exists to break down).
+
+    ``controlled_step_cost`` switches to the deterministic cost-model
+    regime (the quick-tier CI smoke): fake kernels that sleep a fixed
+    per-decode-step cost, so the A/B isolates the SCHEDULING policy
+    from model size and host jitter. The real-model regime
+    (``controlled_step_cost=None``) sizes the toy LM so device compute
+    dominates per-step dispatch (docs/PERF.md "Continuous batching:
+    A/B methodology").
+    """
+    import threading
+
+    from tpu_dist_nn.serving.continuous import ContinuousScheduler
+    from tpu_dist_nn.serving.server import _Batcher
+
+    rng = np.random.default_rng(0)
+    budgets = [
+        short_budget if i % 2 else max_new for i in range(requests)
+    ]
+    T = prompt_len
+
+    if controlled_step_cost is not None:
+        cost = float(controlled_step_cost)
+        prompts = [rng.integers(0, 64, (1, T)) for _ in range(requests)]
+
+        def fake_prefill(params, cache, slot, tokens, key):
+            time.sleep(cost)
+            return np.int32(1), cache
+
+        def fake_step(params, cache, pos, active, tok, key):
+            time.sleep(cost)
+            return np.asarray(tok) + 1, cache
+
+        def make_continuous():
+            return ContinuousScheduler(
+                None, None, slots=slots, prompt_len=T,
+                max_new_tokens=max_new, prefill_fn=fake_prefill,
+                step_fn=fake_step,
+            )
+
+        def static_run(rows):
+            # Run-to-completion cost model: one prefill + max_new steps
+            # regardless of what any row actually asked for (the decode
+            # scan has a fixed trip count) — per-step cost identical to
+            # the continuous arm's, so the delta is pure scheduling.
+            time.sleep(cost * (max_new + 1))
+            return np.concatenate(
+                [np.asarray(rows), np.ones((len(rows), max_new), np.int64)],
+                axis=1,
+            )
+    else:
+        import jax
+
+        from tpu_dist_nn.models.generate import generate
+        from tpu_dist_nn.models.transformer import (
+            TransformerConfig,
+            init_transformer,
+        )
+
+        if model is not None:
+            cfg, params = model
+        else:
+            # Sized so per-step device compute dominates per-step host
+            # dispatch (the regime where iteration-level scheduling's
+            # saved steps convert into wall time; see docs/PERF.md).
+            cfg = TransformerConfig(
+                vocab_size=256, d_model=256, n_heads=4, n_layers=4,
+                d_ff=1024, max_seq_len=T + max_new,
+            )
+            params = init_transformer(jax.random.key(0), cfg)
+        prompts = [
+            rng.integers(0, cfg.vocab_size, (1, T)) for _ in range(requests)
+        ]
+
+        def make_continuous():
+            sched = ContinuousScheduler(
+                params, cfg, slots=slots, prompt_len=T,
+                max_new_tokens=max_new, eos_id=eos_id,
+            )
+            sched.warm()
+            return sched
+
+        def static_run(rows):
+            out = generate(
+                params, cfg, np.asarray(rows, np.int32), max_new,
+                eos_id=eos_id,
+            )
+            import jax.numpy as jnp
+
+            return np.asarray(
+                jnp.concatenate(
+                    [jnp.asarray(rows, out.dtype), out], axis=1
+                )
+            )
+
+    def drive(submit) -> dict:
+        """Fire the staggered-arrival schedule at one arm's submit fn
+        (row, budget) -> full sequence; returns the arm's scorecard."""
+        lats: list[tuple[int, float]] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+
+        def worker(i):
+            time.sleep(i * arrival_gap_s)
+            t0 = time.monotonic()
+            try:
+                submit(prompts[i], budgets[i])
+            except Exception as e:  # noqa: BLE001 — recorded, not hidden
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+                return
+            with lock:
+                lats.append((i, time.monotonic() - t0))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(requests)
+        ]
+        t0 = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t0
+        if errors:
+            raise RuntimeError(f"gen A/B workers failed: {errors[:3]}")
+        arr = np.asarray([d for _, d in lats])
+        useful = sum(budgets[i] for i, _ in lats)
+        return {
+            "wall_s": round(wall, 3),
+            "rps": round(len(lats) / wall, 2),
+            "useful_tokens_per_s": round(useful / wall, 1),
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+        }
+
+    # Static arm: the legacy coalescing batcher in front of the
+    # run-to-completion decode (pipeline_depth=1 — the decode IS the
+    # whole critical section here, overlap is not what this A/B
+    # measures). In the controlled regime the fake per-step cost does
+    # not scale with rows, which would model an infinitely wide device
+    # — cap the static arm's batch at the SAME ``slots`` width the
+    # continuous arm owns, so both arms run the same machine and the
+    # delta is pure scheduling (real models scale per-row on their
+    # own).
+    batcher = _Batcher(
+        None,
+        slots if controlled_step_cost is not None else 65536,
+        120.0, run_fn=static_run, method="Generate",
+        pipeline_depth=1,
+    )
+    if controlled_step_cost is None:
+        # Warm every pow2 bucket the coalescer can hit: an unwarmed
+        # bucket would drop an XLA compile into the STATIC arm's timed
+        # window and hand continuous an unearned win.
+        n = 1
+        while n <= requests:
+            static_run(np.zeros((n, T), np.int64))
+            n *= 2
+    try:
+        static = drive(lambda row, budget: batcher.submit(np.asarray(row)))
+    finally:
+        batcher.close()
+    # Run-to-completion returns every token at once: TTFT == latency.
+    static["ttft_p50_ms"] = static["p50_ms"]
+    static["ttft_p99_ms"] = static["p99_ms"]
+
+    sched = make_continuous()
+    try:
+        continuous = drive(
+            lambda row, budget: sched.submit(row, max_new_tokens=budget)
+        )
+        ttft = np.asarray(sched.ttft_recent)
+        continuous["ttft_p50_ms"] = round(
+            float(np.percentile(ttft, 50)) * 1e3, 2
+        )
+        continuous["ttft_p99_ms"] = round(
+            float(np.percentile(ttft, 99)) * 1e3, 2
+        )
+        continuous["steps"] = sched.steps_total
+        continuous["slot_occupancy"] = round(
+            sched.slot_steps_total / max(sched.steps_total * slots, 1), 3
+        )
+        continuous["retired"] = sched.retired_total
+    finally:
+        sched.close()
+
+    return {
+        "static": static,
+        "continuous": continuous,
+        "continuous_vs_static_rps": round(
+            continuous["rps"] / static["rps"], 3
+        ),
+        "continuous_vs_static_p99": round(
+            continuous["p99_ms"] / static["p99_ms"], 3
+        ),
+        "slots": slots,
+        "requests": requests,
+        "prompt_len": T,
+        "max_new_tokens": max_new,
+        "budgets_mix": [short_budget, max_new],
+        "arrival_gap_s": arrival_gap_s,
+        "regime": (
+            f"controlled per-step cost {controlled_step_cost}s"
+            if controlled_step_cost is not None else "real model"
+        ),
+    }
+
+
+def gen_ab_main() -> int:
+    """``bench.py --gen-ab``: the staggered-arrival static-vs-continuous
+    generation scheduler A/B as one JSON line."""
+    jax, _jnp, backend, device_kind, _ = _bring_up()
+    ab = gen_ab_bench(jax)
+    print(
+        json.dumps(
+            {
+                "metric": "continuous-vs-static generation scheduling A/B "
+                          "(staggered arrivals, mixed budgets)",
+                "value": ab["continuous"]["useful_tokens_per_s"],
+                "unit": "useful tokens/sec",
                 "backend": backend,
                 "device_kind": device_kind or "host cpu",
                 **ab,
@@ -1077,6 +1360,8 @@ if __name__ == "__main__":
             sys.exit(serving_main())
         if "--overlap" in sys.argv:
             sys.exit(overlap_main())
+        if "--gen-ab" in sys.argv:
+            sys.exit(gen_ab_main())
         sys.exit(main())
     except BaseException as e:  # noqa: BLE001 — JSON error record, not a traceback
         if isinstance(e, SystemExit):

@@ -83,6 +83,10 @@ QUICK_TESTS = {
     "test_checkpoint": ["test_async_manager_saves_and_restores",
                         "test_manager_latest_and_retention",
                         "test_resume_noop_when_complete"],
+    "test_continuous": [
+        "test_continuous_matches_static_greedy_tokens",
+        "test_serve_continuous_loopback_parity_and_counters",
+        "test_gen_ab_smoke_continuous_beats_static"],
     "test_conv": ["test_conv_forward_matches_oracle",
                   "test_engine_routes_conv_model"],
     "test_conv_kernel": ["test_conv_matches_lax[stride1-same]",

@@ -1,0 +1,459 @@
+"""Continuous-batching decode scheduler (serving/continuous.py):
+static-scheduler output parity, slot reuse, per-request budgets,
+admission/close semantics, observability, the loopback endpoint, and
+the staggered-arrival static-vs-continuous A/B smoke."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp  # noqa: F401 — parity helpers
+import numpy as np
+import pytest
+
+from tpu_dist_nn.models.generate import generate
+from tpu_dist_nn.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+)
+from tpu_dist_nn.serving.continuous import ContinuousScheduler
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=3, d_ff=64, max_seq_len=48
+)
+PARAMS = init_transformer(jax.random.key(11), CFG)
+T, N = 8, 10
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG.vocab_size, (n, T))
+
+
+def _sched(**kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_len", T)
+    kw.setdefault("max_new_tokens", N)
+    return ContinuousScheduler(PARAMS, CFG, **kw)
+
+
+def _fake_sched(step_cost=0.0, **kw):
+    """Cost-model scheduler (no device work): the deterministic arm of
+    the admission/close/shed tests."""
+
+    def fake_prefill(params, cache, slot, tokens, key):
+        return np.int32(1), cache
+
+    def fake_step(params, cache, pos, active, tok, key):
+        if step_cost:
+            time.sleep(step_cost)
+        return np.asarray(tok) + 1, cache
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("prompt_len", T)
+    kw.setdefault("max_new_tokens", N)
+    return ContinuousScheduler(
+        None, None, prefill_fn=fake_prefill, step_fn=fake_step, **kw
+    )
+
+
+# ------------------------------------------------------------ parity
+
+
+def test_continuous_matches_static_greedy_tokens():
+    # The acceptance core: temperature=0 outputs are identical between
+    # the two schedulers — INCLUDING eos early-retire/pad semantics —
+    # with more rows than slots (so queueing + slot reuse are on the
+    # path) and requests arriving both as one multi-row submit and as
+    # concurrent single rows.
+    prompts = _prompts(6, seed=1)
+    base = np.asarray(generate(PARAMS, CFG, prompts, N))
+    eos = int(base[0, N // 2])
+    ref = np.asarray(generate(PARAMS, CFG, prompts, N, eos_id=eos))
+    want = np.concatenate([prompts, ref], axis=1)
+
+    sched = _sched(slots=4, eos_id=eos)
+    try:
+        out = sched.submit(prompts)
+        np.testing.assert_array_equal(out, want)
+        # Same prompts again as concurrent one-row requests.
+        outs = [None] * 6
+
+        def call(i):
+            outs[i] = sched.submit(prompts[i:i + 1])
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(6)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        for i in range(6):
+            np.testing.assert_array_equal(outs[i][0], want[i])
+        assert sched.retired_total == 12
+        assert sched.rows_total == 12
+    finally:
+        sched.close()
+
+
+def test_slot_reuse_does_not_leak_stale_kv():
+    # One slot, sequential occupants: every sequence must equal its
+    # fresh single-row decode — occupant k's K/V cannot contaminate
+    # occupant k+1 (the prefill overwrites the slot's full extent and
+    # attention masks beyond the frontier).
+    prompts = _prompts(3, seed=2)
+    sched = _sched(slots=1)
+    try:
+        for i in range(3):
+            out = sched.submit(prompts[i:i + 1])
+            ref = np.asarray(generate(PARAMS, CFG, prompts[i:i + 1], N))
+            np.testing.assert_array_equal(out[0, T:], ref[0])
+    finally:
+        sched.close()
+
+
+def test_per_request_budget_caps_and_pads():
+    prompts = _prompts(2, seed=3)
+    ref = np.asarray(generate(PARAMS, CFG, prompts, N))
+    sched = _sched(slots=2, eos_id=None)
+    try:
+        out = sched.submit(prompts, max_new_tokens=3)
+        # The 3 requested tokens match the full decode's first 3; the
+        # rest of the static-width row is pad (0 without an eos_id).
+        np.testing.assert_array_equal(out[:, T:T + 3], ref[:, :3])
+        assert (out[:, T + 3:] == 0).all()
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            sched.submit(prompts, max_new_tokens=N + 1)
+        with pytest.raises(ValueError, match="shape"):
+            sched.submit(np.zeros((1, T + 2), np.int32))
+    finally:
+        sched.close()
+
+
+def test_zero_row_submit_returns_empty_without_touching_the_loop():
+    # A (0, T) submit must answer immediately (the static batcher
+    # round-trips empty matrices too) — queueing it would hand the loop
+    # a rowless item that corrupts the pending ledger and kills the
+    # scheduler thread.
+    sched = _sched(slots=2)
+    try:
+        out = sched.submit(np.zeros((0, T), np.int32))
+        assert out.shape == (0, T + N)
+        assert sched.pending_rows == 0 and sched.requests_total == 0
+        # The scheduler is still fully alive for real work.
+        ref = np.asarray(generate(PARAMS, CFG, _prompts(1, seed=12), N))
+        np.testing.assert_array_equal(
+            sched.submit(_prompts(1, seed=12))[0, T:], ref[0]
+        )
+    finally:
+        sched.close()
+
+
+def test_sampled_generation_fresh_and_in_vocab():
+    # temperature > 0: repeated identical prompts draw fresh
+    # continuations (per-event key folds), everything stays in-vocab.
+    prompts = np.full((2, T), 5)
+    sched = _sched(slots=2, temperature=1.0, seed=3)
+    try:
+        a = sched.submit(prompts)
+        b = sched.submit(prompts)
+        assert not np.array_equal(a, b)
+        assert (a[:, T:] >= 0).all() and (a[:, T:] < CFG.vocab_size).all()
+    finally:
+        sched.close()
+
+
+def test_scheduler_validates_contract_at_construction():
+    with pytest.raises(ValueError, match="slots"):
+        _sched(slots=0)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        _sched(max_new_tokens=CFG.max_seq_len)
+    with pytest.raises(ValueError, match="top_k"):
+        _sched(temperature=0.0, top_k=5)
+    with pytest.raises(ValueError, match="eos_id"):
+        _sched(eos_id=CFG.vocab_size)
+    with pytest.raises(ValueError, match="together"):
+        ContinuousScheduler(
+            None, None, slots=1, prompt_len=T, max_new_tokens=N,
+            prefill_fn=lambda *a: None,
+        )
+
+
+# ------------------------------------------------------------ observability
+
+
+def test_metrics_ttft_occupancy_and_sampler_gauges():
+    from tpu_dist_nn.obs import RuntimeSampler
+    from tpu_dist_nn.obs.registry import REGISTRY
+
+    def total(name, label=None):
+        m = REGISTRY.get(name)
+        if m is None:
+            return 0.0
+        # samples() keys are label-VALUE tuples ((value,) here).
+        return float(sum(
+            c.value for k, c in m.samples()
+            if label is None or tuple(k) == (label,)
+        ))
+
+    tok0 = total("tdn_gen_tokens_total")
+    eos_retired0 = total("tdn_gen_requests_retired_total", "eos")
+    max_retired0 = total("tdn_gen_requests_retired_total", "max_tokens")
+    prompts = _prompts(4, seed=4)
+    base = np.asarray(generate(PARAMS, CFG, prompts, N))
+    eos = int(base[0, N // 2])
+    sched = _sched(slots=2, eos_id=eos)
+    try:
+        sched.submit(prompts)
+        # TTFT recorded per row, and the histogram family moved.
+        assert len(sched.ttft_recent) == 4
+        m = REGISTRY.get("tdn_gen_ttft_seconds")
+        assert m is not None
+        # Retire reasons: row 0 hit the stop token, so the eos counter
+        # moved; tokens counter moved by every emitted token.
+        assert total("tdn_gen_requests_retired_total", "eos") > eos_retired0
+        assert total("tdn_gen_requests_retired_total",
+                     "max_tokens") >= max_retired0
+        assert total("tdn_gen_tokens_total") > tok0
+        # The runtime sampler publishes the slot gauges.
+        sampler = RuntimeSampler()
+        sampler.add_generation_scheduler(sched)
+        sampler.add_batcher(sched, method="Generate")
+        sampler.sample_once()
+        occ = REGISTRY.get("tdn_gen_slot_occupancy_ratio")
+        assert occ is not None
+        vals = {tuple(k): c.value for k, c in occ.samples()}
+        assert 0.0 < list(vals.values())[0] <= 1.0
+        assert REGISTRY.get("tdn_gen_slots_active") is not None
+        assert sched.slot_steps_total <= sched.steps_total * sched.slots
+    finally:
+        sched.close()
+
+
+def test_traced_request_records_prefill_and_decode_spans():
+    from tpu_dist_nn.obs.trace import TRACER
+
+    span = TRACER.start("rpc.Generate")
+    assert span.ctx.sampled
+    sched = _sched(slots=2)
+    try:
+        sched.submit(_prompts(1, seed=5), ctx=span.ctx)
+    finally:
+        span.end()
+        sched.close()
+    names = {
+        s.name for s in TRACER.snapshot()
+        if s.trace_id == span.ctx.trace_id
+    }
+    assert {"queue_wait", "prefill", "decode.step", "decode"} <= names
+
+
+# ------------------------------------------------------------ admission
+
+
+def test_shed_at_watermark_and_oversized_admitted_when_empty():
+    from tpu_dist_nn.utils.errors import ResourceExhaustedError
+
+    # One slow slot: the first request occupies it for ~budget * cost
+    # seconds, so later arrivals deterministically queue behind it.
+    sched = _fake_sched(step_cost=0.05, slots=1, max_pending_rows=2)
+    outs, errs = [], []
+
+    def call(rows):
+        try:
+            outs.append(sched.submit(rows))
+        except Exception as e:  # noqa: BLE001 — collected
+            errs.append(e)
+
+    try:
+        t1 = threading.Thread(target=call, args=(_prompts(1, seed=6),))
+        t1.start()
+        deadline = time.monotonic() + 5
+        while sched.rows_total < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)  # row 1 resident in the slot
+        # 3 rows against an EMPTY queue: oversized vs the watermark but
+        # admitted anyway (the watermark bounds backlog, not size).
+        t2 = threading.Thread(target=call, args=(_prompts(3, seed=7),))
+        t2.start()
+        deadline = time.monotonic() + 5
+        while sched.pending_rows < 3 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        # Now the queue is past the watermark: the next submit sheds.
+        with pytest.raises(ResourceExhaustedError, match="watermark"):
+            sched.submit(_prompts(1, seed=8))
+        assert sched.shed_total == 1
+        t1.join(30)
+        t2.join(30)
+        assert len(outs) == 2 and not errs
+    finally:
+        sched.close()
+
+
+def test_close_fails_pending_over_and_post_close_submit_raises():
+    from tpu_dist_nn.utils.errors import UnavailableError
+
+    sched = _fake_sched(step_cost=0.05, slots=1)
+    errs, oks = [], []
+
+    def caller(i):
+        try:
+            oks.append(sched.submit(_prompts(1, seed=i)))
+        except Exception as e:  # noqa: BLE001 — collected
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=caller, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.08)  # first request resident, rest pending
+    sched.close()
+    for t in threads:
+        t.join(20)
+    # Resident work finished; still-pending waiters failed over.
+    assert len(oks) >= 1
+    assert len(errs) >= 1
+    assert all(isinstance(e, UnavailableError) for e in errs)
+    with pytest.raises(UnavailableError):
+        sched.submit(_prompts(1, seed=9))
+
+
+# ------------------------------------------------------------ endpoint
+
+
+def test_serve_continuous_loopback_parity_and_counters():
+    from tpu_dist_nn.serving import GrpcClient, serve_lm_generate
+
+    prompts = _prompts(5, seed=10)
+    base = np.asarray(generate(PARAMS, CFG, prompts, 6))
+    eos = int(base[0, 2])
+    ref = np.asarray(generate(PARAMS, CFG, prompts, 6, eos_id=eos))
+    server, port = serve_lm_generate(
+        PARAMS, CFG, 0, max_new_tokens=6, prompt_len=T, host="127.0.0.1",
+        gen_slots=3, eos_id=eos, warm_rows=1,
+    )
+    try:
+        assert server.scheduler is not None  # auto => continuous
+        client = GrpcClient(f"127.0.0.1:{port}")
+        out = client.generate(prompts)
+        np.testing.assert_array_equal(out[:, :T], prompts)
+        np.testing.assert_array_equal(out[:, T:], ref)
+        s = server.scheduler
+        assert s.rows_total == 5 and s.retired_total == 5
+        assert s.steps_total == s.batches_total > 0
+        client.close()
+    finally:
+        server.stop(0)
+    # stop() closed the scheduler: its loop thread is gone.
+    assert not server.scheduler._thread.is_alive()
+
+
+def test_serve_scheduler_flag_validation():
+    from tpu_dist_nn.serving import serve_lm_generate
+
+    with pytest.raises(ValueError, match="single-chip"):
+        serve_lm_generate(
+            PARAMS, CFG, 0, max_new_tokens=4, prompt_len=T,
+            num_stages=2, scheduler="continuous", host="127.0.0.1",
+        )
+    with pytest.raises(ValueError, match="scheduler"):
+        serve_lm_generate(
+            PARAMS, CFG, 0, max_new_tokens=4, prompt_len=T,
+            scheduler="orca", host="127.0.0.1",
+        )
+    with pytest.raises(ValueError, match="eos_id"):
+        serve_lm_generate(
+            PARAMS, CFG, 0, max_new_tokens=4, prompt_len=T,
+            num_stages=2, eos_id=3, host="127.0.0.1",
+        )
+    # coalesce=False keeps its documented lock-path meaning: auto
+    # resolves to static (server.batcher is None), and an EXPLICIT
+    # continuous request rejects the combination.
+    with pytest.raises(ValueError, match="coalesce"):
+        serve_lm_generate(
+            PARAMS, CFG, 0, max_new_tokens=4, prompt_len=T,
+            scheduler="continuous", coalesce=False, host="127.0.0.1",
+        )
+    server, _port = serve_lm_generate(
+        PARAMS, CFG, 0, max_new_tokens=4, prompt_len=T,
+        coalesce=False, host="127.0.0.1",
+    )
+    try:
+        assert server.scheduler is None and server.batcher is None
+    finally:
+        server.stop(0)
+
+
+def test_cli_lm_flags_validated_eagerly():
+    from tpu_dist_nn.cli import main
+
+    # Bad eos byte id fails before any training happens.
+    assert main([
+        "--platform", "cpu", "lm", "--steps", "1", "--eos-id", "300",
+    ]) != 0
+    # Continuous x pipelined serving is rejected up front.
+    assert main([
+        "--platform", "cpu", "lm", "--steps", "1",
+        "--serve-generate", "0", "--serve-stages", "2",
+        "--scheduler", "continuous",
+    ]) != 0
+    # eos through the pipelined serve placement is rejected up front.
+    assert main([
+        "--platform", "cpu", "lm", "--steps", "1",
+        "--serve-generate", "0", "--serve-stages", "2",
+        "--eos-id", "0",
+    ]) != 0
+
+
+def test_cli_warmup_lm_generation_kernels(capsys):
+    import json
+
+    from tpu_dist_nn.cli import main
+
+    rc = main([
+        "--platform", "cpu", "warmup", "--lm", "--d-model", "16",
+        "--heads", "2", "--layers", "2", "--seq-len", "24",
+        "--gen-slots", "2", "--serve-prompt-len", "6",
+        "--serve-new-tokens", "4",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["warmed_kernels"] == [
+        "prefill_into_cache", "decode_step_slots"
+    ]
+    assert report["gen_slots"] == 2
+    # Without --lm, the engine path still requires --config.
+    assert main(["--platform", "cpu", "warmup"]) != 0
+
+
+# ------------------------------------------------------------ A/B smoke
+
+
+def test_gen_ab_smoke_continuous_beats_static():
+    """The quick-tier CI gate for ISSUE 5's acceptance criterion, in
+    the controlled per-step-cost regime (both arms pay an identical
+    deterministic per-decode-step cost, so the measured delta is pure
+    scheduling policy): under staggered arrivals with mixed budgets,
+    continuous batching must beat the run-to-completion control arm on
+    throughput AND p99 latency — and report TTFT."""
+    from bench import gen_ab_bench
+
+    # Structural expectation (not a timing race): on a 4-wide device,
+    # run-to-completion needs >= ceil(16/4) batches x 33 step-costs
+    # = 528ms of decode, while iteration-level scheduling needs
+    # ~(8*2 + 8*32)/4 steps + 16 prefills ~ 84 step-costs = 336ms —
+    # a >= 1.5x structural margin before any convoy penalty, which is
+    # what makes the >= assertions robust to CI box jitter.
+    ab = gen_ab_bench(
+        None, slots=4, requests=16, prompt_len=T, max_new=32,
+        short_budget=2, arrival_gap_s=0.005, controlled_step_cost=0.004,
+    )
+    c, s = ab["continuous"], ab["static"]
+    assert c["rps"] >= s["rps"], ab
+    assert c["p99_ms"] < s["p99_ms"], ab
+    # TTFT is measured and (continuous) decoupled from full latency.
+    assert c["ttft_p50_ms"] < c["p50_ms"]
+    assert s["ttft_p99_ms"] == s["p99_ms"]  # run-to-completion
+    assert c["retired"] == 16
+    assert 0.0 < c["slot_occupancy"] <= 1.0

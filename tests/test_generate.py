@@ -207,6 +207,153 @@ def test_generate_validates_top_k_top_p():
                  key=jax.random.key(0))
 
 
+def test_generate_eos_freezes_rows_and_pads():
+    # Stop-token semantics under the static shape: pick a token the
+    # greedy decode ACTUALLY emits mid-stream for row 0, rerun with it
+    # as eos_id — the prefix through the stop token is unchanged, the
+    # tail is all pad (eos_id), and rows that never emit it are
+    # untouched (per-row done-mask, not a batch-wide abort).
+    params = init_transformer(jax.random.key(1), CFG)
+    prompt = _prompt(2, 8, seed=2)
+    base = np.asarray(generate(params, CFG, prompt, 10))
+    eos = int(base[0, 3])
+    out = np.asarray(generate(params, CFG, prompt, 10, eos_id=eos))
+    np.testing.assert_array_equal(out[0, :4], base[0, :4])
+    assert (out[0, 4:] == eos).all()
+    for r in range(1, 2):
+        first = np.flatnonzero(base[r] == eos)
+        if first.size == 0:
+            np.testing.assert_array_equal(out[r], base[r])
+
+
+def test_generate_eos_validated():
+    params = init_transformer(jax.random.key(1), CFG)
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(params, CFG, _prompt(1, 4), 4, eos_id=CFG.vocab_size)
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(params, CFG, _prompt(1, 4), 4, eos_id=-1)
+
+
+# ---------------------------------------------------------------------------
+# Slot-wise decoding (the continuous-batching kernels)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_step_slots_matches_scalar_decode_step():
+    # With a uniform position vector and every slot active, the
+    # slot-wise step IS the batched scalar step: identical logits and
+    # identical cache writes (the masked-select write lands the same
+    # values dynamic_update_slice does).
+    from tpu_dist_nn.models.generate import decode_step_slots
+
+    params = init_transformer(jax.random.key(0), CFG)
+    prompts = _prompt(4, 8, seed=3)
+    _, cache = prefill(params, prompts, CFG, max_len=13)
+    tok = prompts[:, 0]
+    ref_logits, ref_cache = decode_step(
+        params, cache, jnp.int32(8), tok, CFG
+    )
+    got_logits, got_cache = decode_step_slots(
+        params, cache, jnp.full((4,), 8, jnp.int32), tok, CFG
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_logits), np.asarray(got_logits)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_cache["k"]), np.asarray(got_cache["k"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref_cache["v"]), np.asarray(got_cache["v"])
+    )
+
+
+def test_decode_step_slots_staggered_positions_match_oracle():
+    # The point of the per-slot pos vector: slots at DIFFERENT depths
+    # advance in one launch. Slot 0 is 3 tokens ahead of slot 1 (walked
+    # there with slot 1 masked inactive); a joint step must match the
+    # teacher-forced full forward of each slot's own sequence.
+    from tpu_dist_nn.models.generate import (
+        decode_step_slots,
+        init_slot_cache,
+        prefill_into_cache,
+    )
+    from tpu_dist_nn.models.transformer import forward
+
+    params = init_transformer(jax.random.key(5), CFG)
+    T, S = 6, 2
+    prompts = _prompt(S, T, seed=6)
+    cache = init_slot_cache(CFG, S, 16)
+
+    # Admit slot 0 and walk it 3 greedy steps alone (slot 1 inactive).
+    logits0, cache = prefill_into_cache(params, CFG, cache, 0, prompts[:1])
+    seq0 = list(np.asarray(prompts[0]))
+    tok = jnp.array([int(jnp.argmax(logits0[0])), 0], jnp.int32)
+    seq0.append(int(tok[0]))
+    pos = jnp.array([T, 0], jnp.int32)
+    active = jnp.array([True, False])
+    for _ in range(3):
+        logits, cache = decode_step_slots(params, cache, pos, tok, CFG,
+                                          active=active)
+        nxt = int(jnp.argmax(logits[0]))
+        seq0.append(nxt)
+        tok = jnp.array([nxt, 0], jnp.int32)
+        pos = pos + jnp.array([1, 0], jnp.int32)
+
+    # Admit slot 1 mid-flight, then step BOTH in one launch.
+    logits1, cache = prefill_into_cache(params, CFG, cache, 1, prompts[1:])
+    seq1 = list(np.asarray(prompts[1])) + [int(jnp.argmax(logits1[0]))]
+    tok = jnp.array([seq0[-1], seq1[-1]], jnp.int32)
+    pos = jnp.array([T + 3, T], jnp.int32)
+    logits, cache = decode_step_slots(
+        params, cache, pos, tok, CFG, active=jnp.array([True, True])
+    )
+    for s, seq in ((0, seq0), (1, seq1)):
+        ref = forward(params, jnp.asarray([seq], jnp.int32), CFG)[0, -1]
+        np.testing.assert_allclose(
+            np.asarray(logits[s]), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_prefill_into_cache_lands_slot_and_clears_stale():
+    # Admission into an arbitrary slot index: the chosen slot's FULL
+    # extent is overwritten (a reused slot cannot leak its previous
+    # occupant's K/V — the stale tail is zeroed by the prefill pad) and
+    # every other slot's contents are untouched.
+    from tpu_dist_nn.models.generate import (
+        init_slot_cache,
+        prefill_into_cache,
+    )
+
+    params = init_transformer(jax.random.key(0), CFG)
+    prompts = _prompt(3, 8, seed=7)
+    cache = init_slot_cache(CFG, 3, 12)
+    cache = {k: v + 7.5 for k, v in cache.items()}  # stale garbage
+    before_k = np.asarray(cache["k"])
+    logits, cache = prefill_into_cache(params, CFG, cache, 1, prompts[1:2])
+    # Parity with the batch prefill's row 1 — including the zero pad.
+    _, ref = prefill(params, prompts, CFG, max_len=12)
+    np.testing.assert_array_equal(
+        np.asarray(cache["k"][:, 1]), np.asarray(ref["k"][:, 1])
+    )
+    assert np.all(np.asarray(cache["k"][:, 1, 8:]) == 0)
+    # Slots 0 and 2 keep their garbage (untouched by the slot write).
+    np.testing.assert_array_equal(np.asarray(cache["k"][:, 0]), before_k[:, 0])
+    np.testing.assert_array_equal(np.asarray(cache["k"][:, 2]), before_k[:, 2])
+    # And the returned logits sample the same first token the full
+    # generate() would.
+    want = np.asarray(generate(params, CFG, prompts[1:2], 1))[0, 0]
+    assert int(jnp.argmax(logits[0])) == want
+
+
+def test_slot_cache_bounds_validated():
+    from tpu_dist_nn.models.generate import init_slot_cache
+
+    with pytest.raises(ValueError, match="slots"):
+        init_slot_cache(CFG, 0, 8)
+    with pytest.raises(ValueError, match="max_len"):
+        init_slot_cache(CFG, 2, CFG.max_seq_len + 1)
+
+
 # ---------------------------------------------------------------------------
 # Tensor-parallel decode
 # ---------------------------------------------------------------------------

@@ -700,6 +700,144 @@ def test_chaos_smoke_quick_tier_recovers_via_retries():
         metrics.close()
 
 
+# ------------------------------------- continuous-batching chaos (ISSUE 5)
+
+
+def _lm_setup():
+    import jax
+
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=24,
+    )
+    return cfg, init_transformer(jax.random.key(3), cfg)
+
+
+def test_continuous_chaos_step_faults_hooks_and_recovery():
+    """The continuous decode scheduler wears the faults.py hook points
+    the Engine does: a launch-plan fault on the step kernel fails the
+    RESIDENT rows over as UNAVAILABLE (their sampling position in the
+    stream is gone — not silently replayable), the wire surfaces it
+    retryably, a default retrying client recovers with the exact
+    greedy tokens, and the fetch hook sees every step."""
+    import grpc as _grpc
+
+    from tpu_dist_nn.models.generate import generate
+    from tpu_dist_nn.serving import serve_lm_generate
+
+    cfg, params = _lm_setup()
+    prompts = np.arange(8, dtype=np.int64)[None, :] % 7
+    ref = np.asarray(generate(params, cfg, prompts, 6))
+
+    server, port = serve_lm_generate(
+        params, cfg, 0, max_new_tokens=6, prompt_len=8,
+        host="127.0.0.1", gen_slots=2, warm_rows=1,
+    )
+    sched = server.scheduler
+    assert sched is not None
+    launch_plan = faults.FaultPlan(at={2: faults.unavailable()})
+    fetch_plan = faults.FaultPlan()  # counts step fetches, no faults
+    faults.inject_engine_faults(sched, launch=launch_plan,
+                                fetch=fetch_plan)
+    try:
+        # No-retry client sees the mid-decode fault as UNAVAILABLE.
+        bare = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                          retry=None, breaker=None)
+        with pytest.raises(_grpc.RpcError) as e:
+            bare.generate(prompts)
+        assert e.value.code() == _grpc.StatusCode.UNAVAILABLE
+        assert launch_plan.fired == 1
+        bare.close()
+        # The scheduler recovered: slots freed, later requests serve —
+        # and a retrying client would have absorbed the fault entirely.
+        retrying = GrpcClient(f"127.0.0.1:{port}", timeout=15.0,
+                              retry=_fast_policy(), breaker=None)
+        out = retrying.generate(prompts)
+        np.testing.assert_array_equal(out[:, 8:], ref)
+        assert sched.slots_active == 0
+        assert fetch_plan.calls > 0, "fetch hook must see step fetches"
+        retrying.close()
+    finally:
+        faults.clear_engine_faults(sched)
+        server.stop(0)
+
+
+def test_continuous_graceful_drain_completes_backlog_then_refuses():
+    """GracefulDrain over the continuous endpoint honors the _Batcher
+    drain contract: begin() mid-burst lets the resident decode AND the
+    queued backlog complete inside the grace window (in-flight RPCs
+    include queued ones — a healthy drain loses nothing), the drained
+    event fires, the scheduler's loop thread is gone, and new work is
+    refused. (The complementary wedged-path proof — close() failing
+    still-pending waiters over as UnavailableError — is deterministic
+    in-process: test_continuous.py::
+    test_close_fails_pending_over_and_post_close_submit_raises.)"""
+    import grpc as _grpc
+
+    from tpu_dist_nn.serving import serve_lm_generate
+
+    cfg, params = _lm_setup()
+    server, port = serve_lm_generate(
+        params, cfg, 0, max_new_tokens=16, prompt_len=8,
+        host="127.0.0.1", gen_slots=1, warm_rows=1,
+    )
+    drain = GracefulDrain(grace_seconds=30.0)
+    drain.add_server(server)
+    oks, errs = [], []
+    lock = threading.Lock()
+
+    def worker(i):
+        c = GrpcClient(f"127.0.0.1:{port}", timeout=30.0,
+                       retry=None, breaker=None)
+        try:
+            out = c.generate(np.full((1, 8), i % 5))
+            with lock:
+                oks.append(out)
+        except _grpc.RpcError as e:
+            with lock:
+                errs.append(e)
+        finally:
+            c.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    sched = server.scheduler
+    deadline = time.monotonic() + 10
+    # One row resident in the single slot, several queued behind it —
+    # the drain begins with real work in BOTH states.
+    while ((sched.rows_total < 1 or sched.pending_rows < 3)
+           and time.monotonic() < deadline):
+        time.sleep(0.002)
+    assert sched.pending_rows >= 3, "burst never queued"
+    drain.begin()
+    assert drain.drained.wait(30.0)
+    for t in threads:
+        t.join(30)
+    assert not errs, [str(e)[:120] for e in errs[:2]]
+    assert len(oks) == 6, "a healthy drain completes the whole backlog"
+    assert sched.pending_rows == 0
+    # The post-grace close runs on its own thread (the wrapped stop's
+    # _close_after_drain); give it a moment to land.
+    deadline = time.monotonic() + 10
+    while sched._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not sched._thread.is_alive(), "drain must close the scheduler"
+    # The drained endpoint refuses new work.
+    late = GrpcClient(f"127.0.0.1:{port}", timeout=2.0,
+                      retry=None, breaker=None)
+    with pytest.raises(_grpc.RpcError):
+        late.generate(np.zeros((1, 8)))
+    late.close()
+
+
 # ------------------------------------------------------------------- CLI
 
 

@@ -753,12 +753,34 @@ def cmd_lm(args) -> int:
             "(it places the decode; without sampling it would be "
             "silently ignored)"
         )
+    if getattr(args, "eos_id", None) is not None and not (
+        0 <= args.eos_id < 256
+    ):
+        # Byte-level vocab: the shared validator would reject this too,
+        # but only after training — fail the flag before the run.
+        raise ValueError(
+            f"--eos-id must be a byte id in [0, 256), got {args.eos_id}"
+        )
+    if getattr(args, "gen_slots", 8) < 1:
+        raise ValueError(f"--gen-slots must be >= 1, got {args.gen_slots}")
     if getattr(args, "serve_generate", None) is not None:
         # Validate the WHOLE serving request BEFORE training — every
         # constraint serve_lm_generate would raise after, so a bad flag
         # combination cannot discard a long run.
         if moe:
             raise ValueError("--serve-generate supports the dense LM only")
+        if args.scheduler == "continuous" and args.serve_stages > 1:
+            raise ValueError(
+                "--scheduler continuous is single-chip; --serve-stages "
+                "> 1 serves the pipelined overlapped decoder (use "
+                "--scheduler static or auto)"
+            )
+        if args.eos_id is not None and args.serve_stages > 1:
+            raise ValueError(
+                "--eos-id is not supported by the pipelined overlapped "
+                "decoder; serve --serve-stages 1 for stop-token "
+                "semantics"
+            )
         if args.layers % max(args.serve_stages, 1):
             raise ValueError(
                 f"--layers {args.layers} must be divisible by "
@@ -809,6 +831,15 @@ def cmd_lm(args) -> int:
                 f"--sample-bytes {args.sample_bytes} does not fit: the "
                 f"{prompt_len}-byte prompt leaves {args.seq_len - prompt_len} "
                 f"positions within --seq-len {args.seq_len}"
+            )
+        if args.eos_id is not None and (
+            args.sample_pipeline_stages > 1
+            or args.sample_tensor_parallel > 1
+        ):
+            raise ValueError(
+                "--eos-id applies to the single-chip decode only (the "
+                "pipelined/tensor-parallel decoders have no done-mask); "
+                "drop the placement flag to sample with a stop token"
             )
         spp = args.sample_pipeline_stages
         if spp > 1:
@@ -1480,7 +1511,8 @@ def cmd_lm(args) -> int:
             sample_fn = jax.jit(
                 lambda p, t, k: generate(
                     p, cfg, t, n, temperature=args.temperature,
-                    top_k=args.top_k, top_p=args.top_p, key=k
+                    top_k=args.top_k, top_p=args.top_p, key=k,
+                    eos_id=args.eos_id,
                 )
             )
             out = sample_fn(
@@ -1488,7 +1520,13 @@ def cmd_lm(args) -> int:
             )
         # Raw bytes decode UTF-8 with replacement, so the string may be
         # shorter than n bytes when multi-byte sequences collapse.
-        report["sample"] = decode_text(np.asarray(out[0]))
+        sample_row = np.asarray(out[0])
+        if args.eos_id is not None:
+            # Trim at the stop token: everything after it is pad.
+            hits = np.flatnonzero(sample_row == args.eos_id)
+            if hits.size:
+                sample_row = sample_row[:hits[0]]
+        report["sample"] = decode_text(sample_row)
     if getattr(args, "serve_generate", None) is not None:
         # Serve GENERATION from the just-trained params (VERDICT r4
         # item 7: the continuous-batching decoder behind the serving
@@ -1506,6 +1544,16 @@ def cmd_lm(args) -> int:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.seed,
             max_pending_rows=args.max_pending_rows,
+            scheduler=args.scheduler, gen_slots=args.gen_slots,
+            eos_id=args.eos_id,
+            # Continuous mode: open the port hot (warm compiles exactly
+            # the prefill-at-slot + step kernels). The static arm keeps
+            # its cold default — its bucket ladder warm is opt-in.
+            warm_rows=(
+                1 if args.scheduler == "continuous"
+                or (args.scheduler == "auto" and args.serve_stages == 1)
+                else 0
+            ),
         )
         # SIGTERM → graceful drain (healthz NOT_SERVING, stop
         # accepting, finish in-flight) instead of hard-killing decodes.
@@ -1516,13 +1564,20 @@ def cmd_lm(args) -> int:
             "prompt_len": args.serve_prompt_len,
             "max_new_tokens": args.serve_new_tokens,
             "stages": args.serve_stages,
+            "scheduler": (
+                "continuous" if server.scheduler is not None else "static"
+            ),
         }
+        if server.scheduler is not None:
+            report["serving"]["gen_slots"] = args.gen_slots
         sampler = None
         if metrics_server is not None and server.batcher is not None:
             from tpu_dist_nn.obs import RuntimeSampler, TRACER
 
             sampler = RuntimeSampler()
             sampler.add_batcher(server.batcher, method="Generate")
+            if server.scheduler is not None:
+                sampler.add_generation_scheduler(server.scheduler)
             sampler.add_tracer(TRACER)
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
@@ -1670,9 +1725,51 @@ def cmd_warmup(args) -> int:
     (JAX_COMPILATION_CACHE_DIR), the compiles land on disk and a later
     `tdn up --grpc-port` on the same model skips them entirely;
     without one, this is the in-process warm `--serve-warm-rows`
-    performs at serve time (reported so the operator knows which)."""
+    performs at serve time (reported so the operator knows which).
+
+    ``--lm`` warms the GENERATION path instead: the continuous
+    scheduler's prefill-at-slot and slot-step kernels for the given LM
+    shape (compiles key on shapes, not weights, so warming with random
+    params pre-warms the real server)."""
     import jax
 
+    if getattr(args, "lm", False):
+        from tpu_dist_nn.models.transformer import (
+            TransformerConfig,
+            init_transformer,
+        )
+        from tpu_dist_nn.serving.continuous import ContinuousScheduler
+
+        metrics_server = _start_metrics_server(args)
+        t0 = time.monotonic()
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=args.d_model, n_heads=args.heads,
+            n_layers=args.layers, d_ff=4 * args.d_model,
+            max_seq_len=args.seq_len,
+        )
+        params = init_transformer(jax.random.key(0), cfg)
+        sched = ContinuousScheduler(
+            params, cfg, slots=args.gen_slots,
+            prompt_len=args.serve_prompt_len,
+            max_new_tokens=args.serve_new_tokens,
+        )
+        warmed = sched.warm()
+        sched.close()
+        cache_dir = jax.config.jax_compilation_cache_dir
+        print(json.dumps({
+            "warmed_kernels": warmed,
+            "gen_slots": args.gen_slots,
+            "prompt_len": args.serve_prompt_len,
+            "max_new_tokens": args.serve_new_tokens,
+            "seconds": round(time.monotonic() - t0, 3),
+            "persistent_cache_dir": cache_dir,
+            "persists_across_processes": bool(cache_dir),
+        }))
+        _stop_metrics_server(metrics_server)
+        return 0
+    if not args.config:
+        raise ValueError("--config is required (or pass --lm to warm "
+                         "the generation kernels instead)")
     metrics_server = _start_metrics_server(args)
     t0 = time.monotonic()
     engine = _engine_from_args(args)
@@ -2233,6 +2330,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="the endpoint's static prompt length")
     p.add_argument("--serve-new-tokens", type=int, default=32,
                    help="tokens generated per request")
+    p.add_argument("--scheduler", choices=["auto", "static", "continuous"],
+                   default="auto",
+                   help="decode scheduling for --serve-generate: "
+                        "continuous = iteration-level slot scheduler "
+                        "(admit at step granularity, retire on EOS/"
+                        "budget; docs/PERF.md 'Continuous batching'); "
+                        "static = the legacy run-to-completion batch "
+                        "(the A/B control arm); auto (default) = "
+                        "continuous single-chip, static pipelined")
+    p.add_argument("--gen-slots", type=int, default=8,
+                   help="KV-cache slots of the continuous scheduler "
+                        "(concurrent sequences decoding per step; "
+                        "tuning guide in docs/PERF.md)")
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="stop token: a generated row freezes at this "
+                        "byte id and pads the remainder with it "
+                        "(applies to --sample-bytes, and to both "
+                        "--serve-generate schedulers identically)")
     p.add_argument("--serve-seconds", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                         "interrupted)")
@@ -2281,14 +2396,28 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("warmup",
                        help="precompile the serving pow2 bucket ladder "
-                            "(no port opened; pairs with "
-                            "JAX_COMPILATION_CACHE_DIR to pre-warm "
-                            "across processes)")
-    _add_up_args(p)
+                            "— or, with --lm, the continuous-batching "
+                            "generation kernels — (no port opened; "
+                            "pairs with JAX_COMPILATION_CACHE_DIR to "
+                            "pre-warm across processes)")
+    _add_up_args(p, config_required=False)
     _add_multihost_args(p)
     p.add_argument("--rows", type=int, default=64,
                    help="warm every power-of-two bucket up to this many "
                         "rows (default 64, matching --serve-warm-rows)")
+    p.add_argument("--lm", action="store_true",
+                   help="warm the LM generation path instead of the "
+                        "engine ladder: the continuous scheduler's "
+                        "prefill-at-slot + slot-step kernels for the "
+                        "shape flags below")
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--gen-slots", type=int, default=8,
+                   help="decode slots of the server being warmed")
+    p.add_argument("--serve-prompt-len", type=int, default=16)
+    p.add_argument("--serve-new-tokens", type=int, default=32)
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="expose /metrics during the warm (0 = ephemeral, "
                         "printed as a JSON line) — the "

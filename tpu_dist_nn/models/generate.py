@@ -75,7 +75,13 @@ def decode_blocks(blocks: dict, cache: dict, pos, x: jnp.ndarray,
     attends against the group's cache (updated at ``pos``). The
     per-stage building block of :func:`decode_step` and the pipelined
     decoder. Attention masks positions ``> pos`` (the rest of the
-    buffer is zero-filled future space)."""
+    buffer is zero-filled future space).
+
+    Numerics here and in :func:`decode_blocks_slots` must stay in
+    lockstep (same casts, same softmax/einsum order): the continuous
+    scheduler's bit-parity contract with the static decode rides on it
+    (CI: test_continuous_matches_static_greedy_tokens).
+    """
     B = x.shape[0]
     H, Dh = cfg.n_heads, cfg.head_dim
     M = cache["k"].shape[2]
@@ -151,7 +157,8 @@ def _truncate_logits(logits: jnp.ndarray, top_k: int | None,
 def validate_generate_args(cfg: TransformerConfig, prompt_len: int,
                            max_new_tokens: int, temperature: float,
                            top_k: int | None, top_p: float | None,
-                           key: jax.Array | None) -> jax.Array:
+                           key: jax.Array | None,
+                           eos_id: int | None = None) -> jax.Array:
     """The generation argument contract, shared by the single-chip and
     tensor-parallel decode paths (so they cannot drift). Returns the key
     to use (a dummy on the greedy path)."""
@@ -190,13 +197,17 @@ def validate_generate_args(cfg: TransformerConfig, prompt_len: int,
             "top_k/top_p shape the sampling distribution; greedy "
             "decoding (temperature == 0) would silently ignore them"
         )
+    if eos_id is not None and not 0 <= int(eos_id) < cfg.vocab_size:
+        raise ValueError(
+            f"eos_id must be in [0, {cfg.vocab_size}), got {eos_id}"
+        )
     return key if key is not None else jax.random.key(0)
 
 
 def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
              max_new_tokens: int, *, temperature: float = 0.0,
              top_k: int | None = None, top_p: float | None = None,
-             key: jax.Array | None = None):
+             key: jax.Array | None = None, eos_id: int | None = None):
     """Generate ``(B, max_new_tokens)`` continuations of ``prompt (B, T)``.
 
     Greedy when ``temperature == 0`` (no key needed), else samples from
@@ -206,25 +217,36 @@ def generate(params: dict, cfg: TransformerConfig, prompt: jnp.ndarray,
     ``cfg.max_seq_len`` (the final sampled token is never embedded, so
     the positional table needs one row fewer than the total length).
     jit-compatible: static
-    ``max_new_tokens``/``temperature``/``top_k``/``top_p``.
+    ``max_new_tokens``/``temperature``/``top_k``/``top_p``/``eos_id``.
+
+    ``eos_id`` enables stop-token semantics under the static shape: a
+    row that emits ``eos_id`` is FROZEN by a done-mask in the scan
+    carry — every later position emits ``eos_id`` (the pad) and its
+    sampling draws no longer affect the output. The shape stays
+    ``(B, max_new_tokens)``; the continuous-batching scheduler
+    (:mod:`tpu_dist_nn.serving.continuous`) reuses exactly these
+    semantics so the two schedulers are output-comparable.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
     key = validate_generate_args(
-        cfg, T, max_new_tokens, temperature, top_k, top_p, key
+        cfg, T, max_new_tokens, temperature, top_k, top_p, key, eos_id
     )
     # Sampling knobs become lru-cache keys: coerce to python scalars so
     # concrete jax/numpy values (unhashable) keep working.
     temperature = float(temperature)
     top_k = None if top_k is None else int(top_k)
     top_p = None if top_p is None else float(top_p)
-    run = _compiled_generate(cfg, T, max_new_tokens, temperature, top_k, top_p)
+    eos_id = None if eos_id is None else int(eos_id)
+    run = _compiled_generate(
+        cfg, T, max_new_tokens, temperature, top_k, top_p, eos_id
+    )
     return run(params, prompt, key)
 
 
 @functools.lru_cache(maxsize=64)
 def _compiled_generate(cfg: TransformerConfig, T: int, max_new_tokens: int,
-                       temperature, top_k, top_p):
+                       temperature, top_k, top_p, eos_id=None):
     """One jitted prefill+decode program per (cfg, lengths, sampling)
     configuration — rebuilding the scan per generate() call would pay
     the trace (and, without the persistent cache, the compile) every
@@ -239,27 +261,177 @@ def _compiled_generate(cfg: TransformerConfig, T: int, max_new_tokens: int,
             k, logits / temperature, axis=-1
         ).astype(jnp.int32)
 
+    def freeze(done, tok):
+        """Stop-token semantics: a finished row keeps emitting the pad
+        (eos_id itself); the token that EQUALS eos_id is still emitted
+        (then marks the row done)."""
+        if eos_id is None:
+            return done, tok
+        tok = jnp.where(done, jnp.int32(eos_id), tok)
+        return done | (tok == eos_id), tok
+
     @jax.jit
     def run(params, prompt, key):
         # The last decode writes position T + N - 2; size the cache
         # exactly.
         logits, cache = prefill(params, prompt, cfg, max_len=total - 1)
         first = sample(logits[:, T - 1], key)
+        done0, first = freeze(jnp.zeros(prompt.shape[0], bool), first)
         if max_new_tokens == 1:
             return first[:, None]
 
         def body(carry, step_key):
-            cache, token, pos = carry
+            cache, token, pos, done = carry
             logits, cache = decode_step(params, cache, pos, token, cfg)
             nxt = sample(logits, step_key)
-            return (cache, nxt, pos + 1), nxt
+            done, nxt = freeze(done, nxt)
+            return (cache, nxt, pos + 1, done), nxt
 
         keys = jax.random.split(
             jax.random.fold_in(key, 1), max_new_tokens - 1
         )
-        (_, _, _), rest = lax.scan(body, (cache, first, jnp.int32(T)), keys)
+        (_, _, _, _), rest = lax.scan(
+            body, (cache, first, jnp.int32(T), done0), keys
+        )
         return jnp.concatenate(
             [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
         )  # (B, max_new_tokens)
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Slot-wise decoding: the kernels under the continuous-batching scheduler
+# (serving/continuous.py). One fixed (L, S, max_len, H, Dh) cache holds S
+# independent request slots; prefill lands a prompt's K/V into ANY free
+# slot, and one compiled step advances every slot at its OWN position.
+# ---------------------------------------------------------------------------
+
+
+def init_slot_cache(cfg: TransformerConfig, slots: int, max_len: int,
+                    dtype=None) -> dict:
+    """A zeroed ``(L, S, max_len, H, Dh)`` slot KV cache.
+
+    Same layout as :func:`prefill`'s batch cache with the batch axis
+    reinterpreted as slots — so every shape downstream of it
+    (``decode_step_slots``'s einsums, the masked writes) is identical
+    to the batched decode path. Static by construction: admission and
+    retirement never change its shape, only which slots the active
+    mask selects (the TPU-friendly answer to paged KV — see
+    docs/PERF.md "Continuous batching").
+    """
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    if max_len < 1 or max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"max_len must be in [1, {cfg.max_seq_len}], got {max_len}"
+        )
+    dtype = jnp.dtype(cfg.compute_dtype) if dtype is None else dtype
+    shape = (cfg.n_layers, slots, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def prefill_into_cache(params: dict, cfg: TransformerConfig, cache: dict,
+                       slot, tokens: jnp.ndarray):
+    """Prefill one prompt ``(1, T)`` INTO slot ``slot`` of a slot cache.
+
+    Runs the full prompt forward once and lands its K/V at an ARBITRARY
+    (traced) slot index via ``lax.dynamic_update_slice`` — admission at
+    decode-step granularity needs to fill whichever slot just retired,
+    not a static position. The whole ``max_len`` extent of the slot is
+    overwritten (the prefill cache is zero-padded past ``T``), so a
+    reused slot can never leak its previous occupant's K/V.
+
+    Returns ``(logits (1, V), cache)``: the last prompt position's
+    logits (the caller samples the first generated token from them)
+    and the updated slot cache.
+    """
+    M = cache["k"].shape[2]
+    logits, row = prefill(params, tokens, cfg, max_len=M)
+    slot = jnp.asarray(slot, jnp.int32)
+    at = (0, slot, 0, 0, 0)
+    cache = {
+        "k": lax.dynamic_update_slice(
+            cache["k"], row["k"].astype(cache["k"].dtype), at
+        ),
+        "v": lax.dynamic_update_slice(
+            cache["v"], row["v"].astype(cache["v"].dtype), at
+        ),
+    }
+    return logits[:, tokens.shape[1] - 1], cache
+
+
+def decode_blocks_slots(blocks: dict, cache: dict, pos: jnp.ndarray,
+                        x: jnp.ndarray, cfg: TransformerConfig,
+                        active: jnp.ndarray):
+    """One decode step through a stacked block group with PER-SLOT
+    positions: ``x (S, 1, D)`` attends against each slot's cache,
+    updated at ``pos[s]`` for active slots only.
+
+    The scalar-``pos`` :func:`decode_blocks` writes with one
+    ``dynamic_update_slice`` because every row shares a position; here
+    each slot is at its own depth, so the write is a masked select
+    over the length axis (``pos[s]``'s one-hot ∧ ``active[s]``) — the
+    same static-shape, no-scatter idiom as the attention mask, and a
+    retired slot writes nothing at all. Attention masks positions
+    ``> pos[s]`` per slot, so stale K/V beyond a slot's frontier is
+    unreachable even before its next occupant's prefill overwrites it.
+    """
+    S = x.shape[0]
+    H, Dh = cfg.n_heads, cfg.head_dim
+    M = cache["k"].shape[2]
+    write = (
+        (jnp.arange(M)[None, :] == pos[:, None]) & active[:, None]
+    )[:, :, None, None]  # (S, M, 1, 1)
+    live = jnp.arange(M)[None, :] <= pos[:, None]  # (S, M)
+
+    def body(carry, inputs):
+        x = carry
+        block, k_cache, v_cache = inputs
+        h = layer_norm(x, block["ln1_g"], block["ln1_b"])
+        qkv = h @ block["w_qkv"] + block["b_qkv"]
+        q, k, v = jnp.split(qkv.reshape(S, 1, 3 * H, Dh), 3, axis=2)
+        k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+        v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            k_cache.astype(jnp.float32),
+        ) / np.sqrt(Dh)
+        scores = jnp.where(live[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache).reshape(S, 1, H * Dh)
+        x = x + o @ block["w_o"] + block["b_o"]
+        return ffn_sublayer(block, x), (k_cache, v_cache)
+
+    x, (ks, vs) = lax.scan(body, x, (blocks, cache["k"], cache["v"]))
+    return x, {"k": ks, "v": vs}
+
+
+def decode_step_slots(params: dict, cache: dict, pos: jnp.ndarray,
+                      token: jnp.ndarray, cfg: TransformerConfig,
+                      active: jnp.ndarray | None = None):
+    """One decode step for ALL slots: ``token (S,) int32`` at per-slot
+    positions ``pos (S,) int32``, gated by ``active (S,) bool``.
+
+    The slot-cache analogue of :func:`decode_step` (with
+    ``pos = full(S, p)`` and all slots active it computes the same
+    logits and cache). Retired slots cost nothing correctness-wise:
+    their cache is not written, their logits are garbage the scheduler
+    never samples from, and their (clipped) position only bounds the
+    attention mask of a slot nobody reads.
+
+    Returns ``(logits (S, V), cache)``.
+    """
+    params = cfg.cast_params(params)
+    if active is None:
+        active = jnp.ones(token.shape, bool)
+    pos = jnp.asarray(pos, jnp.int32)
+    # Clip so a retired slot's stale position can never over-index the
+    # positional table (its logits are masked out by `active` anyway).
+    safe = jnp.clip(pos, 0, params["pos_embed"].shape[0] - 1)
+    x = params["tok_embed"][token][:, None, :] \
+        + params["pos_embed"][safe][:, None, :]
+    x, cache = decode_blocks_slots(
+        params["blocks"], cache, safe, x, cfg, active
+    )
+    return unembed(params, x)[:, 0], cache

@@ -87,6 +87,21 @@ class RuntimeSampler:
             "tdn_engine_ready",
             "1 when every registered engine would report ready",
         )
+        # Continuous-batching decode (serving/continuous.py): slot
+        # residency now, plus the cumulative occupancy ratio — the
+        # decode-efficiency figure (1.0 = every step advanced a full
+        # slot ladder; low values say --gen-slots is oversized for the
+        # offered load).
+        self._g_gen_slots = reg.gauge(
+            "tdn_gen_slots_active",
+            "decode slots currently occupied by a generating request",
+        )
+        self._g_gen_occ = reg.gauge(
+            "tdn_gen_slot_occupancy_ratio",
+            "cumulative active-slot-steps / (steps * slots) of the "
+            "continuous decode scheduler",
+        )
+        self._gen_scheds: list[object] = []
         # The tracer observing itself: buffer occupancy plus an
         # eviction counter, so "why is my slow request's trace gone"
         # has a scrapeable answer (dropped > 0: raise the buffer or
@@ -112,6 +127,12 @@ class RuntimeSampler:
 
     def add_engine(self, engine) -> None:
         self._engines.append(engine)
+
+    def add_generation_scheduler(self, sched) -> None:
+        """Register a continuous decode scheduler for the tdn_gen_*
+        slot gauges (its queue/counter families ride :meth:`add_batcher`
+        — the scheduler satisfies the batcher attribute contract)."""
+        self._gen_scheds.append(sched)
 
     def add_tracer(self, tracer) -> None:
         self._tracers.append(tracer)
@@ -162,6 +183,17 @@ class RuntimeSampler:
             self._g_overlap.labels(method=method).set(
                 getattr(b, "overlapped_total", 0) / launches
             )
+        if self._gen_scheds:
+            self._g_gen_slots.set(
+                sum(int(s.slots_active) for s in self._gen_scheds)
+            )
+            steps = sum(
+                int(s.steps_total) * int(s.slots) for s in self._gen_scheds
+            )
+            slot_steps = sum(
+                int(s.slot_steps_total) for s in self._gen_scheds
+            )
+            self._g_gen_occ.set(slot_steps / steps if steps else 0.0)
         if self._engines:
             # (tdn_engine_warm_buckets is NOT sampled here: the engine's
             # warm_buckets method is its single writer — a second writer

@@ -1,5 +1,8 @@
 """Wire-compatible gRPC serving (the reference's LayerService protocol)."""
 
+from tpu_dist_nn.serving.continuous import (  # noqa: F401
+    ContinuousScheduler,
+)
 from tpu_dist_nn.serving.resilience import (  # noqa: F401
     CircuitBreaker,
     GracefulDrain,

@@ -1,0 +1,584 @@
+"""Continuous batching for LM generation: the iteration-level decode
+scheduler (Orca, OSDI '22) on a slot-based KV cache.
+
+The static Generate path (``serving/server.py``'s ``_Batcher`` over
+``models.generate.generate``) is run-to-completion batching: a batch is
+admitted, decodes ALL ``max_new_tokens`` steps, and only then does the
+next batch start — a 4-token request pays for its 32-token neighbor,
+and late arrivals convoy behind the whole batch. This module schedules
+at DECODE-STEP granularity instead:
+
+* One fixed ``(L, S, max_len, H, Dh)`` slot KV cache
+  (:func:`~tpu_dist_nn.models.generate.init_slot_cache`) holds ``S``
+  independent requests. Shapes never change — admission and retirement
+  only flip entries of a per-slot active mask, the TPU-friendly
+  static-shape answer to vLLM-style paged KV (one request = one slot =
+  one contiguous ``max_len`` extent; no block tables, no gathers on
+  the hot path — trade-off discussion in docs/PERF.md).
+* **Admission at step granularity**: whenever a slot is free and a
+  request is pending, its prompt prefills INTO that slot
+  (:func:`~tpu_dist_nn.models.generate.prefill_into_cache`,
+  ``lax.dynamic_update_slice`` at the traced slot index) and the
+  request starts decoding on the very next step — no waiting for the
+  current "batch" to finish, because there is no batch.
+* **One compiled step kernel**
+  (:func:`~tpu_dist_nn.models.generate.decode_step_slots`) advances
+  every slot at its OWN position (per-slot ``pos`` vector + active
+  mask) — mixed-age requests share each device launch.
+* **Early retirement**: a slot frees on EOS
+  (:func:`~tpu_dist_nn.models.generate.generate`'s stop-token
+  semantics, so the two schedulers are output-comparable) or its
+  per-request ``max_new_tokens`` — and the freed slot is refilled on
+  the same scheduler iteration while the remaining slots keep
+  decoding. Finished rows stream back to their waiters immediately.
+
+Resilience contract (docs/ROBUSTNESS.md): ``max_pending_rows``
+admission shedding (``tdn_batcher_shed_total``), ``close(timeout)``
+failing still-pending waiters over as UNAVAILABLE (the ``_Batcher``
+drain contract, so ``GracefulDrain`` works unchanged), and the
+``testing/faults.py`` hook points — ``launch_hook`` fires before every
+step-kernel dispatch, ``fetch_hook`` before its token fetch.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import time
+
+import numpy as np
+
+from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
+
+log = logging.getLogger(__name__)
+
+# Generation metric families (docs/OBSERVABILITY.md catalog). Pushed by
+# the scheduler loop; the slot gauges are sampled by obs/runtime.py.
+_TTFT = REGISTRY.histogram(
+    "tdn_gen_ttft_seconds",
+    "time to first token: request submit to its first sampled token "
+    "(prefill complete), continuous scheduler",
+)
+_TOKENS = REGISTRY.counter(
+    "tdn_gen_tokens_total",
+    "tokens emitted by the continuous decode scheduler",
+)
+_RETIRED = REGISTRY.counter(
+    "tdn_gen_requests_retired_total",
+    "request rows retired from a decode slot, by reason",
+    labels=("reason",),
+)
+_SHED = REGISTRY.counter(
+    "tdn_batcher_shed_total",
+    "submits fast-failed RESOURCE_EXHAUSTED at the pending-rows "
+    "watermark (admission control)",
+    labels=("method",),
+)
+_WAIT = REGISTRY.histogram(
+    "tdn_batch_wait_seconds",
+    "time a request spent in the batcher (submit to result)",
+    labels=("method",),
+)
+# Same family (and meaning — rows per device launch) as the static
+# batcher's, so dashboards read the Generate series unchanged across
+# schedulers: here a "launch" is one slot step and its rows are the
+# active slots it advanced.
+_BATCH_ROWS = REGISTRY.histogram(
+    "tdn_batch_rows", "coalesced rows per device launch (pre-padding)",
+    labels=("method",), buckets=POW2_BUCKETS,
+)
+
+
+class ContinuousScheduler:
+    """Iteration-level decode scheduler over a slot-based KV cache.
+
+    ``submit(rows)`` blocks the calling (gRPC worker) thread until every
+    row's sequence is finished, exactly like ``_Batcher.submit`` — the
+    difference is behind the call: one daemon loop thread owns the
+    device, interleaving slot admission (prefill) with single-token
+    steps over all active slots, retiring each row the moment it hits
+    EOS or its token budget.
+
+    Construction compiles nothing; :meth:`warm` precompiles the
+    prefill-at-slot and step kernels so a port can open hot
+    (``serve_lm_generate(warm_rows=...)`` / ``tdn warmup --lm``).
+
+    Counter attributes mirror ``_Batcher`` (``requests_total``,
+    ``batches_total`` = step-kernel launches, ``rows_total``,
+    ``pending_rows``, ``inflight_rows`` = rows resident in slots,
+    ``shed_total``) so the runtime sampler and drain plumbing work
+    unchanged; generation-specific state (``slots_active``,
+    ``steps_total``, ``slot_steps_total``, ``ttft_recent``) feeds the
+    ``tdn_gen_*`` families.
+
+    ``prefill_fn`` / ``step_fn`` are testing seams (the bench CI smoke
+    injects a deterministic cost model); production always builds the
+    real jitted kernels from ``params``/``cfg``.
+    """
+
+    method = "Generate"
+
+    def __init__(self, params, cfg, *, slots: int, prompt_len: int,
+                 max_new_tokens: int, temperature: float = 0.0,
+                 top_k: int | None = None, top_p: float | None = None,
+                 eos_id: int | None = None, seed: int = 0,
+                 submit_timeout: float | None = 120.0,
+                 max_pending_rows: int | None = None,
+                 prefill_fn=None, step_fn=None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self._S = int(slots)
+        self._T = int(prompt_len)
+        self._N = int(max_new_tokens)
+        self._eos = None if eos_id is None else int(eos_id)
+        self._submit_timeout = submit_timeout
+        self._max_pending_rows = (
+            int(max_pending_rows) if max_pending_rows is not None else None
+        )
+        self._counter = itertools.count()
+        if prefill_fn is not None or step_fn is not None:
+            if prefill_fn is None or step_fn is None:
+                raise ValueError(
+                    "prefill_fn and step_fn must be injected together"
+                )
+            self._prefill, self._step = prefill_fn, step_fn
+            self._params = params
+            self._cache = None
+            self._key = None
+            self._temperature = float(temperature)
+        else:
+            import jax
+
+            from tpu_dist_nn.models.generate import validate_generate_args
+
+            self._key = jax.random.key(int(seed))
+            validate_generate_args(
+                cfg, self._T, self._N, temperature, top_k, top_p,
+                self._key if temperature > 0 else None, eos_id,
+            )
+            self._params = cfg.cast_params(params)
+            self._temperature = float(temperature)
+            self._build_kernels(
+                cfg, float(temperature), top_k, top_p,
+            )
+        # Host-side slot state: the loop thread is the only writer.
+        self._pos = np.zeros(self._S, np.int32)
+        self._active = np.zeros(self._S, bool)
+        self._tok = np.zeros(self._S, np.int32)
+        self._occupant: list[dict | None] = [None] * self._S
+        # Fault-injection hook points (testing/faults.py): called at
+        # the top of every step-kernel dispatch / token fetch.
+        self.launch_hook = None
+        self.fetch_hook = None
+        # Pending queue + admission ledger (same shape as _Batcher).
+        self._cond = threading.Condition()
+        self._pending: collections.deque[dict] = collections.deque()
+        self.pending_rows = 0
+        self._closed = False
+        # _Batcher-compatible counters (runtime sampler contract).
+        self.requests_total = 0    # submit() calls admitted to the queue
+        self.rows_total = 0        # rows that entered a slot
+        self.batches_total = 0     # step-kernel launches (steps_total
+        #                            is a read alias — one source of truth)
+        self.shed_total = 0
+        self.overlapped_total = 0  # N/A here; kept for sampler parity
+        # Generation-specific stats.
+        self.slot_steps_total = 0  # active slots summed over steps
+        self.retired_total = 0     # rows retired (eos + max_tokens)
+        self.ttft_recent: collections.deque[float] = collections.deque(
+            maxlen=1024
+        )
+        self._m_shed = _SHED.labels(method=self.method)
+        self._m_wait = _WAIT.labels(method=self.method)
+        self._m_rows = _BATCH_ROWS.labels(method=self.method)
+        self._thread = threading.Thread(
+            target=self._loop, name="tdn-gen-continuous", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ kernels
+
+    def _build_kernels(self, cfg, temperature, top_k, top_p) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_dist_nn.models.generate import (
+            _truncate_logits,
+            decode_step_slots,
+            init_slot_cache,
+            prefill_into_cache,
+        )
+
+        # The last decode writes position T + N - 2 (generate()'s cache
+        # sizing rule), so the slot extent is total - 1.
+        M = self._T + self._N - 1 if self._N > 1 else self._T
+        self._cache = init_slot_cache(cfg, self._S, M)
+        top_k = None if top_k is None else int(top_k)
+        top_p = None if top_p is None else float(top_p)
+
+        def sample(logits, key):
+            if temperature == 0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = _truncate_logits(logits, top_k, top_p)
+            return jax.random.categorical(
+                key, logits / temperature, axis=-1
+            ).astype(jnp.int32)
+
+        @jax.jit
+        def prefill_at(params, cache, slot, tokens, key):
+            logits, cache = prefill_into_cache(
+                params, cfg, cache, slot, tokens
+            )
+            return sample(logits, key)[0], cache
+
+        @jax.jit
+        def step(params, cache, pos, active, tok, key):
+            logits, cache = decode_step_slots(
+                params, cache, pos, tok, cfg, active=active
+            )
+            return sample(logits, key), cache
+
+        self._prefill = prefill_at
+        self._step = step
+
+    def _next_key(self):
+        """A fresh fold of the base key per sampling event (prefill or
+        step): repeated identical prompts draw fresh continuations, the
+        serving endpoint's existing contract."""
+        if self._key is None:
+            return None
+        if self._temperature == 0:
+            return self._key  # unused inside the greedy kernels
+        import jax
+
+        return jax.random.fold_in(self._key, next(self._counter))
+
+    def warm(self) -> list[str]:
+        """Precompile the prefill-at-slot and step kernels (the port
+        opens hot; with JAX_COMPILATION_CACHE_DIR the compiles also
+        land on disk for later processes). Runs against slot 0 of the
+        real cache with a zero prompt — the slot is free, so the junk
+        K/V is masked and the next real occupant's prefill overwrites
+        it."""
+        zeros = np.zeros((1, self._T), np.int32)
+        key = self._next_key()
+        _, cache = self._prefill(
+            self._params, self._cache, np.int32(0), zeros, key
+        )
+        toks, cache = self._step(
+            self._params, cache,
+            np.zeros(self._S, np.int32), np.zeros(self._S, bool),
+            np.zeros(self._S, np.int32), key,
+        )
+        np.asarray(toks)  # force the compile + execution to finish
+        self._cache = cache
+        return ["prefill_into_cache", "decode_step_slots"]
+
+    # ------------------------------------------------------------ submit
+
+    @property
+    def inflight_rows(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def slots(self) -> int:
+        return self._S
+
+    @property
+    def slots_active(self) -> int:
+        """Alias of :attr:`inflight_rows` under its generation name."""
+        return self.inflight_rows
+
+    @property
+    def steps_total(self) -> int:
+        """Step-kernel launches, under the name the occupancy ratio
+        reads naturally (alias of ``batches_total`` — a device launch
+        IS a decode step here)."""
+        return self.batches_total
+
+    def submit(self, x: np.ndarray, *, max_new_tokens: int | None = None,
+               timeout: float | None = None, ctx=None) -> np.ndarray:
+        """Block until every row of ``x (N, prompt_len)`` has finished
+        generating; returns ``(N, prompt_len + max_new_tokens)`` int64
+        (prompt included, post-retirement positions padded with
+        ``eos_id``, or with token id 0 when no ``eos_id`` is configured
+        — identical row semantics to the static scheduler, whose only
+        retire reason without an eos is the full budget, so the 0-pad
+        case is reachable only via per-request ``max_new_tokens``).
+
+        ``max_new_tokens`` caps THIS request below the endpoint budget
+        (iteration-level scheduling makes per-request budgets free:
+        the row simply retires earlier); the output width stays the
+        endpoint's. ``timeout``/``ctx`` follow ``_Batcher.submit``.
+        """
+        from tpu_dist_nn.utils.errors import (
+            DeadlineExceededError,
+            ResourceExhaustedError,
+            UnavailableError,
+        )
+
+        x = np.asarray(x, np.int32)
+        if x.ndim != 2 or x.shape[1] != self._T:
+            raise ValueError(
+                f"expected prompts of shape (N, {self._T}), got "
+                f"{tuple(x.shape)}"
+            )
+        budget = self._N if max_new_tokens is None else int(max_new_tokens)
+        if not 1 <= budget <= self._N:
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self._N}], got {budget}"
+            )
+        n = len(x)
+        out = np.full(
+            (n, self._T + self._N),
+            self._eos if self._eos is not None else 0, np.int64,
+        )
+        out[:, :self._T] = x
+        if n == 0:
+            # Nothing to decode: answer immediately (the static batcher
+            # round-trips an empty matrix too). Queueing it would hand
+            # the loop a rowless item whose bogus occupant corrupts the
+            # ledger.
+            return out
+        item = {
+            "x": x, "budget": budget, "out": out, "next_row": 0,
+            "remaining": n, "done": threading.Event(), "err": None,
+            "abandoned": False, "t_submit": time.monotonic(),
+            "ctx": ctx if ctx is not None and ctx.sampled else None,
+        }
+        with self._cond:
+            if self._closed:
+                raise UnavailableError("server is shutting down")
+            # Admission control: same watermark semantics as _Batcher
+            # (an oversized request against an empty queue is admitted;
+            # the watermark bounds backlog, not request size).
+            if (self._max_pending_rows is not None and self._pending
+                    and self.pending_rows + n > self._max_pending_rows):
+                self.shed_total += 1
+                self._m_shed.inc()
+                raise ResourceExhaustedError(
+                    f"generation queue at capacity ({self.pending_rows} "
+                    f"rows pending, watermark {self._max_pending_rows}); "
+                    "back off and retry"
+                )
+            self._pending.append(item)
+            self.pending_rows += n
+            self.requests_total += 1
+            self._cond.notify()
+        bounds = [
+            t for t in (self._submit_timeout, timeout) if t is not None
+        ]
+        wait = min(bounds) if bounds else None
+        if not item["done"].wait(wait):
+            # Abandoned rows already decoding finish their (bounded)
+            # budget and are discarded; rows still pending are skipped
+            # at admission. Either way nobody computes for a caller
+            # that is gone for longer than one residual decode.
+            with self._cond:
+                item["abandoned"] = True
+            raise DeadlineExceededError(
+                f"generation did not complete within {wait}s "
+                "(decode wedged or request backlogged?)"
+            )
+        self._m_wait.observe(time.monotonic() - item["t_submit"])
+        if item["err"] is not None:
+            raise item["err"]
+        return item["out"]
+
+    # ------------------------------------------------------------ loop
+
+    def _pop_admittable(self):
+        """Under ``_cond``: the next (item, row_index) to admit, or
+        None. Drops abandoned/failed items from the queue, returning
+        their rows to the ledger."""
+        while self._pending:
+            item = self._pending[0]
+            if item["abandoned"] or item["err"] is not None:
+                self._pending.popleft()
+                self.pending_rows -= len(item["x"]) - item["next_row"]
+                continue
+            row = item["next_row"]
+            item["next_row"] += 1
+            self.pending_rows -= 1
+            if item["next_row"] >= len(item["x"]):
+                self._pending.popleft()
+            return item, row
+        return None
+
+    def _fail_occupants(self, e: Exception) -> None:
+        """A step-kernel fault hits every resident row: fail their
+        items over (a row cannot be replayed — its sampling position
+        in the stream is gone) and free the slots so the scheduler
+        keeps serving later arrivals."""
+        for s in range(self._S):
+            occ = self._occupant[s]
+            if occ is None:
+                continue
+            self._occupant[s] = None
+            self._active[s] = False
+            item = occ["item"]
+            if item["err"] is None:
+                item["err"] = e
+                item["done"].set()
+
+    def _retire(self, slot: int, reason: str) -> None:
+        occ = self._occupant[slot]
+        item, row = occ["item"], occ["row"]
+        toks = occ["tokens"]
+        item["out"][row, self._T:self._T + len(toks)] = toks
+        self._active[slot] = False
+        self._occupant[slot] = None
+        self.retired_total += 1
+        _RETIRED.labels(reason=reason).inc()
+        _TOKENS.inc(len(toks))
+        if item["ctx"] is not None:
+            _trace.TRACER.record_span(
+                "decode", item["ctx"], occ["t_first"],
+                time.monotonic() - occ["t_first"],
+                attrs={"slot": slot, "steps": len(toks), "reason": reason},
+            )
+        item["remaining"] -= 1
+        if item["remaining"] == 0 and not item["abandoned"]:
+            item["done"].set()
+
+    def _admit_one(self, item: dict, row: int) -> None:
+        """Prefill one row into a free slot (there is one — the caller
+        checked) and start it decoding; a first token that already
+        satisfies EOS/budget retires without ever occupying the slot
+        across a step."""
+        slot = int(np.flatnonzero(~self._active)[0])
+        t0 = time.monotonic()
+        try:
+            first, cache = self._prefill(
+                self._params, self._cache, np.int32(slot),
+                item["x"][row:row + 1], self._next_key(),
+            )
+            first = int(first)
+        except Exception as e:  # noqa: BLE001 — per item
+            if item["err"] is None:
+                item["err"] = e
+                item["done"].set()
+            return
+        self._cache = cache
+        now = time.monotonic()
+        ttft = now - item["t_submit"]
+        _TTFT.observe(ttft)
+        self.ttft_recent.append(ttft)
+        self.rows_total += 1
+        if item["ctx"] is not None:
+            _trace.TRACER.record_span(
+                "queue_wait", item["ctx"], item["t_submit"],
+                t0 - item["t_submit"],
+            )
+            _trace.TRACER.record_span(
+                "prefill", item["ctx"], t0, now - t0,
+                attrs={"slot": slot, "prompt_len": self._T},
+            )
+        occ = {"item": item, "row": row, "tokens": [first],
+               "budget": item["budget"], "t_first": now}
+        self._occupant[slot] = occ
+        self._active[slot] = True
+        self._pos[slot] = self._T
+        self._tok[slot] = first
+        if self._eos is not None and first == self._eos:
+            self._retire(slot, "eos")
+        elif len(occ["tokens"]) >= occ["budget"]:
+            self._retire(slot, "max_tokens")
+
+    def _step_once(self) -> None:
+        """One compiled step over every slot; retire/refill happens on
+        the host between steps (the iteration-level boundary)."""
+        t0 = time.monotonic()
+        traced = [
+            self._occupant[s] for s in range(self._S)
+            if self._active[s] and self._occupant[s]["item"]["ctx"] is not None
+        ]
+        try:
+            if self.launch_hook is not None:
+                self.launch_hook(self._tok)
+            toks, cache = self._step(
+                self._params, self._cache, self._pos, self._active,
+                self._tok, self._next_key(),
+            )
+            if self.fetch_hook is not None:
+                self.fetch_hook(toks)
+            toks = np.asarray(toks)
+        except Exception as e:  # noqa: BLE001 — fan out to occupants
+            log.exception("continuous decode step failed")
+            self._fail_occupants(e)
+            return
+        self._cache = cache
+        self.batches_total += 1
+        active = int(self._active.sum())
+        self.slot_steps_total += active
+        self._m_rows.observe(active)
+        dur = time.monotonic() - t0
+        for occ in traced:
+            if occ["item"]["err"] is not None:
+                continue
+            _trace.TRACER.record_span(
+                "decode.step", occ["item"]["ctx"], t0, dur,
+                attrs={"active_slots": active},
+            )
+        for s in range(self._S):
+            if not self._active[s]:
+                continue
+            occ = self._occupant[s]
+            tok = int(toks[s])
+            occ["tokens"].append(tok)
+            self._pos[s] += 1
+            self._tok[s] = tok
+            if self._eos is not None and tok == self._eos:
+                self._retire(s, "eos")
+            elif len(occ["tokens"]) >= occ["budget"]:
+                self._retire(s, "max_tokens")
+
+    def _loop(self) -> None:
+        while True:
+            admits = []
+            with self._cond:
+                while (not self._closed and not self._pending
+                       and not self._active.any()):
+                    self._cond.wait()
+                if (self._closed and not self._active.any()):
+                    return  # close() sweeps whatever is still pending
+                if not self._closed:
+                    while self._active.sum() + len(admits) < self._S:
+                        got = self._pop_admittable()
+                        if got is None:
+                            break
+                        admits.append(got)
+            # Device work OUTSIDE the lock: submitters must never block
+            # behind a prefill or a step.
+            for item, row in admits:
+                self._admit_one(item, row)
+            if self._active.any():
+                self._step_once()
+
+    # ------------------------------------------------------------ close
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admitting, let resident rows finish their (bounded)
+        decodes, then fail still-pending waiters over as UNAVAILABLE —
+        the ``_Batcher.close`` contract ``GracefulDrain`` relies on."""
+        from tpu_dist_nn.utils.errors import UnavailableError
+
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        leftovers = []
+        with self._cond:
+            while self._pending:
+                item = self._pending.popleft()
+                self.pending_rows -= len(item["x"]) - item["next_row"]
+                if not item["abandoned"] and item["err"] is None:
+                    leftovers.append(item)
+        for item in leftovers:
+            item["err"] = UnavailableError(
+                "server shut down before this request was served"
+            )
+            item["done"].set()
