@@ -712,6 +712,31 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
         print(f"# generate serving bench unavailable "
               f"({type(e).__name__}: {e})", file=sys.stderr)
         out["generate"] = None
+    # Per-stage attribution of the numbers above (obs/profile over the
+    # spans this bench just recorded): the round artifact then carries
+    # WHERE the serving time went, and tools/bench_gate.py folds it
+    # into its report when a later round regresses. Trimmed to the
+    # top stages — the artifact is a summary, /profile is the firehose.
+    try:
+        from tpu_dist_nn.obs.profile import profile_snapshot
+
+        prof = profile_snapshot(top=0)
+        out["profile"] = {
+            "methods": {
+                method: {
+                    "traces": m["traces"],
+                    "stages": [
+                        {"stage": s["stage"], "share": s["share"],
+                         "p99_s": s["p99_s"]}
+                        for s in m["stages"][:6]
+                    ],
+                }
+                for method, m in prof.get("methods", {}).items()
+            },
+        }
+    except Exception as e:  # noqa: BLE001 — attribution must not cost the run
+        print(f"# serving profile attribution unavailable "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
     return out
 
 

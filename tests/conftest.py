@@ -120,7 +120,8 @@ QUICK_TESTS = {
                           "test_fuzz_model_roundtrip_native_vs_python"],
     "test_obs": ["test_counter_gauge_histogram_basics",
                  "test_render_text_format_and_round_trip",
-                 "test_loopback_serving_metrics_and_healthz"],
+                 "test_loopback_serving_metrics_and_healthz",
+                 "test_prometheus_exposition_conformance"],
     "test_optimizers": ["test_default_is_exactly_adam",
                         "test_warmup_ramps_learning_rate",
                         "test_grad_accum_no_update_until_k_steps"],
@@ -139,6 +140,14 @@ QUICK_TESTS = {
                          "test_pp_tp_shard_roundtrip"],
     "test_pipeline_tp_sp": [
         "test_pp_tp_sp_1f1b_grads_match_single_chip[ulysses]"],
+    "test_profile": [
+        # The ISSUE-6 quick-tier smokes: loopback /profile shares sum
+        # to the measured root wall, and tools/bench_gate.py runs the
+        # checked-in r04->r05 pair report-only plus a synthetic failing
+        # pair in enforce mode.
+        "test_loopback_profile_process_shares_sum_to_wall",
+        "test_bench_gate_report_only_on_checked_in_rounds",
+        "test_bench_gate_enforce_fails_synthetic_regression"],
     "test_profiling": ["test_latency_stats_summary",
                        "test_annotate_inside_jit"],
     "test_quantized": ["test_weight_quantization_roundtrip_error_bounded",

@@ -630,3 +630,122 @@ def test_instrumentation_is_cheap():
         hchild.observe(17)
     dt = time.monotonic() - t0
     assert dt < 1.0, f"50k updates took {dt:.3f}s"
+
+
+# ------------------------------------------------- exposition conformance
+
+
+def test_prometheus_exposition_conformance():
+    """Text-format 0.0.4 conformance for the WHOLE process registry —
+    the guard that keeps every newly added gauge scrape-compatible:
+    HELP + TYPE lines precede every family's samples, histogram
+    families expose exactly ``_bucket``/``_sum``/``_count`` with a
+    cumulative le ladder whose ``+Inf`` equals ``_count``, and every
+    series line matches the exposition grammar (incl. label escaping).
+    """
+    import re
+
+    # Import every built-in instrumentation site so their families are
+    # registered, then run one sampler pass so gauges materialize.
+    import tpu_dist_nn.api.engine  # noqa: F401
+    import tpu_dist_nn.serving.continuous  # noqa: F401
+    import tpu_dist_nn.serving.resilience  # noqa: F401
+    import tpu_dist_nn.serving.server  # noqa: F401
+    import tpu_dist_nn.train.lm_trainer  # noqa: F401
+    import tpu_dist_nn.train.trainer  # noqa: F401
+    from tpu_dist_nn.obs.runtime import RuntimeSampler
+
+    RuntimeSampler().sample_once()
+    for m in REGISTRY.collect():
+        assert m.help, f"{m.name}: every family must carry HELP text"
+
+    # A label value exercising the escaping rules rides along.
+    esc = REGISTRY.counter(
+        "tdn_conformance_escape_total", "escaping probe", labels=("path",)
+    )
+    esc.labels(path='a"b\\c\nd').inc()
+
+    text = render(REGISTRY)
+    series_re = re.compile(
+        r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\})?$'
+    )
+    seen_type: dict[str, str] = {}
+    seen_help: set[str] = set()
+    # histogram family -> labelset -> {"buckets": [(le, v)], suffixes}
+    hists: dict[str, dict[str, dict]] = {}
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            seen_help.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert name not in seen_type, f"duplicate TYPE for {name}"
+            seen_type[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        series, _, value = line.rpartition(" ")
+        float(value)  # every sample value parses (incl. NaN/+Inf)
+        m = series_re.match(series)
+        assert m, f"series does not match the exposition grammar: {line}"
+        base = m.group("name")
+        family = base
+        suffix = None
+        for sfx in ("_bucket", "_sum", "_count"):
+            stem = base[: -len(sfx)] if base.endswith(sfx) else None
+            if stem and seen_type.get(stem) == "histogram":
+                family, suffix = stem, sfx
+                break
+        assert family in seen_type, (
+            f"sample before (or without) its TYPE line: {line}"
+        )
+        assert family in seen_help, (
+            f"sample before (or without) its HELP line: {line}"
+        )
+        kind = seen_type[family]
+        if kind == "histogram":
+            assert suffix is not None, (
+                f"histogram family {family} exposed a bare series: {line}"
+            )
+            labels = series[len(base):]
+            pairs = re.findall(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"', labels
+            )
+            key = tuple(sorted((k, v) for k, v in pairs if k != "le"))
+            st = hists.setdefault(family, {}).setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if suffix == "_bucket":
+                le = re.search(r'le="([^"]*)"', labels)
+                assert le, f"_bucket series without le label: {line}"
+                st["buckets"].append((le.group(1), float(value)))
+            elif suffix == "_sum":
+                st["sum"] = float(value)
+            else:
+                st["count"] = float(value)
+        else:
+            assert suffix is None
+            if kind == "counter":
+                assert base.endswith("_total") or base.endswith("_info"), (
+                    f"counter {base} should end in _total"
+                )
+    # Histogram ladders: cumulative, +Inf present and equal to _count.
+    assert hists, "no histogram families rendered"
+    for family, labelsets in hists.items():
+        for key, st in labelsets.items():
+            assert st["sum"] is not None, f"{family}{key}: missing _sum"
+            assert st["count"] is not None, f"{family}{key}: missing _count"
+            assert st["buckets"], f"{family}{key}: no buckets"
+            assert st["buckets"][-1][0] == "+Inf", (
+                f"{family}{key}: ladder must end at +Inf"
+            )
+            values = [v for _, v in st["buckets"]]
+            assert values == sorted(values), (
+                f"{family}{key}: bucket counts must be cumulative"
+            )
+            assert values[-1] == st["count"], (
+                f"{family}{key}: +Inf bucket must equal _count"
+            )
+    # The new ISSUE-6 gauge family is registered and conformant.
+    assert seen_type.get("tdn_int8_speedup_ratio") == "gauge"

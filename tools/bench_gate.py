@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff the newest BENCH round against its
+predecessor and FAIL on a >5% hot-path regression.
+
+The per-round ``BENCH_r*.json`` diffs have existed since round 2 and
+caught nothing, because nothing enforced them — host-fed throughput
+decayed 233k -> 199k samples/s across r02->r05 with every round green.
+This tool is the enforcement half of the perf-attribution layer
+(``tpu_dist_nn/obs/profile.py``): it gates the serving hot-path
+metrics, and when one regresses it folds the ``/profile`` per-stage
+breakdown into the report so the failure names WHERE the time went,
+not just that it went.
+
+Gated metrics (docs/PERF.md "Regression gate"):
+
+    host_fed_samples_per_sec        parsed.value                 higher
+    device_resident_samples_per_sec parsed.device_resident_...   higher
+    serving_rps                     serving.coalesced.rps        higher
+    generate_rps                    serving.generate.requests_per_s
+                                                                 higher
+    generate_ttft_p99_ms            serving.generate.ttft_p99_ms lower
+
+Rules:
+
+* A metric regresses when it moves more than ``--threshold`` (default
+  5%) in its BAD direction; improvements never fail.
+* A metric absent from either round is skipped (reported as such) —
+  older rounds predate some series.
+* Rounds from DIFFERENT backends skip the whole gate with exit 0: a
+  cpu-fallback round against a real-TPU round is not a regression
+  signal, it is a hardware change (the rule that keeps the gate honest
+  on boxes whose TPU tunnel flaps).
+* ``--report-only`` prints the identical report but always exits 0 —
+  the mode the quick tier runs against the checked-in r04->r05 pair
+  (which carries a real ~10% serving_rps regression; the enforced gate
+  exists so the NEXT one cannot land silently).
+
+Exit codes: 0 pass/skip/report-only, 1 enforced regression, 2 usage.
+
+Usage:
+    python tools/bench_gate.py                          # newest pair
+    python tools/bench_gate.py --current BENCH_r05.json \\
+        --previous BENCH_r04.json
+    python tools/bench_gate.py --threshold 0.05 --report-only
+    python tools/bench_gate.py --profile http://host:9100/profile
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import urllib.request
+
+DEFAULT_THRESHOLD = 0.05
+
+# (label, path into the parsed bench doc, direction). "higher" means
+# higher is better (throughput); "lower" means lower is better (TTFT).
+GATED_METRICS = (
+    ("host_fed_samples_per_sec", ("value",), "higher"),
+    ("device_resident_samples_per_sec",
+     ("device_resident_samples_per_sec",), "higher"),
+    ("serving_rps", ("serving", "coalesced", "rps"), "higher"),
+    ("generate_rps", ("serving", "generate", "requests_per_s"), "higher"),
+    ("generate_ttft_p99_ms", ("serving", "generate", "ttft_p99_ms"),
+     "lower"),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_round(path: str) -> dict:
+    """A BENCH_r*.json's parsed payload (the driver wraps the bench
+    JSON line under "parsed"; a bare bench dump is accepted too)."""
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if isinstance(parsed, dict):
+        return parsed
+    if isinstance(doc, dict) and "value" in doc:
+        return doc
+    raise ValueError(f"{path}: not a BENCH round (no 'parsed' payload)")
+
+
+def find_rounds(bench_dir: str) -> list[tuple[int, str]]:
+    out = []
+    for name in os.listdir(bench_dir):
+        m = _ROUND_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(bench_dir, name)))
+    return sorted(out)
+
+
+def resolve_pair(args) -> tuple[str, str]:
+    """(current_path, previous_path) from flags or discovery: newest
+    round in --dir, previous from its recorded ``prev_bench.file`` (the
+    lineage the bench itself wrote) else the next-lower round file."""
+    if args.current and args.previous:
+        return args.current, args.previous
+    rounds = find_rounds(args.dir)
+    if args.current:
+        cur_path = args.current
+    else:
+        # With an explicit --previous only the current round needs
+        # discovery; without one the previous must be discoverable too.
+        need = 1 if args.previous else 2
+        if len(rounds) < need:
+            raise FileNotFoundError(
+                f"need {need} BENCH_r*.json round(s) in {args.dir!r} "
+                f"(found {len(rounds)})"
+            )
+        cur_path = rounds[-1][1]
+    if args.previous:
+        return cur_path, args.previous
+    cur = load_round(cur_path)
+    prev_name = (cur.get("prev_bench") or {}).get("file")
+    if prev_name:
+        prev_path = os.path.join(args.dir, prev_name)
+        if os.path.exists(prev_path):
+            return cur_path, prev_path
+    m = _ROUND_RE.search(os.path.basename(cur_path))
+    if m:
+        below = [p for n, p in rounds if n < int(m.group(1))]
+        if below:
+            return cur_path, below[-1]
+    raise FileNotFoundError(
+        f"no previous round found for {cur_path!r} (pass --previous)"
+    )
+
+
+def _dig(doc: dict, path: tuple) -> float | None:
+    node = doc
+    for key in path:
+        if not isinstance(node, dict) or node.get(key) is None:
+            return None
+        node = node[key]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def compare(prev: dict, cur: dict,
+            threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """The gate verdict for one round pair.
+
+    Returns ``{"skipped": reason}`` on a backend mismatch, else
+    ``{"metrics": [...], "regressions": [labels]}`` where each metric
+    row carries prev/cur/regression fraction (positive = worse) or a
+    per-metric skip reason.
+    """
+    prev_backend = str(prev.get("backend"))
+    cur_backend = str(cur.get("backend"))
+    if prev_backend != cur_backend:
+        return {
+            "skipped": (
+                f"backend changed between rounds ({prev_backend!r} -> "
+                f"{cur_backend!r}); cross-backend deltas are hardware "
+                "changes, not regressions"
+            ),
+        }
+    metrics = []
+    regressions = []
+    for label, path, direction in GATED_METRICS:
+        p, c = _dig(prev, path), _dig(cur, path)
+        if p is None or c is None:
+            metrics.append({
+                "metric": label,
+                "skipped": "absent in "
+                + ("both rounds" if p is None and c is None
+                   else "previous round" if p is None else "current round"),
+            })
+            continue
+        if p <= 0:
+            metrics.append({
+                "metric": label,
+                "skipped": f"previous value not positive ({p})",
+            })
+            continue
+        # regression fraction: positive = moved the BAD way.
+        reg = (p - c) / p if direction == "higher" else (c - p) / p
+        row = {
+            "metric": label, "previous": p, "current": c,
+            "direction": direction, "regression": round(reg, 4),
+            "failed": reg > threshold,
+        }
+        metrics.append(row)
+        if row["failed"]:
+            regressions.append(label)
+    return {"metrics": metrics, "regressions": regressions,
+            "threshold": threshold, "backend": cur_backend}
+
+
+def load_profile(source: str | None) -> dict | None:
+    """A /profile breakdown for attribution: an http(s) URL (a live
+    ``--metrics-port`` endpoint), a JSON file path, or None. Fetch
+    failures degrade to None — attribution is garnish, the verdict
+    never depends on it."""
+    if not source:
+        return None
+    try:
+        if source.startswith(("http://", "https://")):
+            with urllib.request.urlopen(source, timeout=5.0) as resp:
+                return json.loads(resp.read())
+        with open(source) as f:
+            return json.load(f)
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+        print(f"# profile attribution unavailable ({e!r})", file=sys.stderr)
+        return None
+
+
+def attribution_lines(profile: dict | None) -> list[str]:
+    """Top stage shares per method — where the regressed time goes."""
+    if not profile:
+        return []
+    lines = ["where the time goes (/profile stage shares):"]
+    for method in sorted(profile.get("methods", {})):
+        m = profile["methods"][method]
+        tops = ", ".join(
+            f"{s['stage']} {s['share'] * 100:.1f}% "
+            f"(p99 {s['p99_s'] * 1e3:.2f}ms)"
+            for s in m.get("stages", ())[:4]
+        )
+        lines.append(
+            f"  {method}: {m.get('traces', 0)} traces — {tops}"
+        )
+    return lines
+
+
+def render_report(verdict: dict, cur_path: str, prev_path: str,
+                  profile: dict | None = None,
+                  report_only: bool = False) -> str:
+    lines = [
+        f"bench gate: {os.path.basename(prev_path)} -> "
+        f"{os.path.basename(cur_path)}"
+        + (" [report-only]" if report_only else ""),
+    ]
+    if "skipped" in verdict:
+        lines.append(f"SKIP: {verdict['skipped']}")
+        return "\n".join(lines)
+    lines.append(
+        f"backend: {verdict['backend']}  threshold: "
+        f"{verdict['threshold'] * 100:.0f}%"
+    )
+    for row in verdict["metrics"]:
+        if "skipped" in row:
+            lines.append(f"  SKIP {row['metric']:<34} {row['skipped']}")
+            continue
+        arrow = "v" if row["regression"] > 0 else "^"
+        mark = "FAIL" if row["failed"] else " ok "
+        lines.append(
+            f"  {mark} {row['metric']:<34} {row['previous']:>12.1f} -> "
+            f"{row['current']:>12.1f}  {arrow}{abs(row['regression']) * 100:.1f}%"
+        )
+    if verdict["regressions"]:
+        lines.append(
+            f"REGRESSED past {verdict['threshold'] * 100:.0f}%: "
+            + ", ".join(verdict["regressions"])
+        )
+        lines.extend(attribution_lines(profile))
+        if not profile:
+            lines.append(
+                "  (no /profile attribution attached — rerun with "
+                "--profile <url-or-json> against a serving run to see "
+                "which stage ate the time)"
+            )
+    else:
+        lines.append("all gated metrics within threshold")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail a PR that regresses the serving hot path >5% "
+                    "between BENCH rounds",
+    )
+    ap.add_argument("--current", help="current round BENCH_r*.json "
+                                      "(default: newest in --dir)")
+    ap.add_argument("--previous",
+                    help="previous round (default: the current round's "
+                         "recorded prev_bench.file, else next-lower round)")
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default .)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="regression fraction that fails the gate "
+                         "(default 0.05 = 5%%)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="print the identical report but always exit 0 "
+                         "(the known-regressed-pair mode)")
+    ap.add_argument("--profile", default=None,
+                    help="a /profile URL or saved JSON for per-stage "
+                         "attribution on failure")
+    ap.add_argument("--json", action="store_true",
+                    help="also print the machine verdict as one JSON line")
+    args = ap.parse_args(argv)
+    if not 0 < args.threshold < 1:
+        print(f"error: --threshold must be in (0, 1), got {args.threshold}",
+              file=sys.stderr)
+        return 2
+    try:
+        cur_path, prev_path = resolve_pair(args)
+        cur, prev = load_round(cur_path), load_round(prev_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    verdict = compare(prev, cur, args.threshold)
+    # Attribution source priority: an explicit --profile (live /profile
+    # endpoint or saved JSON), else the breakdown bench.py embeds in
+    # the current round's serving section.
+    profile = load_profile(args.profile) or (
+        (cur.get("serving") or {}).get("profile")
+    )
+    print(render_report(verdict, cur_path, prev_path, profile,
+                        report_only=args.report_only))
+    if args.json:
+        print(json.dumps({
+            "current": os.path.basename(cur_path),
+            "previous": os.path.basename(prev_path),
+            "report_only": args.report_only,
+            **verdict,
+        }))
+    if verdict.get("regressions") and not args.report_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

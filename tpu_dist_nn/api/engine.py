@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 
 import jax
@@ -56,12 +57,14 @@ from tpu_dist_nn.parallel.pipeline import (
     pipeline_spec_summary,
 )
 from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import REGISTRY
 from tpu_dist_nn.train.metrics import classification_metrics
 from tpu_dist_nn.train.trainer import TrainConfig, train_fcnn
 from tpu_dist_nn.train.pipeline_trainer import train_pipelined
 
 log = logging.getLogger("tpu_dist_nn.engine")
+slog = get_logger("tpu_dist_nn.engine")
 
 # Engine metric families (docs/OBSERVABILITY.md). Host-side float adds
 # only — a time.monotonic() pair around a device call, never a fetch.
@@ -101,6 +104,23 @@ _WARM_BUCKETS = REGISTRY.gauge(
     "tdn_engine_warm_buckets",
     "precompiled pow2 row-bucket programs resident in the jit cache",
 )
+# Measured at warm_buckets time on quantized engines: f32 wall time /
+# int8 wall time for one warmed-bucket launch. > 1 means the int8 path
+# pays off on the active backend; < 1 means quantized serving is
+# SLOWER here (the BENCH int8_vs_f32 0.24-0.48x regression, made
+# visible at serve time instead of only in the round artifacts).
+_INT8_RATIO = REGISTRY.gauge(
+    "tdn_int8_speedup_ratio",
+    "f32 launch wall time / int8 launch wall time on the largest warm "
+    "bucket (quantized engines; < 1 = int8 is slower on this backend; "
+    "NaN until a quantized engine has measured)",
+)
+# Unlabeled gauges materialize at 0 immediately — which would read as
+# "int8 is catastrophically slow" on every UNquantized process under
+# the `< 1` alert the HELP text invites. NaN is the scrape-safe
+# "no measurement yet" (renders as the text format's NaN literal;
+# comparisons against it are false in PromQL).
+_INT8_RATIO.set(float("nan"))
 
 
 @dataclasses.dataclass
@@ -217,6 +237,9 @@ class Engine:
         self._np_dtype = np.dtype(dtype)
         # Pow2 row buckets already compiled+executed by warm_buckets.
         self._warm_buckets: set[int] = set()
+        # One automatic int8-payoff measurement per engine (warm_buckets
+        # is idempotent and re-entered; the f32-arm compile is not free).
+        self._int8_measured = False
         # First-class fault-injection hook points (monkeypatch-free):
         # when set, called at the top of infer_async / fetch with the
         # batch / pending handle. tpu_dist_nn.testing.faults attaches
@@ -355,7 +378,8 @@ class Engine:
             # of on the first unlucky live request mix.
             engine.warm_buckets(max(warm_rows, 1 if warmup else 0))
         engine.setup_seconds = time.monotonic() - t0
-        log.info("engine up in %.2fs: %s", engine.setup_seconds, engine.placement())
+        slog.info("engine.up", seconds=round(engine.setup_seconds, 3),
+                  placement=engine.placement())
         return engine
 
     def placement(self) -> dict:
@@ -504,7 +528,76 @@ class Engine:
                 # overwrites with its own count.
                 _WARM_BUCKETS.set(len(self._warm_buckets))
             n *= 2
+        if (
+            warmed
+            and (self._q is not None or self._q_pp is not None)
+            and not self._int8_measured
+            and os.environ.get("TDN_INT8_WARMUP_MEASURE", "1") != "0"
+        ):
+            # The int8 payoff check rides the FIRST warm (the port is
+            # not open yet): the BENCH int8_vs_f32 regression becomes a
+            # serve-time gauge + structured warning instead of a
+            # round-artifact archaeology find. Costs one f32 compile of
+            # the never-warmed float path plus a few launches —
+            # TDN_INT8_WARMUP_MEASURE=0 skips it where that compile is
+            # too expensive (explicit measure_int8_speedup() calls
+            # still work).
+            self.measure_int8_speedup()
         return warmed
+
+    def measure_int8_speedup(self, rows: int | None = None) -> float | None:
+        """Time one f32 vs one int8 launch on the largest warm bucket
+        (or ``rows``) and publish ``tdn_int8_speedup_ratio``.
+
+        Returns f32_seconds / int8_seconds (> 1: the quantized path is
+        faster on this backend), or None on a non-quantized engine.
+        Runs the engine's OWN dispatch both ways — the f32 arm
+        temporarily clears the quantized state so ``_infer_impl``
+        selects the float path for any placement (single-chip, sharded,
+        pipelined, interleaved). Best-of-3 after one warm call per arm,
+        so neither side pays its XLA compile inside the timed window.
+        Bring-up only: not safe concurrent with live traffic.
+        """
+        if self._q is None and self._q_pp is None:
+            return None
+        if rows is None:
+            rows = max(self._warm_buckets) if self._warm_buckets else 1
+        x = np.zeros((int(rows), self.model.input_dim), self._np_dtype)
+
+        def best_of(n: int = 3) -> float:
+            self.infer(x)  # warm (compile lands outside the timing)
+            times = []
+            for _ in range(n):
+                t0 = time.monotonic()
+                self.infer(x)
+                times.append(time.monotonic() - t0)
+            return min(times)
+
+        q, q_pp, q_apply = self._q, self._q_pp, getattr(self, "_q_apply", None)
+        self._q = self._q_pp = self._q_apply = None
+        try:
+            f32_s = best_of()
+        finally:
+            self._q, self._q_pp, self._q_apply = q, q_pp, q_apply
+        int8_s = best_of()
+        ratio = f32_s / int8_s if int8_s > 0 else float("inf")
+        self._int8_measured = True
+        _INT8_RATIO.set(ratio)
+        if ratio < 1.0:
+            slog.warning(
+                "int8.slower_than_f32", ratio=round(ratio, 3),
+                rows=int(rows), f32_ms=round(f32_s * 1e3, 3),
+                int8_ms=round(int8_s * 1e3, 3),
+                backend=jax.default_backend(),
+                hint="serve without --quantize on this backend (int8 "
+                     "is a dequantize-dominated loss here)",
+            )
+        else:
+            slog.info(
+                "int8.speedup", ratio=round(ratio, 3), rows=int(rows),
+                backend=jax.default_backend(),
+            )
+        return ratio
 
     @property
     def warm_bucket_count(self) -> int:

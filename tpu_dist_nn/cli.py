@@ -15,6 +15,10 @@
 ``tdn trace``  — pull a ``--metrics-port`` endpoint's recorded request
                  spans as a Chrome trace-event file (obs/trace.py);
                  the output opens directly in Perfetto/chrome://tracing
+``tdn profile``— pull the per-stage self-time breakdown (obs/profile.py
+                 via ``GET /profile``) as a "where does the time go"
+                 table, optionally with an on-demand ``jax.profiler``
+                 device capture (``GET /debug/profile``)
 """
 
 from __future__ import annotations
@@ -1702,19 +1706,111 @@ def cmd_trace(args) -> int:
         f.write(body)
     spans = [e for e in events if e.get("ph") == "X"]
     traces = {e["args"]["trace_id"] for e in spans if "trace_id" in e.get("args", {})}
-    slowest = sorted(spans, key=lambda e: e.get("dur", 0), reverse=True)[:3]
+    # Slowest-span summary by SELF time (child time subtracted): a slow
+    # `fetch` must not inflate its `rpc.Process` parent's row and hide
+    # the real culprit. Containment nesting + interval subtraction live
+    # in obs/profile (the same math /profile serves).
+    from tpu_dist_nn.obs.profile import SpanRecord, compute_self_times
+
+    records = [
+        SpanRecord(
+            e["name"], e["args"].get("trace_id", ""),
+            e["args"].get("span_id", f"_anon{i}"),
+            e["args"].get("parent_id"),
+            e["ts"] / 1e6, e.get("dur", 0) / 1e6,
+        )
+        for i, e in enumerate(spans) if "args" in e
+    ]
+    selfs = compute_self_times(records)
+    by_self = sorted(
+        records, key=lambda r: selfs.get(r.span_id, 0.0), reverse=True
+    )[:3]
     print(json.dumps({
         "out": args.out,
         "events": len(events),
         "spans": len(spans),
         "traces": len(traces),
         "slowest": [
-            {"name": e["name"], "dur_ms": round(e.get("dur", 0) / 1000, 3),
-             "trace_id": e.get("args", {}).get("trace_id")}
-            for e in slowest
+            {"name": r.name,
+             "self_ms": round(selfs.get(r.span_id, 0.0) * 1e3, 3),
+             "dur_ms": round(r.dur * 1e3, 3),
+             "trace_id": r.trace_id or None}
+            for r in by_self
         ],
+        "slowest_ranked_by": "self_time",
         "open_with": "https://ui.perfetto.dev or chrome://tracing",
     }))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Pull a running endpoint's per-stage self-time breakdown — the
+    "where does the time go" table (``tdn profile --target
+    host:metrics-port``) — and, with ``--capture-seconds``, an
+    on-demand ``jax.profiler`` device trace zip from
+    ``/debug/profile`` (open the extracted directory in TensorBoard /
+    Perfetto alongside the request spans from ``tdn trace``)."""
+    from tpu_dist_nn.obs.profile import format_profile_table
+
+    base = _endpoint_base(args.target)
+    path = "/profile"
+    params = []
+    if args.window is not None:
+        params.append(f"window={args.window}")
+    if args.top is not None:
+        params.append(f"top={args.top}")
+    if params:
+        path += "?" + "&".join(params)
+    body = _endpoint_get(base, path, args.timeout)
+    try:
+        doc = json.loads(body)
+        doc["methods"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise ValueError(
+            f"{base}{path} did not return a /profile document: {e}"
+        ) from e
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(format_profile_table(doc))
+    if args.capture_seconds is not None:
+        # Device capture AFTER the breakdown (the table tells you
+        # whether a capture is even worth the pause): the artifact is
+        # the zipped TensorBoard-format profiler directory. Fetched
+        # directly (not via _endpoint_get): the endpoint's graceful
+        # degrades arrive as HTTP 503/409 with a JSON reason in the
+        # BODY, and that reason — not a bare status line — is the
+        # user-facing error.
+        import urllib.error
+        import urllib.request
+
+        url = f"{base}/debug/profile?seconds={args.capture_seconds}"
+        try:
+            with urllib.request.urlopen(
+                # The HTTP wait IS the capture window plus writeout.
+                url, timeout=args.timeout + float(args.capture_seconds) + 30.0,
+            ) as resp:
+                cap = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace").strip()
+            raise ValueError(
+                f"device capture unavailable (HTTP {e.code}): {body}"
+            ) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ValueError(f"could not fetch {url}: {e}") from e
+        if not cap.startswith(b"PK"):
+            raise ValueError(
+                f"device capture unavailable: {cap.decode(errors='replace').strip()}"
+            )
+        with open(args.capture_out, "wb") as f:
+            f.write(cap)
+        print(json.dumps({
+            "device_capture": args.capture_out,
+            "seconds": args.capture_seconds,
+            "bytes": len(cap),
+            "open_with": "unzip, then tensorboard --logdir <dir> or "
+                         "ui.perfetto.dev",
+        }))
     return 0
 
 
@@ -2031,6 +2127,13 @@ def build_parser() -> argparse.ArgumentParser:
              "to host CPU if it hangs or errors; cpu forces the host "
              "backend; tpu uses the accelerator unconditionally "
              "(env: TDN_PLATFORM, probe bound: TDN_CLI_BACKEND_TIMEOUT)",
+    )
+    parser.add_argument(
+        "--log-json", action="store_true",
+        default=os.environ.get("TDN_LOG_JSON", "") == "1",
+        help="emit logs as one JSON object per line (structured "
+             "records keep their event/fields; everything else "
+             "degrades to {'event': message}) — env: TDN_LOG_JSON=1",
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -2451,6 +2554,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_trace)
 
+    p = sub.add_parser("profile",
+                       help="pull a --metrics-port endpoint's per-stage "
+                            "self-time breakdown (the 'where does the "
+                            "time go' table), optionally with an "
+                            "on-demand device-trace capture")
+    p.add_argument("--target", required=True,
+                   help="host:port of a running --metrics-port endpoint")
+    p.add_argument("--window", type=float, default=None,
+                   help="only traces whose root ended within the last "
+                        "N seconds (default: everything buffered)")
+    p.add_argument("--top", type=int, default=5,
+                   help="slowest exemplar traces per method (default 5)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw /profile JSON instead of the table")
+    p.add_argument("--capture-seconds", type=float, default=None,
+                   metavar="N",
+                   help="also capture a jax.profiler device trace for N "
+                        "seconds via /debug/profile (503s gracefully on "
+                        "backends without profiler support)")
+    p.add_argument("--capture-out", default="device_profile.zip",
+                   help="where the capture zip lands (default "
+                        "device_profile.zip)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="HTTP timeout in seconds (default 5)")
+    p.set_defaults(fn=cmd_profile)
+
     return parser
 
 
@@ -2529,6 +2658,10 @@ def _resolve_platform(choice: str) -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "log_json", False):
+        from tpu_dist_nn.obs.log import setup_json_logging
+
+        setup_json_logging()
     try:
         if hasattr(args, "coordinator"):
             # up/infer/train/lm touch the backend; oracle/import-* stay

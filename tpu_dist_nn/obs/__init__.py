@@ -22,6 +22,15 @@ metrics layer the serving/training hot paths publish into:
     :data:`~tpu_dist_nn.obs.trace.TRACER`, ``x-tdn-trace`` wire
     propagation across the gRPC hop, and Chrome trace-event export
     served from ``GET /trace`` (``tdn trace`` pulls and saves it).
+  - :mod:`tpu_dist_nn.obs.profile` — performance attribution: completed
+    spans folded into a per-stage SELF-time breakdown (p50/p99/share
+    per stage, per method), served from ``GET /profile`` (``tdn
+    profile`` pretty-prints it; ``tools/bench_gate.py`` folds it into
+    regression reports).
+  - :mod:`tpu_dist_nn.obs.log` — structured JSON logging: event-shaped,
+    trace-correlated, rate-limited records for the serving/engine
+    operational paths (``tdn --log-json`` renders the whole process's
+    logs as JSON lines).
 
 Every metric this framework publishes is prefixed ``tdn_``; the
 catalog lives in ``docs/OBSERVABILITY.md``. All updates are plain
@@ -48,6 +57,15 @@ from tpu_dist_nn.obs.trace import (  # noqa: F401
     TRACER,
     Tracer,
 )
+from tpu_dist_nn.obs.profile import (  # noqa: F401
+    format_profile_table,
+    profile_snapshot,
+)
+from tpu_dist_nn.obs.log import (  # noqa: F401
+    JsonFormatter,
+    get_logger,
+    setup_json_logging,
+)
 
 __all__ = [
     "REGISTRY",
@@ -62,4 +80,9 @@ __all__ = [
     "TRACE_HEADER",
     "TRACER",
     "Tracer",
+    "profile_snapshot",
+    "format_profile_table",
+    "get_logger",
+    "setup_json_logging",
+    "JsonFormatter",
 ]

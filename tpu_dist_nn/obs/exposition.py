@@ -9,6 +9,12 @@ second port (``tdn up --grpc-port 5101 --metrics-port 9100``).
 (structured readiness, the reference's TCP poll as JSON): HTTP 200
 when ``ready``, 503 when not — so the same probe a human curls is the
 one a load balancer gates on.
+
+``/profile`` serves the per-stage self-time breakdown
+(:func:`tpu_dist_nn.obs.profile.profile_snapshot`); ``/debug/profile``
+runs an on-demand ``jax.profiler`` device capture and returns the
+artifact as a zip (degrading to a JSON 503 on backends without
+profiler support).
 """
 
 from __future__ import annotations
@@ -17,6 +23,7 @@ import json
 import logging
 import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_dist_nn.obs.registry import REGISTRY, Registry
@@ -124,7 +131,8 @@ def parse_prometheus_text(text: str) -> dict:
 
 
 class MetricsServer:
-    """The /metrics + /healthz + /trace endpoint on a daemon thread.
+    """The /metrics + /healthz + /trace + /profile endpoint on a
+    daemon thread.
 
     ``health_fn`` is polled per /healthz request (``Engine.health`` in
     the serving wiring); omit it for processes with no engine — the
@@ -135,7 +143,18 @@ class MetricsServer:
     save the body and open it in Perfetto / ``chrome://tracing``, or
     let ``tdn trace`` do both. ``tracer`` overrides the process-wide
     :data:`tpu_dist_nn.obs.trace.TRACER` (tests).
+
+    ``GET /profile?window=S&top=N`` serves the per-stage self-time
+    breakdown over the same tracer (``tdn profile`` pretty-prints it).
+    ``GET /debug/profile?seconds=N`` captures a ``jax.profiler`` device
+    trace for N seconds and returns the TensorBoard-format artifact as
+    one zip body; one capture at a time (409 while busy), 503 with a
+    JSON error where the backend has no profiler.
     """
+
+    # On-demand device captures are bounded: a typo'd ?seconds= must
+    # not pin the profiler (and its buffer growth) for an hour.
+    MAX_CAPTURE_SECONDS = 60.0
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
                  registry: Registry | None = None, health_fn=None,
@@ -155,6 +174,12 @@ class MetricsServer:
                 elif path == "/trace":
                     status, body = outer._trace_body(query)
                     self._reply(status, "application/json", body)
+                elif path == "/profile":
+                    status, body = outer._profile_body(query)
+                    self._reply(status, "application/json", body)
+                elif path == "/debug/profile":
+                    status, ctype, body = outer._debug_profile_body(query)
+                    self._reply(status, ctype, body)
                 else:
                     self._reply(404, "text/plain", b"not found\n")
 
@@ -170,6 +195,10 @@ class MetricsServer:
 
         self._health_fn = health_fn
         self._tracer = tracer
+        # One device capture at a time: jax.profiler.trace is a
+        # process-global session — a second concurrent start raises
+        # deep inside the profiler instead of returning a clean 409.
+        self._capture_lock = threading.Lock()
         self._closed = False
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -193,12 +222,15 @@ class MetricsServer:
         status = 200 if health.get("ready") else 503
         return status, json.dumps(health).encode() + b"\n"
 
-    def _trace_body(self, query: str):
-        tracer = self._tracer
-        if tracer is None:
-            from tpu_dist_nn.obs.trace import TRACER
+    def _resolve_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from tpu_dist_nn.obs.trace import TRACER
 
-            tracer = TRACER
+        return TRACER
+
+    def _trace_body(self, query: str):
+        tracer = self._resolve_tracer()
         limit = None
         for part in query.split("&"):
             k, _, v = part.partition("=")
@@ -208,6 +240,83 @@ class MetricsServer:
                 except ValueError:
                     return 400, b'{"error": "limit must be an integer"}\n'
         return 200, tracer.render_json(limit).encode() + b"\n"
+
+    def _profile_body(self, query: str):
+        from tpu_dist_nn.obs.profile import profile_snapshot
+
+        window = None
+        top = 5
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if not v:
+                continue
+            try:
+                if k == "window":
+                    window = float(v)
+                elif k == "top":
+                    top = int(v)
+            except ValueError:
+                return 400, (
+                    b'{"error": "window must be a number of seconds, '
+                    b'top an integer"}\n'
+                )
+        doc = profile_snapshot(self._resolve_tracer(), window=window, top=top)
+        return 200, json.dumps(doc).encode() + b"\n"
+
+    def _debug_profile_body(self, query: str):
+        """On-demand device capture: run ``jax.profiler.trace`` for
+        ``?seconds=N`` (default 2, capped) and return the TensorBoard-
+        format artifact directory as one zip body. Every failure mode
+        is a JSON status, never a handler traceback: backends without
+        profiler support 503, a concurrent capture 409."""
+        seconds = 2.0
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "seconds" and v:
+                try:
+                    seconds = float(v)
+                except ValueError:
+                    return (400, "application/json",
+                            b'{"error": "seconds must be a number"}\n')
+        if not 0 < seconds <= self.MAX_CAPTURE_SECONDS:
+            return (400, "application/json", json.dumps({
+                "error": f"seconds must be in (0, "
+                         f"{self.MAX_CAPTURE_SECONDS:g}]",
+            }).encode() + b"\n")
+        if not self._capture_lock.acquire(blocking=False):
+            return (409, "application/json",
+                    b'{"error": "a device capture is already running"}\n')
+        try:
+            import io
+            import os
+            import shutil
+            import tempfile
+            import zipfile
+
+            tmp = tempfile.mkdtemp(prefix="tdn_device_profile_")
+            try:
+                import jax
+
+                with jax.profiler.trace(tmp):
+                    # The capture window: whatever the serving/training
+                    # threads dispatch during it lands in the trace.
+                    time.sleep(seconds)
+                buf = io.BytesIO()
+                with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                    for root, _, files in os.walk(tmp):
+                        for fname in files:
+                            p = os.path.join(root, fname)
+                            z.write(p, os.path.relpath(p, tmp))
+                return 200, "application/zip", buf.getvalue()
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — degrade, never traceback
+            log.warning("device profile capture failed: %r", e)
+            return (503, "application/json", json.dumps({
+                "error": f"device profiler unavailable: {e!r}",
+            }).encode() + b"\n")
+        finally:
+            self._capture_lock.release()
 
     def close(self) -> None:
         """Idempotent — a second close is a no-op, not a hang (stdlib

@@ -51,9 +51,11 @@ import time
 import numpy as np
 
 from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
 
-log = logging.getLogger(__name__)
+log = logging.getLogger(__name__)  # plain channel (kept for debug use)
+slog = get_logger(__name__)
 
 # Generation metric families (docs/OBSERVABILITY.md catalog). Pushed by
 # the scheduler loop; the slot gauges are sampled by obs/runtime.py.
@@ -507,7 +509,14 @@ class ContinuousScheduler:
                 self.fetch_hook(toks)
             toks = np.asarray(toks)
         except Exception as e:  # noqa: BLE001 — fan out to occupants
-            log.exception("continuous decode step failed")
+            # Rate-limited: a wedged backend fails every subsequent
+            # step too — the first few stack traces are the signal,
+            # thousands more per minute are noise.
+            slog.exception(
+                "gen.step_failed", error=f"{type(e).__name__}: {e}",
+                active_slots=int(self._active.sum()),
+                steps_total=self.batches_total,
+            )
             self._fail_occupants(e)
             return
         self._cache = cache

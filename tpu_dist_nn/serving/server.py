@@ -39,6 +39,7 @@ import grpc
 import numpy as np
 
 from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
 from tpu_dist_nn.serving.wire import (
     GENERATE_METHOD,
@@ -49,6 +50,10 @@ from tpu_dist_nn.serving.wire import (
 )
 
 log = logging.getLogger(__name__)
+# Structured channel for the operational events a log pipeline matches
+# on (server.start, client.rpc_failed, ...): trace-correlated JSON
+# records under `tdn --log-json`, readable key=value lines otherwise.
+slog = get_logger(__name__)
 
 # Serving metric families (docs/OBSERVABILITY.md catalog). All updates
 # are host-side float adds — never a device touch on the hot path.
@@ -593,7 +598,8 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
         # Engine torn down mid-flight: the reference's dead-channel
         # semantics (clients may retry elsewhere).
         _abort(context, method, grpc.StatusCode.UNAVAILABLE, str(e))
-    log.exception("%s failed", what)
+    slog.exception("rpc.internal_error", method=method, what=what,
+                   error=f"{type(e).__name__}: {e}")
     _abort(context, method, grpc.StatusCode.INTERNAL, f"{what} failed: {e}")
 
 
@@ -788,9 +794,10 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
     server.batcher = batcher
     _wrap_server_stop(server, batcher)
     server.start()
-    log.info("gRPC LayerService serving on :%d (wire-compatible with "
-             "run_grpc_inference.py)%s", bound,
-             " with request coalescing" if coalesce else "")
+    slog.info("server.start", method="Process", port=bound,
+              coalesce=coalesce, pipeline_depth=pipeline_depth,
+              warm_rows=warm_rows,
+              max_pending_rows=max_pending_rows)
     return server, bound
 
 
@@ -977,12 +984,9 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
         server.scheduler = sched
         _wrap_server_stop(server, sched)
         server.start()
-        log.info(
-            "gRPC LayerService.Generate serving on :%d (continuous "
-            "batching, %d slots, prompt_len=%d, max_new_tokens=%d%s)",
-            bound, gen_slots, T, N,
-            f", eos_id={eos_id}" if eos_id is not None else "",
-        )
+        slog.info("server.start", method="Generate",
+                  scheduler="continuous", port=bound, gen_slots=gen_slots,
+                  prompt_len=T, max_new_tokens=N, eos_id=eos_id)
         return server, bound
 
     if num_stages > 1:
@@ -1079,13 +1083,9 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
     server.scheduler = None  # continuous-mode handle; static here
     _wrap_server_stop(server, batcher)
     server.start()
-    log.info(
-        "gRPC LayerService.Generate serving on :%d (%s, prompt_len=%d, "
-        "max_new_tokens=%d)%s", bound,
-        f"pipelined x{num_stages} overlapped decode" if num_stages > 1
-        else "single-chip decode", T, N,
-        " with request coalescing" if coalesce else "",
-    )
+    slog.info("server.start", method="Generate", scheduler="static",
+              port=bound, num_stages=num_stages, prompt_len=T,
+              max_new_tokens=N, coalesce=coalesce)
     return server, bound
 
 
@@ -1285,11 +1285,15 @@ class GrpcClient:
                             f"rpc error {code} on attempt {attempt} ({why}): "
                             f"server trace {trace_id}"
                         )
-                        log.warning(
-                            "%s RPC to %s failed (%s, attempt %d, %s) — "
-                            "server trace id %s; pull it with `tdn trace "
-                            "--target <metrics-port>`",
-                            method, self.target, code, attempt, why, trace_id,
+                        # Rate-limited: a dead target under a client
+                        # loop logs its first occurrences then 1/s, not
+                        # one line per failed RPC.
+                        slog.warning(
+                            "client.rpc_failed", method=method,
+                            target=self.target, code=str(code),
+                            attempt=attempt, why=why, trace_id=trace_id,
+                            hint="pull the server span tree with "
+                                 "`tdn trace --target <metrics-port>`",
                         )
                         raise
                     CLIENT_RETRIES.labels(method=method).inc()
