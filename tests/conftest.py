@@ -86,7 +86,11 @@ QUICK_TESTS = {
     "test_continuous": [
         "test_continuous_matches_static_greedy_tokens",
         "test_serve_continuous_loopback_parity_and_counters",
-        "test_gen_ab_smoke_continuous_beats_static"],
+        "test_gen_ab_smoke_continuous_beats_static",
+        # ISSUE 7: prefix-cache bit parity is the correctness anchor,
+        # the shared-prefix A/B smoke the perf gate.
+        "test_prefix_cache_greedy_bit_parity_including_eos",
+        "test_gen_prefix_smoke_cache_on_beats_off"],
     "test_conv": ["test_conv_forward_matches_oracle",
                   "test_engine_routes_conv_model"],
     "test_conv_kernel": ["test_conv_matches_lax[stride1-same]",

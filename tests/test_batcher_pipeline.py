@@ -82,6 +82,7 @@ def _mesh_free_engine(sizes=(8, 6, 4)):
     e = Engine.__new__(Engine)
     e.model = model
     e._pp = e._hp = e._plan = e._q = e._q_pp = None
+    e.int8_auto_disabled = False
     e._params = params_from_spec(model, jnp.float32)
     e.pipelined = False
     e.data_sharded = False

@@ -354,6 +354,104 @@ def test_slot_cache_bounds_validated():
         init_slot_cache(CFG, 2, CFG.max_seq_len + 1)
 
 
+def test_prefill_chunk_into_cache_bitwise_matches_monolithic():
+    # The chunk kernel IS the monolithic prefill when the chunk covers
+    # the whole prompt — and splitting the prompt across chunk calls
+    # must land the exact same logits and cache bytes (the continuous
+    # scheduler's cache-on/cache-off bit-parity anchor rides on this).
+    from tpu_dist_nn.models.generate import (
+        init_slot_cache,
+        prefill_chunk_into_cache,
+        prefill_into_cache,
+    )
+
+    params = init_transformer(jax.random.key(0), CFG)
+    T = 8
+    prompts = _prompt(1, T, seed=8)
+    cache0 = init_slot_cache(CFG, 3, 12)
+    ref_logits, ref_cache = prefill_into_cache(params, CFG, cache0, 1, prompts)
+    # One whole-prompt chunk.
+    lg, c = prefill_chunk_into_cache(params, CFG, cache0, 1, prompts, 0)
+    np.testing.assert_array_equal(np.asarray(lg), np.asarray(ref_logits))
+    np.testing.assert_array_equal(
+        np.asarray(c["k"][:, 1, :T]), np.asarray(ref_cache["k"][:, 1, :T])
+    )
+    # Split 3 + 5: the second chunk attends to the first's K/V.
+    lg2, c2 = prefill_chunk_into_cache(
+        params, CFG, cache0, 1, prompts[:, :3], 0
+    )
+    lg2, c2 = prefill_chunk_into_cache(params, CFG, c2, 1, prompts[:, 3:], 3)
+    np.testing.assert_array_equal(np.asarray(lg2), np.asarray(ref_logits))
+    np.testing.assert_array_equal(
+        np.asarray(c2["k"][:, 1, :T]), np.asarray(ref_cache["k"][:, 1, :T])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(c2["v"][:, 1, :T]), np.asarray(ref_cache["v"][:, 1, :T])
+    )
+
+
+def test_copy_cache_slot_full_extent_and_isolation():
+    # The prefix-cache transfer primitive: dst becomes a bit-exact copy
+    # of src's whole extent; every other slot is untouched; and both
+    # indices are traced (one compile serves any src/dst pair).
+    from tpu_dist_nn.models.generate import (
+        copy_cache_slot,
+        init_slot_cache,
+        prefill_chunk_into_cache,
+    )
+
+    params = init_transformer(jax.random.key(1), CFG)
+    prompts = _prompt(1, 8, seed=9)
+    cache = init_slot_cache(CFG, 3, 12)
+    cache = {k: v + 2.5 for k, v in cache.items()}  # distinguishable
+    _, cache = prefill_chunk_into_cache(params, CFG, cache, 2, prompts, 0)
+    before = {k: np.asarray(v).copy() for k, v in cache.items()}
+    out = copy_cache_slot(cache, 2, 0)
+    for part in ("k", "v"):
+        np.testing.assert_array_equal(
+            np.asarray(out[part][:, 0]), before[part][:, 2]
+        )
+        np.testing.assert_array_equal(  # src and bystander untouched
+            np.asarray(out[part][:, 1]), before[part][:, 1]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out[part][:, 2]), before[part][:, 2]
+        )
+
+
+def test_prefill_chunk_after_copied_prefix_matches_monolithic():
+    # The COW admission path end-to-end at the kernel level: prefix
+    # prefilled into a POOL slot, copied into a request slot, suffix
+    # chunked on top — last-position logits and the request slot's
+    # prompt extent must be bit-identical to a monolithic prefill.
+    from tpu_dist_nn.models.generate import (
+        copy_cache_slot,
+        init_slot_cache,
+        prefill_chunk_into_cache,
+        prefill_into_cache,
+    )
+
+    params = init_transformer(jax.random.key(2), CFG)
+    T, pool_slot, req_slot = 8, 2, 0
+    prompts = _prompt(1, T, seed=10)
+    cache0 = init_slot_cache(CFG, 3, 12)
+    ref_logits, ref_cache = prefill_into_cache(
+        params, CFG, cache0, req_slot, prompts
+    )
+    _, c = prefill_chunk_into_cache(
+        params, CFG, cache0, pool_slot, prompts[:, :4], 0
+    )
+    c = copy_cache_slot(c, pool_slot, req_slot)
+    lg, c = prefill_chunk_into_cache(
+        params, CFG, c, req_slot, prompts[:, 4:], 4
+    )
+    np.testing.assert_array_equal(np.asarray(lg), np.asarray(ref_logits))
+    np.testing.assert_array_equal(
+        np.asarray(c["k"][:, req_slot, :T]),
+        np.asarray(ref_cache["k"][:, req_slot, :T]),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Tensor-parallel decode
 # ---------------------------------------------------------------------------

@@ -528,7 +528,8 @@ def test_int8_warm_measure_runs_once_and_honors_env_gate(monkeypatch):
 
 
 def _round(value=100000.0, *, backend="cpu", device=250000.0,
-           rps=1000.0, gen_rps=60.0, ttft=12.0) -> dict:
+           rps=1000.0, gen_rps=60.0, ttft=12.0, prefix_rps=70.0,
+           prefix_ttft=40.0) -> dict:
     return {
         "value": value,
         "device_resident_samples_per_sec": device,
@@ -537,6 +538,8 @@ def _round(value=100000.0, *, backend="cpu", device=250000.0,
             "coalesced": {"rps": rps},
             "generate": {"requests_per_s": gen_rps,
                          "ttft_p99_ms": ttft},
+            "generate_prefix": {"rps": prefix_rps,
+                                "ttft_p99_ms": prefix_ttft},
         },
     }
 
@@ -587,6 +590,27 @@ def test_bench_gate_skips_absent_metrics_per_metric():
     v = gate.compare(prev, cur)
     skipped = {r["metric"] for r in v["metrics"] if "skipped" in r}
     assert {"generate_rps", "generate_ttft_p99_ms"} <= skipped
+    assert v["regressions"] == []
+
+
+def test_bench_gate_gates_shared_prefix_metrics_both_directions():
+    gate = _load_bench_gate()
+    prev = _round()
+    # The shared-prefix rps dropping >5% fails; its TTFT p99 RISING
+    # >5% fails (lower-is-better direction).
+    v = gate.compare(prev, _round(prefix_rps=60.0))
+    assert v["regressions"] == ["gen_prefix_rps"]
+    v = gate.compare(prev, _round(prefix_ttft=45.0))
+    assert v["regressions"] == ["gen_prefix_ttft_p99_ms"]
+    # Improvements on both never fail.
+    v = gate.compare(prev, _round(prefix_rps=90.0, prefix_ttft=30.0))
+    assert v["regressions"] == []
+    # Rounds that predate the generate_prefix section skip per-metric.
+    old = _round()
+    del old["serving"]["generate_prefix"]
+    v = gate.compare(old, _round())
+    skipped = {r["metric"] for r in v["metrics"] if "skipped" in r}
+    assert {"gen_prefix_rps", "gen_prefix_ttft_p99_ms"} <= skipped
     assert v["regressions"] == []
 
 

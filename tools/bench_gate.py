@@ -19,6 +19,9 @@ Gated metrics (docs/PERF.md "Regression gate"):
     generate_rps                    serving.generate.requests_per_s
                                                                  higher
     generate_ttft_p99_ms            serving.generate.ttft_p99_ms lower
+    gen_prefix_rps                  serving.generate_prefix.rps  higher
+    gen_prefix_ttft_p99_ms          serving.generate_prefix.ttft_p99_ms
+                                                                 lower
 
 Rules:
 
@@ -66,6 +69,12 @@ GATED_METRICS = (
     ("generate_rps", ("serving", "generate", "requests_per_s"), "higher"),
     ("generate_ttft_p99_ms", ("serving", "generate", "ttft_p99_ms"),
      "lower"),
+    # Shared-prefix workload (prefix cache + chunked prefill ON): the
+    # KV-reuse win must not regress once landed. Absent in rounds that
+    # predate the section -> per-metric skip.
+    ("gen_prefix_rps", ("serving", "generate_prefix", "rps"), "higher"),
+    ("gen_prefix_ttft_p99_ms",
+     ("serving", "generate_prefix", "ttft_p99_ms"), "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

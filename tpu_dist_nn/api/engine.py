@@ -240,6 +240,12 @@ class Engine:
         # One automatic int8-payoff measurement per engine (warm_buckets
         # is idempotent and re-entered; the f32-arm compile is not free).
         self._int8_measured = False
+        # When the payoff measurement finds int8 SLOWER than f32 on
+        # this backend (ratio < 1), serving launches auto-route to the
+        # f32 path instead of shipping the regression (TDN_INT8_AUTO=0
+        # opts out; the quantized state is kept, so train()'s
+        # re-quantization and explicit re-measurement still work).
+        self.int8_auto_disabled = False
         # First-class fault-injection hook points (monkeypatch-free):
         # when set, called at the top of infer_async / fetch with the
         # batch / pending handle. tpu_dist_nn.testing.faults attaches
@@ -579,7 +585,14 @@ class Engine:
             f32_s = best_of()
         finally:
             self._q, self._q_pp, self._q_apply = q, q_pp, q_apply
-        int8_s = best_of()
+        # A RE-measurement on an auto-disabled engine must time the real
+        # int8 path, not the f32 reroute the gate would select.
+        gate = self.int8_auto_disabled
+        self.int8_auto_disabled = False
+        try:
+            int8_s = best_of()
+        finally:
+            self.int8_auto_disabled = gate
         ratio = f32_s / int8_s if int8_s > 0 else float("inf")
         self._int8_measured = True
         _INT8_RATIO.set(ratio)
@@ -592,7 +605,27 @@ class Engine:
                 hint="serve without --quantize on this backend (int8 "
                      "is a dequantize-dominated loss here)",
             )
+            if os.environ.get("TDN_INT8_AUTO", "1") != "0":
+                # Close the regression instead of just warning about
+                # it: the measured-slower path never serves traffic.
+                # The f32 programs are already compiled (the f32 arm
+                # of the measurement just ran them), so the reroute is
+                # warm.
+                self.int8_auto_disabled = True
+                slog.warning(
+                    "int8.auto_disabled", ratio=round(ratio, 3),
+                    backend=jax.default_backend(),
+                    hint="serving launches rerouted to the f32 path "
+                         "(TDN_INT8_AUTO=0 opts out of the fallback)",
+                )
+            else:
+                # Explicit opt-out means measure + warn ONLY: a
+                # re-measurement must also clear any reroute a prior
+                # env-enabled run left armed, or the opt-out would
+                # leave the engine stuck on f32.
+                self.int8_auto_disabled = False
         else:
+            self.int8_auto_disabled = False
             slog.info(
                 "int8.speedup", ratio=round(ratio, 3), rows=int(rows),
                 backend=jax.default_backend(),
@@ -631,10 +664,15 @@ class Engine:
         if self._hp is not None:
             mb = max(1, len(x) // self.num_microbatches)
             return self._hp.forward(x, microbatch_size=mb), np.asarray, launch
+        # The int8 serving paths are skipped entirely when the warmup
+        # payoff measurement auto-disabled them (measured slower than
+        # f32 on this backend; measure_int8_speedup).
+        use_int8 = not self.int8_auto_disabled
         if self.pipelined:
             from tpu_dist_nn.parallel.multihost import to_host_numpy
 
-            if self._q_pp is not None and self.virtual_stages > 1:
+            if use_int8 and self._q_pp is not None \
+                    and self.virtual_stages > 1:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_interleaved_quantized,
                 )
@@ -645,7 +683,7 @@ class Engine:
                     num_microbatches=self.num_microbatches,
                 )
                 return out, to_host_numpy, launch
-            if self._q_pp is not None:
+            if use_int8 and self._q_pp is not None:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_quantized,
                 )
@@ -670,7 +708,7 @@ class Engine:
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
             return out, to_host_numpy, launch
-        if self._q is not None and not self.data_sharded:
+        if use_int8 and self._q is not None and not self.data_sharded:
             from tpu_dist_nn.kernels.quantized import fcnn_quantized_forward
 
             return (
@@ -681,7 +719,7 @@ class Engine:
                 np.asarray,
                 launch,
             )
-        if self._q is not None:
+        if use_int8 and self._q is not None:
             # Data-sharded int8: the jnp quantized chain under jit on the
             # batch-sharded global array (weights replicated); XLA keeps
             # the int8 matmuls sharded over the data axis.

@@ -767,6 +767,16 @@ def cmd_lm(args) -> int:
         )
     if getattr(args, "gen_slots", 8) < 1:
         raise ValueError(f"--gen-slots must be >= 1, got {args.gen_slots}")
+    if getattr(args, "prefill_chunk", None) is not None \
+            and args.prefill_chunk < 1:
+        raise ValueError(
+            f"--prefill-chunk must be >= 1, got {args.prefill_chunk}"
+        )
+    if getattr(args, "prefix_cache_blocks", 0) < 0:
+        raise ValueError(
+            f"--prefix-cache-blocks must be >= 0, got "
+            f"{args.prefix_cache_blocks}"
+        )
     if getattr(args, "serve_generate", None) is not None:
         # Validate the WHOLE serving request BEFORE training — every
         # constraint serve_lm_generate would raise after, so a bad flag
@@ -784,6 +794,21 @@ def cmd_lm(args) -> int:
                 "--eos-id is not supported by the pipelined overlapped "
                 "decoder; serve --serve-stages 1 for stop-token "
                 "semantics"
+            )
+        if (args.prefix_cache_blocks or args.prefill_chunk is not None) \
+                and (args.scheduler == "static" or args.serve_stages > 1):
+            raise ValueError(
+                "--prefix-cache-blocks / --prefill-chunk are continuous-"
+                "scheduler features; drop --scheduler static / "
+                "--serve-stages > 1 (or drop the prefix/chunk flags)"
+            )
+        if (args.prefix_cache_blocks
+                and args.prefill_chunk is not None
+                and args.prefill_chunk > args.serve_prompt_len - 1):
+            raise ValueError(
+                f"--prefix-cache-blocks needs a cacheable tier: "
+                f"--prefill-chunk {args.prefill_chunk} must be <= "
+                f"--serve-prompt-len - 1 = {args.serve_prompt_len - 1}"
             )
         if args.layers % max(args.serve_stages, 1):
             raise ValueError(
@@ -1550,6 +1575,8 @@ def cmd_lm(args) -> int:
             max_pending_rows=args.max_pending_rows,
             scheduler=args.scheduler, gen_slots=args.gen_slots,
             eos_id=args.eos_id,
+            prefix_cache_blocks=args.prefix_cache_blocks,
+            prefill_chunk=args.prefill_chunk,
             # Continuous mode: open the port hot (warm compiles exactly
             # the prefill-at-slot + step kernels). The static arm keeps
             # its cold default — its bucket ladder warm is opt-in.
@@ -1574,6 +1601,9 @@ def cmd_lm(args) -> int:
         }
         if server.scheduler is not None:
             report["serving"]["gen_slots"] = args.gen_slots
+            report["serving"]["prefix_cache_blocks"] = \
+                args.prefix_cache_blocks
+            report["serving"]["prefill_chunk"] = args.prefill_chunk
         sampler = None
         if metrics_server is not None and server.batcher is not None:
             from tpu_dist_nn.obs import RuntimeSampler, TRACER
@@ -1848,6 +1878,8 @@ def cmd_warmup(args) -> int:
             params, cfg, slots=args.gen_slots,
             prompt_len=args.serve_prompt_len,
             max_new_tokens=args.serve_new_tokens,
+            prefix_cache_blocks=args.prefix_cache_blocks,
+            prefill_chunk=args.prefill_chunk,
         )
         warmed = sched.warm()
         sched.close()
@@ -1857,6 +1889,8 @@ def cmd_warmup(args) -> int:
             "gen_slots": args.gen_slots,
             "prompt_len": args.serve_prompt_len,
             "max_new_tokens": args.serve_new_tokens,
+            "prefix_cache_blocks": args.prefix_cache_blocks,
+            "prefill_chunk": args.prefill_chunk,
             "seconds": round(time.monotonic() - t0, 3),
             "persistent_cache_dir": cache_dir,
             "persists_across_processes": bool(cache_dir),
@@ -2451,6 +2485,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "byte id and pads the remainder with it "
                         "(applies to --sample-bytes, and to both "
                         "--serve-generate schedulers identically)")
+    p.add_argument("--prefix-cache-blocks", type=int, default=0,
+                   help="reserve this many shared-prefix KV pool "
+                        "blocks in the continuous scheduler's slot "
+                        "cache: requests whose prompts share a cached "
+                        "prefix admit by block copy + suffix-only "
+                        "prefill (ref-counted, LRU-evicted; "
+                        "docs/PERF.md 'Prefix caching & chunked "
+                        "prefill'; 0 = off)")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   metavar="TOKENS",
+                   help="split prompt prefills into chunks of at most "
+                        "this many tokens, one chunk per scheduler "
+                        "iteration, so a long prompt stops stalling "
+                        "resident decode streams; also the prefix-"
+                        "cache tier granularity (default: whole "
+                        "prompt in one launch)")
     p.add_argument("--serve-seconds", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                         "interrupted)")
@@ -2521,6 +2571,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode slots of the server being warmed")
     p.add_argument("--serve-prompt-len", type=int, default=16)
     p.add_argument("--serve-new-tokens", type=int, default=32)
+    p.add_argument("--prefix-cache-blocks", type=int, default=0,
+                   help="match the server's --prefix-cache-blocks so "
+                        "the slot-copy kernel (and the suffix chunk "
+                        "lengths a prefix hit produces) precompile too")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   metavar="TOKENS",
+                   help="match the server's --prefill-chunk so every "
+                        "chunk length precompiles")
     p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
                    help="expose /metrics during the warm (0 = ephemeral, "
                         "printed as a JSON line) — the "

@@ -361,6 +361,114 @@ def prefill_into_cache(params: dict, cfg: TransformerConfig, cache: dict,
     return logits[:, tokens.shape[1] - 1], cache
 
 
+def copy_cache_slot(cache: dict, src, dst) -> dict:
+    """Copy slot ``src``'s FULL ``max_len`` extent onto slot ``dst``
+    (both traced indices) — the prefix-cache transfer primitive
+    (:mod:`tpu_dist_nn.serving.continuous`): pool-block -> request-slot
+    on a prefix HIT (the copy-on-write admission, after which the
+    request decodes into its own slot and can never mutate the shared
+    block), and request-slot -> pool-block on INSERT.
+
+    Copying the whole extent (not just the prefix length) keeps the
+    kernel one compile for every (src, dst, length) combination; the
+    bytes past the prefix frontier are dead either way — a suffix
+    prefill overwrites ``[len, T)`` and attention masks positions
+    beyond the decode frontier (the same argument that makes slot
+    reuse safe).
+    """
+    L, _, M, H, Dh = cache["k"].shape
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    at_src = (0, src, 0, 0, 0)
+    at_dst = (0, dst, 0, 0, 0)
+    size = (L, 1, M, H, Dh)
+    return {
+        "k": lax.dynamic_update_slice(
+            cache["k"], lax.dynamic_slice(cache["k"], at_src, size), at_dst
+        ),
+        "v": lax.dynamic_update_slice(
+            cache["v"], lax.dynamic_slice(cache["v"], at_src, size), at_dst
+        ),
+    }
+
+
+def prefill_chunk_into_cache(params: dict, cfg: TransformerConfig,
+                             cache: dict, slot, tokens: jnp.ndarray,
+                             start):
+    """Prefill ONE CHUNK of a prompt into slot ``slot``: ``tokens
+    (1, C)`` occupy positions ``[start, start + C)`` and attend to the
+    slot's already-filled cache (positions ``< start`` — a cached
+    prefix block copied in by :func:`copy_cache_slot`, or earlier
+    chunks of this same prompt) plus themselves, causally.
+
+    With ``start == 0`` and ``C == T`` this is a whole-prompt prefill
+    (the monolithic :func:`prefill_into_cache` path expressed in chunk
+    form) — the continuous scheduler routes EVERY admission through
+    this kernel so cache-on and cache-off prefills share one numeric
+    path and the greedy bit-parity anchor holds by construction.
+    Numerics deliberately mirror :func:`decode_blocks_slots` (same
+    casts, same f32 score/softmax order, reduction over the full
+    ``max_len`` key extent) for the same reason.
+
+    ``slot`` and ``start`` are traced: one compile per chunk LENGTH
+    covers every slot and every chunk position. Returns
+    ``(logits (1, V) of the chunk's last position, cache)`` — only the
+    final chunk's logits are sampled from (they are the prompt's
+    last-position logits).
+    """
+    params = cfg.cast_params(params)
+    Lc, S, M, H, Dh = cache["k"].shape
+    C = tokens.shape[1]
+    D = cfg.d_model
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    x = params["tok_embed"][tokens] + lax.dynamic_slice(
+        params["pos_embed"], (start, 0), (C, D)
+    )[None]
+    # Key position j is visible to chunk-local query i iff j <= start+i
+    # (the causal mask, offset into the slot's timeline); everything
+    # beyond the chunk's own frontier is future space.
+    allowed = (
+        jnp.arange(M)[None, :]
+        <= (start + jnp.arange(C))[:, None]
+    )  # (C, M)
+    k_rows = lax.dynamic_slice(
+        cache["k"], (0, slot, 0, 0, 0), (Lc, 1, M, H, Dh)
+    )
+    v_rows = lax.dynamic_slice(
+        cache["v"], (0, slot, 0, 0, 0), (Lc, 1, M, H, Dh)
+    )
+
+    def body(carry, inputs):
+        x = carry
+        block, k_cache, v_cache = inputs
+        h = layer_norm(x, block["ln1_g"], block["ln1_b"])
+        qkv = h @ block["w_qkv"] + block["b_qkv"]
+        q, k, v = jnp.split(qkv.reshape(1, C, 3 * H, Dh), 3, axis=2)
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+        )
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            k_cache.astype(jnp.float32),
+        ) / np.sqrt(Dh)
+        scores = jnp.where(allowed[None, None, :, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache).reshape(1, C, H * Dh)
+        x = x + o @ block["w_o"] + block["b_o"]
+        return ffn_sublayer(block, x), (k_cache, v_cache)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_rows, v_rows))
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], ks, (0, slot, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs, (0, slot, 0, 0, 0)),
+    }
+    return unembed(params, x)[:, C - 1], cache
+
+
 def decode_blocks_slots(blocks: dict, cache: dict, pos: jnp.ndarray,
                         x: jnp.ndarray, cfg: TransformerConfig,
                         active: jnp.ndarray):

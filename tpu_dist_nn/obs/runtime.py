@@ -101,6 +101,12 @@ class RuntimeSampler:
             "cumulative active-slot-steps / (steps * slots) of the "
             "continuous decode scheduler",
         )
+        self._g_prefix_used = reg.gauge(
+            "tdn_prefix_cache_blocks_used",
+            "prefix-pool blocks currently holding a cached shared "
+            "prefix (continuous scheduler; hit/miss/evict counters are "
+            "the tdn_prefix_cache_* families)",
+        )
         self._gen_scheds: list[object] = []
         # The tracer observing itself: buffer occupancy plus an
         # eviction counter, so "why is my slow request's trace gone"
@@ -194,6 +200,12 @@ class RuntimeSampler:
                 int(s.slot_steps_total) for s in self._gen_scheds
             )
             self._g_gen_occ.set(slot_steps / steps if steps else 0.0)
+            self._g_prefix_used.set(
+                sum(
+                    int(getattr(s, "prefix_blocks_used", 0))
+                    for s in self._gen_scheds
+                )
+            )
         if self._engines:
             # (tdn_engine_warm_buckets is NOT sampled here: the engine's
             # warm_buckets method is its single writer — a second writer

@@ -1,5 +1,7 @@
 """Continuous batching for LM generation: the iteration-level decode
-scheduler (Orca, OSDI '22) on a slot-based KV cache.
+scheduler (Orca, OSDI '22) on a slot-based KV cache, with
+cross-request KV REUSE (RadixAttention-style shared-prefix caching,
+exact-match tiers) and CHUNKED PREFILL (Sarathi-Serve).
 
 The static Generate path (``serving/server.py``'s ``_Batcher`` over
 ``models.generate.generate``) is run-to-completion batching: a batch is
@@ -8,19 +10,40 @@ next batch start — a 4-token request pays for its 32-token neighbor,
 and late arrivals convoy behind the whole batch. This module schedules
 at DECODE-STEP granularity instead:
 
-* One fixed ``(L, S, max_len, H, Dh)`` slot KV cache
+* One fixed ``(L, S + P, max_len, H, Dh)`` slot KV cache
   (:func:`~tpu_dist_nn.models.generate.init_slot_cache`) holds ``S``
-  independent requests. Shapes never change — admission and retirement
-  only flip entries of a per-slot active mask, the TPU-friendly
-  static-shape answer to vLLM-style paged KV (one request = one slot =
-  one contiguous ``max_len`` extent; no block tables, no gathers on
-  the hot path — trade-off discussion in docs/PERF.md).
+  independent request slots plus ``P`` reserved PREFIX-POOL blocks
+  (``--prefix-cache-blocks``). Shapes never change — admission and
+  retirement only flip entries of a per-slot active mask, the
+  TPU-friendly static-shape answer to vLLM-style paged KV (one request
+  = one slot = one contiguous ``max_len`` extent; no block tables, no
+  gathers on the hot path — trade-off discussion in docs/PERF.md).
+* **Prefix caching**: most production Generate traffic shares a long
+  common prefix (system prompt, few-shot header). The pool caches K/V
+  for chunk-aligned token prefixes, keyed on the exact prefix bytes
+  (exact-match tiers — no radix tree; rationale in docs/PERF.md). A
+  hit admits by COPYING the block into the request's slot
+  (:func:`~tpu_dist_nn.models.generate.copy_cache_slot` — copy-on-
+  write: the request then decodes into its own slot and can never
+  mutate the shared block) and prefilling only the SUFFIX. Blocks are
+  ref-counted (held admission -> retire), evicted LRU at refcount 0,
+  with hit/miss/evict accounting (``tdn_prefix_cache_*``).
+* **Chunked prefill**: prefills longer than ``--prefill-chunk`` tokens
+  are split across scheduler iterations — each iteration runs at most
+  ONE chunk (:func:`~tpu_dist_nn.models.generate.
+  prefill_chunk_into_cache`) alongside the resident decode step, so a
+  4k-token prompt no longer freezes every live decode stream. The
+  per-slot ``pos`` vector already supports the resulting staggered
+  positions. Every admission routes through the chunk kernel (a
+  monolithic prefill is just one whole-prompt chunk), so cache-on and
+  cache-off share ONE numeric path and greedy outputs stay
+  bit-identical — the correctness anchor
+  (test_prefix_cache_greedy_bit_parity).
 * **Admission at step granularity**: whenever a slot is free and a
-  request is pending, its prompt prefills INTO that slot
-  (:func:`~tpu_dist_nn.models.generate.prefill_into_cache`,
-  ``lax.dynamic_update_slice`` at the traced slot index) and the
-  request starts decoding on the very next step — no waiting for the
-  current "batch" to finish, because there is no batch.
+  request is pending, it binds to that slot and starts chunking; the
+  request starts decoding on the step after its last chunk — no
+  waiting for the current "batch" to finish, because there is no
+  batch.
 * **One compiled step kernel**
   (:func:`~tpu_dist_nn.models.generate.decode_step_slots`) advances
   every slot at its OWN position (per-slot ``pos`` vector + active
@@ -34,15 +57,21 @@ at DECODE-STEP granularity instead:
 
 Resilience contract (docs/ROBUSTNESS.md): ``max_pending_rows``
 admission shedding (``tdn_batcher_shed_total``), ``close(timeout)``
+letting resident rows — INCLUDING half-prefilled slots — finish before
 failing still-pending waiters over as UNAVAILABLE (the ``_Batcher``
-drain contract, so ``GracefulDrain`` works unchanged), and the
-``testing/faults.py`` hook points — ``launch_hook`` fires before every
-step-kernel dispatch, ``fetch_hook`` before its token fetch.
+drain contract, so ``GracefulDrain`` works unchanged), and first-class
+fault hook points — ``launch_hook`` fires before every step-kernel
+dispatch, ``fetch_hook`` before its token fetch, and ``prefill_hook``
+before every prefill-chunk dispatch (a mid-prefill fault fails that
+request over, frees its slot, and releases its prefix-block ref).
+Assign a ``testing/faults.py`` plan's ``fire`` directly (the
+``inject_engine_faults`` helper covers only engine hooks).
 """
 
 from __future__ import annotations
 
 import collections
+import functools
 import itertools
 import logging
 import threading
@@ -92,6 +121,132 @@ _BATCH_ROWS = REGISTRY.histogram(
     "tdn_batch_rows", "coalesced rows per device launch (pre-padding)",
     labels=("method",), buckets=POW2_BUCKETS,
 )
+# Prefix-cache accounting (docs/OBSERVABILITY.md catalog; the
+# tdn_prefix_cache_blocks_used gauge rides the runtime sampler).
+_PREFIX_HITS = REGISTRY.counter(
+    "tdn_prefix_cache_hits_total",
+    "admissions served from a cached prefix block (copy-on-write "
+    "block copy + suffix-only prefill)",
+)
+_PREFIX_MISSES = REGISTRY.counter(
+    "tdn_prefix_cache_misses_total",
+    "admissions whose prompt matched no cached prefix tier "
+    "(full prefill)",
+)
+_PREFIX_EVICTIONS = REGISTRY.counter(
+    "tdn_prefix_cache_evictions_total",
+    "refcount-0 prefix blocks evicted (LRU) to admit a new prefix",
+)
+
+
+class PrefixCachePool:
+    """Host-side bookkeeping for the reserved prefix region of the slot
+    cache: which pool block holds which token-prefix, with refcounts
+    and LRU eviction. Exact-match only — the key IS the prefix bytes,
+    so there are no collisions and no radix tree (docs/PERF.md
+    "exact-match vs radix").
+
+    Single-threaded by design: the scheduler loop thread is the only
+    caller (lookups/inserts happen at admission and chunk boundaries,
+    releases at retirement — all loop-side events), so no lock.
+
+    A block is REFERENCED from the admission that hit it until that
+    request retires (or fails): a referenced block is never evicted, so
+    a hot shared header cannot be thrashed out from under the requests
+    using it. Eviction picks the least-recently-USED block among
+    refcount-0 blocks; with every block referenced, insertion is simply
+    skipped (caching is an optimization, never a correctness gate).
+    """
+
+    def __init__(self, blocks: int):
+        if blocks < 1:
+            raise ValueError(f"pool needs >= 1 block, got {blocks}")
+        self.blocks = int(blocks)
+        self._key: list[bytes | None] = [None] * self.blocks
+        self._len = [0] * self.blocks
+        self._refs = [0] * self.blocks
+        self._last_use = [0] * self.blocks
+        self._by_key: dict[bytes, int] = {}
+        self._tick = itertools.count(1)
+        self.hits_total = 0
+        self.misses_total = 0
+        self.evictions_total = 0
+
+    @property
+    def used(self) -> int:
+        """Blocks currently holding a cached prefix."""
+        return len(self._by_key)
+
+    def refs(self, block: int) -> int:
+        return self._refs[block]
+
+    def block_len(self, block: int) -> int:
+        return self._len[block]
+
+    def lookup(self, candidates) -> tuple[int, int] | None:
+        """The longest cached prefix among ``candidates`` (``(length,
+        key_bytes)`` pairs, longest FIRST). A hit takes a reference and
+        bumps recency, returning ``(block, length)``; a full miss
+        returns None. Exactly one hit-or-miss is accounted per call
+        (per admission)."""
+        for length, key in candidates:
+            b = self._by_key.get(key)
+            if b is not None:
+                self._refs[b] += 1
+                self._last_use[b] = next(self._tick)
+                self.hits_total += 1
+                return b, length
+        self.misses_total += 1
+        return None
+
+    def release(self, block: int) -> None:
+        """Drop one reference (the request that held it retired)."""
+        if self._refs[block] <= 0:
+            raise AssertionError(
+                f"release of unreferenced prefix block {block}"
+            )
+        self._refs[block] -= 1
+
+    def clear(self) -> None:
+        """Drop every cached block — the backing cache was rebuilt
+        after a device fault, so the K/V the blocks pointed at is gone.
+        Lifetime counters survive (they are totals, not state). The
+        caller fails/releases every resident first, so no block can
+        still be referenced."""
+        if any(self._refs):
+            raise AssertionError(
+                "clear() with live references — release residents first"
+            )
+        self._key = [None] * self.blocks
+        self._len = [0] * self.blocks
+        self._last_use = [0] * self.blocks
+        self._by_key.clear()
+
+    def insert(self, key: bytes, length: int) -> tuple[int | None, bool]:
+        """Reserve a block for a new prefix: a free block, else the LRU
+        refcount-0 block (eviction), else None — all blocks referenced,
+        insertion skipped. Returns ``(block, evicted)``; ``(None,
+        False)`` when skipped or the key is already cached."""
+        if key in self._by_key:
+            return None, False
+        free = next(
+            (b for b in range(self.blocks) if self._key[b] is None), None
+        )
+        evicted = False
+        if free is None:
+            idle = [b for b in range(self.blocks) if self._refs[b] == 0]
+            if not idle:
+                return None, False
+            free = min(idle, key=lambda b: self._last_use[b])
+            del self._by_key[self._key[free]]
+            self.evictions_total += 1
+            evicted = True
+        self._key[free] = key
+        self._len[free] = int(length)
+        self._refs[free] = 0
+        self._last_use[free] = next(self._tick)
+        self._by_key[key] = free
+        return free, evicted
 
 
 class ContinuousScheduler:
@@ -100,12 +255,22 @@ class ContinuousScheduler:
     ``submit(rows)`` blocks the calling (gRPC worker) thread until every
     row's sequence is finished, exactly like ``_Batcher.submit`` — the
     difference is behind the call: one daemon loop thread owns the
-    device, interleaving slot admission (prefill) with single-token
-    steps over all active slots, retiring each row the moment it hits
-    EOS or its token budget.
+    device, interleaving per-iteration prefill CHUNKS (at most one per
+    iteration, so no prompt ever stalls the decode frontier for more
+    than one chunk) with single-token steps over all decoding slots,
+    retiring each row the moment it hits EOS or its token budget.
+
+    ``prefix_cache_blocks > 0`` reserves that many pool blocks at the
+    tail of the slot cache and enables shared-prefix reuse: admission
+    looks the prompt's chunk-aligned prefixes up (longest tier first),
+    copies a hit's block into the request slot, and prefills only the
+    suffix. ``prefill_chunk`` bounds tokens per prefill launch (None =
+    whole prompt/suffix in one chunk) and doubles as the prefix tier
+    granularity. Tuning guide: docs/PERF.md "Prefix caching & chunked
+    prefill".
 
     Construction compiles nothing; :meth:`warm` precompiles the
-    prefill-at-slot and step kernels so a port can open hot
+    chunk-prefill, slot-copy, and step kernels so a port can open hot
     (``serve_lm_generate(warm_rows=...)`` / ``tdn warmup --lm``).
 
     Counter attributes mirror ``_Batcher`` (``requests_total``,
@@ -113,12 +278,13 @@ class ContinuousScheduler:
     ``pending_rows``, ``inflight_rows`` = rows resident in slots,
     ``shed_total``) so the runtime sampler and drain plumbing work
     unchanged; generation-specific state (``slots_active``,
-    ``steps_total``, ``slot_steps_total``, ``ttft_recent``) feeds the
-    ``tdn_gen_*`` families.
+    ``steps_total``, ``slot_steps_total``, ``ttft_recent``, the
+    ``prefix_*`` accessors) feeds the ``tdn_gen_*`` /
+    ``tdn_prefix_cache_*`` families.
 
-    ``prefill_fn`` / ``step_fn`` are testing seams (the bench CI smoke
-    injects a deterministic cost model); production always builds the
-    real jitted kernels from ``params``/``cfg``.
+    ``prefill_fn`` / ``step_fn`` / ``copy_fn`` are testing seams (the
+    bench CI smokes inject deterministic cost models); production
+    always builds the real jitted kernels from ``params``/``cfg``.
     """
 
     method = "Generate"
@@ -129,7 +295,9 @@ class ContinuousScheduler:
                  eos_id: int | None = None, seed: int = 0,
                  submit_timeout: float | None = 120.0,
                  max_pending_rows: int | None = None,
-                 prefill_fn=None, step_fn=None):
+                 prefix_cache_blocks: int = 0,
+                 prefill_chunk: int | None = None,
+                 prefill_fn=None, step_fn=None, copy_fn=None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self._S = int(slots)
@@ -141,17 +309,60 @@ class ContinuousScheduler:
             int(max_pending_rows) if max_pending_rows is not None else None
         )
         self._counter = itertools.count()
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self._chunk = None if prefill_chunk is None else int(prefill_chunk)
+        self._P = int(prefix_cache_blocks)
+        if self._P < 0:
+            raise ValueError(
+                f"prefix_cache_blocks must be >= 0, got {prefix_cache_blocks}"
+            )
+        # Prefix tiers: the cacheable prefix lengths, chunk-aligned so a
+        # hit resumes exactly at a chunk boundary. Without chunking the
+        # single tier is the whole-prompt-but-last-token prefix (repeat
+        # / retry traffic); capped at T-1 so a hit always leaves >= 1
+        # suffix token to produce the last-position logits from.
+        grain = self._chunk if self._chunk is not None else self._T - 1
+        self._tiers: tuple[int, ...] = tuple(
+            sorted(
+                (k * grain for k in range(1, self._T)
+                 if 1 <= k * grain <= self._T - 1),
+                reverse=True,
+            )
+        ) if self._P else ()
+        if self._P and not self._tiers:
+            raise ValueError(
+                f"prefix_cache_blocks={self._P} has no cacheable tier: "
+                f"need a prefix length in [1, prompt_len - 1 = "
+                f"{self._T - 1}] — lower prefill_chunk (got "
+                f"{self._chunk}) or raise prompt_len"
+            )
+        self._pool = PrefixCachePool(self._P) if self._P else None
         if prefill_fn is not None or step_fn is not None:
             if prefill_fn is None or step_fn is None:
                 raise ValueError(
                     "prefill_fn and step_fn must be injected together"
                 )
             self._prefill, self._step = prefill_fn, step_fn
+            # Fake caches have no block storage; the default injected
+            # copy is the identity (pool bookkeeping still exercises).
+            self._copy = (
+                copy_fn if copy_fn is not None
+                else (lambda cache, src, dst: cache)
+            )
             self._params = params
             self._cache = None
+            self._make_cache = None
             self._key = None
             self._temperature = float(temperature)
         else:
+            if copy_fn is not None:
+                raise ValueError(
+                    "copy_fn is an injection seam: pass it together "
+                    "with prefill_fn/step_fn"
+                )
             import jax
 
             from tpu_dist_nn.models.generate import validate_generate_args
@@ -167,14 +378,19 @@ class ContinuousScheduler:
                 cfg, float(temperature), top_k, top_p,
             )
         # Host-side slot state: the loop thread is the only writer.
+        # _active marks DECODING slots; a bound slot whose prefill is
+        # still chunking has an occupant but is not yet active.
         self._pos = np.zeros(self._S, np.int32)
         self._active = np.zeros(self._S, bool)
         self._tok = np.zeros(self._S, np.int32)
         self._occupant: list[dict | None] = [None] * self._S
+        self._prefill_rr = 0  # round-robin fairness over chunking slots
         # Fault-injection hook points (testing/faults.py): called at
-        # the top of every step-kernel dispatch / token fetch.
+        # the top of every step-kernel dispatch / token fetch /
+        # prefill-chunk dispatch.
         self.launch_hook = None
         self.fetch_hook = None
+        self.prefill_hook = None
         # Pending queue + admission ledger (same shape as _Batcher).
         self._cond = threading.Condition()
         self._pending: collections.deque[dict] = collections.deque()
@@ -190,6 +406,7 @@ class ContinuousScheduler:
         # Generation-specific stats.
         self.slot_steps_total = 0  # active slots summed over steps
         self.retired_total = 0     # rows retired (eos + max_tokens)
+        self.prefill_chunks_total = 0  # chunk-kernel launches
         self.ttft_recent: collections.deque[float] = collections.deque(
             maxlen=1024
         )
@@ -209,15 +426,19 @@ class ContinuousScheduler:
 
         from tpu_dist_nn.models.generate import (
             _truncate_logits,
+            copy_cache_slot,
             decode_step_slots,
             init_slot_cache,
-            prefill_into_cache,
+            prefill_chunk_into_cache,
         )
 
         # The last decode writes position T + N - 2 (generate()'s cache
-        # sizing rule), so the slot extent is total - 1.
+        # sizing rule), so the slot extent is total - 1. The prefix
+        # pool rides the SAME cache as P extra slots past the request
+        # region — one allocation, one shape, one copy kernel.
         M = self._T + self._N - 1 if self._N > 1 else self._T
-        self._cache = init_slot_cache(cfg, self._S, M)
+        self._make_cache = lambda: init_slot_cache(cfg, self._S + self._P, M)
+        self._cache = self._make_cache()
         top_k = None if top_k is None else int(top_k)
         top_p = None if top_p is None else float(top_p)
 
@@ -229,21 +450,43 @@ class ContinuousScheduler:
                 key, logits / temperature, axis=-1
             ).astype(jnp.int32)
 
-        @jax.jit
-        def prefill_at(params, cache, slot, tokens, key):
-            logits, cache = prefill_into_cache(
-                params, cfg, cache, slot, tokens
+        # The cache is LINEAR through the scheduler (one owner, always
+        # rebound to the kernel's output), so its buffer is DONATED to
+        # every kernel: XLA updates it in place instead of copying the
+        # whole (L, S+P, M, H, Dh) pytree per launch — per-launch cost
+        # that would otherwise dwarf a small chunk's compute.
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill_chunk(params, cache, slot, tokens, start, key):
+            logits, cache = prefill_chunk_into_cache(
+                params, cfg, cache, slot, tokens, start
             )
             return sample(logits, key)[0], cache
 
-        @jax.jit
+        self._prefill = prefill_chunk
+        self._copy = jax.jit(copy_cache_slot, donate_argnums=(0,))
+        S, P = self._S, self._P
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def step(params, cache, pos, active, tok, key):
-            logits, cache = decode_step_slots(
-                params, cache, pos, tok, cfg, active=active
-            )
+            # Decode advances the REQUEST region only: the pool blocks
+            # past slot S hold cached prefixes, not decoding sequences
+            # — running them through the step kernel would burn FLOPs
+            # on dead slots every token.
+            if P:
+                head = {"k": cache["k"][:, :S], "v": cache["v"][:, :S]}
+                logits, head = decode_step_slots(
+                    params, head, pos, tok, cfg, active=active
+                )
+                cache = {
+                    "k": cache["k"].at[:, :S].set(head["k"]),
+                    "v": cache["v"].at[:, :S].set(head["v"]),
+                }
+            else:
+                logits, cache = decode_step_slots(
+                    params, cache, pos, tok, cfg, active=active
+                )
             return sample(logits, key), cache
 
-        self._prefill = prefill_at
         self._step = step
 
     def _next_key(self):
@@ -258,18 +501,47 @@ class ContinuousScheduler:
 
         return jax.random.fold_in(self._key, next(self._counter))
 
+    def _chunk_lengths(self) -> list[int]:
+        """Every chunk length the scheduler can launch (the compile
+        set): walking from each possible start position — 0, or any
+        prefix tier a hit can resume at — in ``prefill_chunk`` strides.
+        Small by construction: {chunk, T mod chunk} in the common case.
+        """
+        starts = {0, *self._tiers}
+        lengths: set[int] = set()
+        for s in starts:
+            pos = s
+            while pos < self._T:
+                c = (
+                    self._T - pos if self._chunk is None
+                    else min(self._chunk, self._T - pos)
+                )
+                lengths.add(c)
+                pos += c
+        return sorted(lengths, reverse=True)
+
     def warm(self) -> list[str]:
-        """Precompile the prefill-at-slot and step kernels (the port
-        opens hot; with JAX_COMPILATION_CACHE_DIR the compiles also
-        land on disk for later processes). Runs against slot 0 of the
-        real cache with a zero prompt — the slot is free, so the junk
-        K/V is masked and the next real occupant's prefill overwrites
-        it."""
-        zeros = np.zeros((1, self._T), np.int32)
+        """Precompile every kernel the loop can launch — the
+        chunk-prefill kernel at each chunk LENGTH the configuration can
+        produce, the slot-copy kernel (prefix pool on), and the step
+        kernel — so the port opens hot (with JAX_COMPILATION_CACHE_DIR
+        the compiles also land on disk for later processes). Runs
+        against slot 0 of the real cache with zero prompts — the slot
+        is free, so the junk K/V is masked and the next real occupant's
+        prefill overwrites it."""
         key = self._next_key()
-        _, cache = self._prefill(
-            self._params, self._cache, np.int32(0), zeros, key
-        )
+        cache = self._cache
+        for c in self._chunk_lengths():
+            zeros = np.zeros((1, c), np.int32)
+            _, cache = self._prefill(
+                self._params, cache, np.int32(0), zeros, np.int32(0), key
+            )
+        warmed = ["prefill_chunk_into_cache"]
+        if self._P:
+            # Self-copy of free slot 0: compiles the (src, dst)-traced
+            # kernel without touching live state.
+            cache = self._copy(cache, np.int32(0), np.int32(0))
+            warmed.append("copy_cache_slot")
         toks, cache = self._step(
             self._params, cache,
             np.zeros(self._S, np.int32), np.zeros(self._S, bool),
@@ -277,13 +549,15 @@ class ContinuousScheduler:
         )
         np.asarray(toks)  # force the compile + execution to finish
         self._cache = cache
-        return ["prefill_into_cache", "decode_step_slots"]
+        warmed.append("decode_step_slots")
+        return warmed
 
     # ------------------------------------------------------------ submit
 
     @property
     def inflight_rows(self) -> int:
-        return int(self._active.sum())
+        """Rows resident in slots — decoding OR mid-prefill."""
+        return sum(1 for o in self._occupant if o is not None)
 
     @property
     def slots(self) -> int:
@@ -300,6 +574,33 @@ class ContinuousScheduler:
         reads naturally (alias of ``batches_total`` — a device launch
         IS a decode step here)."""
         return self.batches_total
+
+    # Prefix-cache accounting (None-safe: 0 with the pool off, so the
+    # sampler/bench read one shape regardless of configuration).
+    @property
+    def prefix_blocks(self) -> int:
+        return self._P
+
+    @property
+    def prefix_blocks_used(self) -> int:
+        return self._pool.used if self._pool is not None else 0
+
+    @property
+    def prefix_hits_total(self) -> int:
+        return self._pool.hits_total if self._pool is not None else 0
+
+    @property
+    def prefix_misses_total(self) -> int:
+        return self._pool.misses_total if self._pool is not None else 0
+
+    @property
+    def prefix_evictions_total(self) -> int:
+        return self._pool.evictions_total if self._pool is not None else 0
+
+    @property
+    def prefix_hit_ratio(self) -> float:
+        n = self.prefix_hits_total + self.prefix_misses_total
+        return self.prefix_hits_total / n if n else 0.0
 
     def submit(self, x: np.ndarray, *, max_new_tokens: int | None = None,
                timeout: float | None = None, ctx=None) -> np.ndarray:
@@ -410,21 +711,54 @@ class ContinuousScheduler:
             return item, row
         return None
 
+    def _release_block(self, occ: dict) -> None:
+        """Drop the occupant's prefix-block reference, if it holds one
+        (once — retire, fault, and drain paths all funnel here)."""
+        block = occ.pop("block", None)
+        if block is not None and self._pool is not None:
+            self._pool.release(block)
+
+    def _free_slot_on_error(self, slot: int, e: Exception) -> None:
+        """Fail ONE occupant's item over (a mid-prefill or per-request
+        fault) and free its slot + prefix ref so the scheduler keeps
+        serving later arrivals."""
+        occ = self._occupant[slot]
+        self._occupant[slot] = None
+        self._active[slot] = False
+        self._release_block(occ)
+        item = occ["item"]
+        if item["err"] is None:
+            item["err"] = e
+            item["done"].set()
+
     def _fail_occupants(self, e: Exception) -> None:
-        """A step-kernel fault hits every resident row: fail their
-        items over (a row cannot be replayed — its sampling position
-        in the stream is gone) and free the slots so the scheduler
-        keeps serving later arrivals."""
+        """A step-kernel fault leaves the shared cache pytree in an
+        unknown state, so it hits every resident row — decoding AND
+        mid-prefill: fail their items over (a row cannot be replayed —
+        its sampling position in the stream is gone) and free the
+        slots so the scheduler keeps serving later arrivals."""
         for s in range(self._S):
-            occ = self._occupant[s]
-            if occ is None:
-                continue
-            self._occupant[s] = None
-            self._active[s] = False
-            item = occ["item"]
-            if item["err"] is None:
-                item["err"] = e
-                item["done"].set()
+            if self._occupant[s] is not None:
+                self._free_slot_on_error(s, e)
+
+    def _device_fault(self, e: Exception) -> None:
+        """A REAL kernel call raised (not an injected hook fault, which
+        fires before the dispatch): the cache buffer was DONATED to
+        that call and may already be consumed, so per-slot recovery is
+        impossible — fail every resident over, rebuild a fresh zeroed
+        cache (every slot is free after the fan-out, so zeroes are the
+        correct contents), and drop the prefix pool, whose blocks lived
+        in the dead cache. The scheduler then keeps serving later
+        arrivals — the same contract as before, paid for with a cold
+        prefix pool."""
+        self._fail_occupants(e)
+        if self._make_cache is not None:
+            try:
+                self._cache = self._make_cache()
+            except Exception:  # noqa: BLE001 — backend fully down
+                log.exception("cache rebuild after device fault failed")
+        if self._pool is not None:
+            self._pool.clear()
 
     def _retire(self, slot: int, reason: str) -> None:
         occ = self._occupant[slot]
@@ -433,6 +767,7 @@ class ContinuousScheduler:
         item["out"][row, self._T:self._T + len(toks)] = toks
         self._active[slot] = False
         self._occupant[slot] = None
+        self._release_block(occ)
         self.retired_total += 1
         _RETIRED.labels(reason=reason).inc()
         _TOKENS.inc(len(toks))
@@ -446,69 +781,190 @@ class ContinuousScheduler:
         if item["remaining"] == 0 and not item["abandoned"]:
             item["done"].set()
 
-    def _admit_one(self, item: dict, row: int) -> None:
-        """Prefill one row into a free slot (there is one — the caller
-        checked) and start it decoding; a first token that already
-        satisfies EOS/budget retires without ever occupying the slot
-        across a step."""
-        slot = int(np.flatnonzero(~self._active)[0])
-        t0 = time.monotonic()
-        try:
-            first, cache = self._prefill(
-                self._params, self._cache, np.int32(slot),
-                item["x"][row:row + 1], self._next_key(),
-            )
-            first = int(first)
-        except Exception as e:  # noqa: BLE001 — per item
-            if item["err"] is None:
-                item["err"] = e
-                item["done"].set()
-            return
-        self._cache = cache
+    def _tier_keys(self, row: np.ndarray):
+        """The prompt's cacheable-prefix candidates, longest first —
+        the exact-match lookup/insert keys (the raw prefix bytes: no
+        hash collisions to reason about). Lazy: ``lookup`` early-exits
+        on the first (longest) hit, so a warm-pool deepest-tier hit
+        copies exactly one prefix instead of materializing every tier
+        of a long prompt on the scheduler loop thread."""
+        return ((ln, row[:ln].tobytes()) for ln in self._tiers)
+
+    def _bind_slot(self, item: dict, row: int) -> None:
+        """Bind one pending row to a free slot (there is one — the
+        caller checked): prefix-pool lookup, copy-on-write block copy
+        on a hit, and the slot enters its chunked-prefill phase. No
+        prompt tokens run here — chunks are the loop's per-iteration
+        work, so binding never stalls the decode frontier."""
+        slot = int(
+            next(s for s in range(self._S) if self._occupant[s] is None)
+        )
         now = time.monotonic()
-        ttft = now - item["t_submit"]
-        _TTFT.observe(ttft)
-        self.ttft_recent.append(ttft)
+        occ = {
+            "item": item, "row": row, "tokens": [],
+            "budget": item["budget"], "t_first": None,
+            "t_bind": now, "fill": 0, "block": None,
+        }
+        self._occupant[slot] = occ
         self.rows_total += 1
         if item["ctx"] is not None:
             _trace.TRACER.record_span(
                 "queue_wait", item["ctx"], item["t_submit"],
-                t0 - item["t_submit"],
+                now - item["t_submit"],
             )
+        if self._pool is None:
+            return
+        hit = self._pool.lookup(self._tier_keys(item["x"][row]))
+        if hit is None:
+            _PREFIX_MISSES.inc()
+            return
+        block, length = hit
+        # Counted at lookup, BEFORE the copy, so this counter can never
+        # diverge from the pool's own hits_total (which lookup() just
+        # bumped) — a hit whose COW copy then faults is still a hit in
+        # both ledgers.
+        _PREFIX_HITS.inc()
+        try:
+            self._cache = self._copy(
+                self._cache, np.int32(self._S + block), np.int32(slot)
+            )
+        except Exception as e:  # noqa: BLE001 — donated cache: global fault
+            occ["block"] = block
+            self._device_fault(e)
+            return
+        occ["fill"] = length
+        occ["block"] = block
+        slog.info(
+            "gen.prefix_hit", slot=slot, block=block, prefix_len=length,
+            suffix_len=self._T - length,
+        )
+
+    def _next_prefill_slot(self) -> int | None:
+        """The next slot with prefill work, round-robin so concurrent
+        long prompts chunk fairly instead of head-of-line blocking each
+        other."""
+        for i in range(self._S):
+            s = (self._prefill_rr + i) % self._S
+            occ = self._occupant[s]
+            if occ is not None and not self._active[s] \
+                    and occ["fill"] < self._T:
+                self._prefill_rr = (s + 1) % self._S
+                return s
+        return None
+
+    def _maybe_insert_tiers(self, slot: int, occ: dict, start: int) -> None:
+        """After a chunk lands, publish any newly-completed prefix tier
+        in ``(start, fill]`` into the pool (slot -> block copy). Failure
+        to insert — pool full of referenced blocks, or a copy fault —
+        skips silently: caching is an optimization, never load-bearing."""
+        row = occ["item"]["x"][occ["row"]]
+        for length in reversed(self._tiers):  # ascending
+            if not start < length <= occ["fill"]:
+                continue
+            block, evicted = self._pool.insert(row[:length].tobytes(), length)
+            if evicted:
+                _PREFIX_EVICTIONS.inc()
+            if block is None:
+                continue
+            try:
+                self._cache = self._copy(
+                    self._cache, np.int32(slot), np.int32(self._S + block)
+                )
+            except Exception as e:  # noqa: BLE001 — donated cache: global
+                log.warning("prefix-block insert copy failed: %s", e)
+                self._device_fault(e)
+                return
+
+    def _prefill_chunk_once(self, slot: int) -> None:
+        """Run ONE chunk of ``slot``'s pending prefill — the at-most-
+        one-chunk-per-iteration budget that keeps a long prompt from
+        freezing the resident decode streams. The final chunk yields
+        the prompt's last-position sample: the request's first token
+        (TTFT), after which the slot joins the decode frontier."""
+        occ = self._occupant[slot]
+        item = occ["item"]
+        start = occ["fill"]
+        size = (
+            self._T - start if self._chunk is None
+            else min(self._chunk, self._T - start)
+        )
+        tokens = item["x"][occ["row"]:occ["row"] + 1, start:start + size]
+        t0 = time.monotonic()
+        if self.prefill_hook is not None:
+            # Hook faults fire BEFORE the dispatch: the cache is still
+            # intact, so only THIS request fails over — the mid-prefill
+            # chaos contract (slot freed, prefix ref released).
+            try:
+                self.prefill_hook(tokens)
+            except Exception as e:  # noqa: BLE001 — per item
+                self._free_slot_on_error(slot, e)
+                return
+        try:
+            tok, cache = self._prefill(
+                self._params, self._cache, np.int32(slot), tokens,
+                np.int32(start), self._next_key(),
+            )
+        except Exception as e:  # noqa: BLE001 — donated cache: global
+            self._device_fault(e)
+            return
+        self._cache = cache
+        try:
+            tok = int(tok)  # the token fetch (host sync)
+        except Exception as e:  # noqa: BLE001 — donated cache: global
+            # On async backends a failed LAUNCH surfaces here, at the
+            # first host sync of its results — the rebound cache is the
+            # poisoned donated output, so this is a device fault, not a
+            # per-item one (on the sync CPU backend a post-return fetch
+            # failure is unreachable, so nothing is lost by escalating).
+            self._device_fault(e)
+            return
+        occ["fill"] = start + size
+        self.prefill_chunks_total += 1
+        now = time.monotonic()
+        if item["ctx"] is not None:
             _trace.TRACER.record_span(
-                "prefill", item["ctx"], t0, now - t0,
-                attrs={"slot": slot, "prompt_len": self._T},
+                "prefill.chunk", item["ctx"], t0, now - t0,
+                attrs={"slot": slot, "start": start, "tokens": size},
             )
-        occ = {"item": item, "row": row, "tokens": [first],
-               "budget": item["budget"], "t_first": now}
-        self._occupant[slot] = occ
+        if self._pool is not None:
+            self._maybe_insert_tiers(slot, occ, start)
+            if self._occupant[slot] is not occ:
+                return  # an insert-copy fault failed the slot over
+        if occ["fill"] < self._T:
+            return
+        # Prefill complete: `tok` is the sample from the prompt's last
+        # position — the first generated token.
+        ttft = now - item["t_submit"]
+        _TTFT.observe(ttft)
+        self.ttft_recent.append(ttft)
+        occ["t_first"] = now
+        if item["ctx"] is not None:
+            _trace.TRACER.record_span(
+                "prefill", item["ctx"], occ["t_bind"], now - occ["t_bind"],
+                attrs={
+                    "slot": slot, "prompt_len": self._T,
+                    "prefix_hit": occ["block"] is not None,
+                },
+            )
+        occ["tokens"].append(tok)
         self._active[slot] = True
         self._pos[slot] = self._T
-        self._tok[slot] = first
-        if self._eos is not None and first == self._eos:
+        self._tok[slot] = tok
+        if self._eos is not None and tok == self._eos:
             self._retire(slot, "eos")
         elif len(occ["tokens"]) >= occ["budget"]:
             self._retire(slot, "max_tokens")
 
     def _step_once(self) -> None:
-        """One compiled step over every slot; retire/refill happens on
-        the host between steps (the iteration-level boundary)."""
+        """One compiled step over every decoding slot; retire/refill
+        happens on the host between steps (the iteration-level
+        boundary)."""
         t0 = time.monotonic()
         traced = [
             self._occupant[s] for s in range(self._S)
             if self._active[s] and self._occupant[s]["item"]["ctx"] is not None
         ]
-        try:
-            if self.launch_hook is not None:
-                self.launch_hook(self._tok)
-            toks, cache = self._step(
-                self._params, self._cache, self._pos, self._active,
-                self._tok, self._next_key(),
-            )
-            if self.fetch_hook is not None:
-                self.fetch_hook(toks)
-            toks = np.asarray(toks)
-        except Exception as e:  # noqa: BLE001 — fan out to occupants
+        def fail(e: Exception, kernel: bool) -> None:
             # Rate-limited: a wedged backend fails every subsequent
             # step too — the first few stack traces are the signal,
             # thousands more per minute are noise.
@@ -517,9 +973,40 @@ class ContinuousScheduler:
                 active_slots=int(self._active.sum()),
                 steps_total=self.batches_total,
             )
-            self._fail_occupants(e)
+            # A raise from the kernel call itself may have consumed
+            # the donated cache; hook/fetch faults leave it intact.
+            self._device_fault(e) if kernel else self._fail_occupants(e)
+
+        if self.launch_hook is not None:
+            try:
+                self.launch_hook(self._tok)
+            except Exception as e:  # noqa: BLE001 — fan out to occupants
+                fail(e, kernel=False)
+                return
+        try:
+            toks, cache = self._step(
+                self._params, self._cache, self._pos, self._active,
+                self._tok, self._next_key(),
+            )
+        except Exception as e:  # noqa: BLE001 — fan out to occupants
+            fail(e, kernel=True)
             return
         self._cache = cache
+        if self.fetch_hook is not None:
+            try:
+                self.fetch_hook(toks)
+            except Exception as e:  # noqa: BLE001 — fan out to occupants
+                fail(e, kernel=False)
+                return
+        try:
+            toks = np.asarray(toks)
+        except Exception as e:  # noqa: BLE001 — fan out to occupants
+            # Async backends surface a failed launch at this first host
+            # sync: the rebound cache is the poisoned donated output,
+            # so recover as a device fault (kernel=True), unlike the
+            # pre-sync hook fault above which leaves the cache intact.
+            fail(e, kernel=True)
+            return
         self.batches_total += 1
         active = int(self._active.sum())
         self.slot_steps_total += active
@@ -545,34 +1032,45 @@ class ContinuousScheduler:
             elif len(occ["tokens"]) >= occ["budget"]:
                 self._retire(s, "max_tokens")
 
+    def _resident(self) -> bool:
+        """Any slot occupied — decoding or mid-prefill (both must drain
+        before close() may stop the loop)."""
+        return any(o is not None for o in self._occupant)
+
     def _loop(self) -> None:
         while True:
             admits = []
             with self._cond:
                 while (not self._closed and not self._pending
-                       and not self._active.any()):
+                       and not self._resident()):
                     self._cond.wait()
-                if (self._closed and not self._active.any()):
+                if self._closed and not self._resident():
                     return  # close() sweeps whatever is still pending
                 if not self._closed:
-                    while self._active.sum() + len(admits) < self._S:
+                    free = sum(1 for o in self._occupant if o is None)
+                    while len(admits) < free:
                         got = self._pop_admittable()
                         if got is None:
                             break
                         admits.append(got)
             # Device work OUTSIDE the lock: submitters must never block
-            # behind a prefill or a step.
+            # behind a block copy, a prefill chunk, or a step.
             for item, row in admits:
-                self._admit_one(item, row)
+                self._bind_slot(item, row)
+            slot = self._next_prefill_slot()
+            if slot is not None:
+                self._prefill_chunk_once(slot)
             if self._active.any():
                 self._step_once()
 
     # ------------------------------------------------------------ close
 
     def close(self, timeout: float = 10.0) -> None:
-        """Stop admitting, let resident rows finish their (bounded)
-        decodes, then fail still-pending waiters over as UNAVAILABLE —
-        the ``_Batcher.close`` contract ``GracefulDrain`` relies on."""
+        """Stop admitting, let resident rows — including half-prefilled
+        slots, which finish their remaining chunks — complete their
+        (bounded) decodes, then fail still-pending waiters over as
+        UNAVAILABLE — the ``_Batcher.close`` contract ``GracefulDrain``
+        relies on."""
         from tpu_dist_nn.utils.errors import UnavailableError
 
         with self._cond:

@@ -865,6 +865,8 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
                       max_pending_rows: int | None = None,
                       scheduler: str = "auto", gen_slots: int = 8,
                       eos_id: int | None = None,
+                      prefix_cache_blocks: int = 0,
+                      prefill_chunk: int | None = None,
                       interceptors=()):
     """Serve LM GENERATION over the reference wire.
 
@@ -903,6 +905,14 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
     repeated identical prompts draw fresh continuations. ``eos_id``
     enables stop-token semantics on BOTH schedulers (same freeze/pad
     rule, so their ``temperature == 0`` outputs are identical).
+
+    ``prefix_cache_blocks > 0`` enables the continuous scheduler's
+    shared-prefix KV reuse (ref-counted pool blocks, copy-on-write
+    admission) and ``prefill_chunk`` bounds tokens per prefill launch
+    so long prompts interleave with resident decodes — both continuous-
+    scheduler features (docs/PERF.md "Prefix caching & chunked
+    prefill"); requesting either with a static resolution is an error
+    rather than a silently-ignored perf flag.
 
     Returns ``(server, bound_port)``; ``server.batcher`` exposes the
     scheduling counters (the continuous scheduler satisfies the
@@ -943,6 +953,15 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
         scheduler = (
             "static" if num_stages > 1 or not coalesce else "continuous"
         )
+    if scheduler != "continuous" and (
+        prefix_cache_blocks or prefill_chunk is not None
+    ):
+        raise ValueError(
+            "prefix_cache_blocks / prefill_chunk are continuous-"
+            "scheduler features (the static run-to-completion decode "
+            "has no slot cache to reuse or chunk into); drop them or "
+            "serve scheduler='continuous'"
+        )
     params = cfg.cast_params(params)
     N = int(max_new_tokens)
     T = int(prompt_len)
@@ -965,6 +984,8 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, seed=seed, submit_timeout=submit_timeout,
             max_pending_rows=max_pending_rows,
+            prefix_cache_blocks=prefix_cache_blocks,
+            prefill_chunk=prefill_chunk,
         )
         if warm_rows > 0:
             sched.warm()
@@ -986,7 +1007,9 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
         server.start()
         slog.info("server.start", method="Generate",
                   scheduler="continuous", port=bound, gen_slots=gen_slots,
-                  prompt_len=T, max_new_tokens=N, eos_id=eos_id)
+                  prompt_len=T, max_new_tokens=N, eos_id=eos_id,
+                  prefix_cache_blocks=prefix_cache_blocks,
+                  prefill_chunk=prefill_chunk)
         return server, bound
 
     if num_stages > 1:
