@@ -157,6 +157,13 @@ QUICK_TESTS = {
     "test_quantized": ["test_weight_quantization_roundtrip_error_bounded",
                        "test_quantized_forward_close_to_f32",
                        "test_quantize_honors_metadata_distribution"],
+    "test_router": [
+        # ISSUE 8: the loopback p2c smoke (spread + tdn_router_*
+        # family on /metrics), the breaker-registry-eviction
+        # regression, and the router_rps gate skip/fail contract.
+        "test_router_loopback_spreads_load_and_exposes_metrics",
+        "test_pool_remove_evicts_breaker_registry_for_reused_address",
+        "test_bench_gate_router_rps_skip_and_fail"],
     "test_resilience": [
         "test_chaos_smoke_quick_tier_recovers_via_retries",
         "test_breaker_cycle_closed_open_half_open_closed",

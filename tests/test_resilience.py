@@ -497,7 +497,9 @@ def test_graceful_drain_completes_inflight_and_flips_health():
     drain = GracefulDrain(grace_seconds=5.0)
     drain.add_server(server)
     health = drain.wrap_health(lambda: {"ready": True, "devices": 1})
-    assert health() == {"ready": True, "devices": 1, "draining": False}
+    h0 = health()
+    assert h0.pop("boot_id")  # per-process identity rides every payload
+    assert h0 == {"ready": True, "devices": 1, "draining": False}
     client = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
                         retry=None, breaker=None)
     try:

@@ -429,7 +429,7 @@ def test_timeout_hint_bounds_the_batcher_budget():
 
     TRACER.configure(sample_rate=1.0)
     ctx = SpanContext("ab" * 16, "cd" * 8, sampled=True)
-    span, budget = _request_span(
+    span, budget, md = _request_span(
         Ctx([(TRACE_HEADER, ctx.header()), (TIMEOUT_HEADER, "1500")],
             remaining=30.0),
         "Process",
@@ -437,14 +437,18 @@ def test_timeout_hint_bounds_the_batcher_budget():
     span.end()
     assert budget == pytest.approx(1.5)
     assert span.ctx.trace_id == ctx.trace_id  # joined the caller's trace
+    # The parsed metadata dict rides back too (the router reads
+    # x-tdn-session from it).
+    assert md[TIMEOUT_HEADER] == "1500"
     # The hint alone (a proxy rewrote the deadline away).
-    span, budget = _request_span(Ctx([(TIMEOUT_HEADER, "250")]), "Process")
+    span, budget, _md = _request_span(Ctx([(TIMEOUT_HEADER, "250")]),
+                                      "Process")
     span.end()
     assert budget == pytest.approx(0.25)
     # Garbled hint: no budget, no crash; trailing metadata still names
     # the trace.
     fake = Ctx([(TIMEOUT_HEADER, "soon")])
-    span, budget = _request_span(fake, "Process")
+    span, budget, _md = _request_span(fake, "Process")
     span.end()
     assert budget is None
     assert fake.trailing and fake.trailing[0][0] == TRACE_ID_HEADER

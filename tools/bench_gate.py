@@ -22,6 +22,7 @@ Gated metrics (docs/PERF.md "Regression gate"):
     gen_prefix_rps                  serving.generate_prefix.rps  higher
     gen_prefix_ttft_p99_ms          serving.generate_prefix.ttft_p99_ms
                                                                  lower
+    router_rps                      serving.router.rps           higher
 
 Rules:
 
@@ -75,6 +76,10 @@ GATED_METRICS = (
     ("gen_prefix_rps", ("serving", "generate_prefix", "rps"), "higher"),
     ("gen_prefix_ttft_p99_ms",
      ("serving", "generate_prefix", "ttft_p99_ms"), "lower"),
+    # Multi-replica router (controlled-regime 3-replica rps): the
+    # fleet's scaling win must not regress once landed. Absent in
+    # rounds that predate the section -> per-metric skip.
+    ("router_rps", ("serving", "router", "rps"), "higher"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

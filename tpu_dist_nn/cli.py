@@ -10,8 +10,12 @@
                  offline scripts/generate_mnist_*.py + notebook recipes)
 ``tdn oracle`` — scripts/manual_nn.py analogue: single-process float64
                  forward with per-example latency printout
+``tdn router`` — multi-replica front door: load-aware gRPC router over
+                 an engine replica pool (p2c placement, session
+                 affinity, failover, rolling restarts; docs/SCALING.md)
 ``tdn metrics``— one-shot scrape/pretty-print of a ``--metrics-port``
-                 /metrics endpoint (obs/exposition.py)
+                 /metrics endpoint (obs/exposition.py); ``--aggregate``
+                 folds a router's whole fleet into one view
 ``tdn trace``  — pull a ``--metrics-port`` endpoint's recorded request
                  spans as a Chrome trace-event file (obs/trace.py);
                  the output opens directly in Perfetto/chrome://tracing
@@ -169,7 +173,7 @@ def _jax_process_count() -> int:
 _live_metrics_servers: list = []
 
 
-def _start_metrics_server(args, health_fn=None):
+def _start_metrics_server(args, health_fn=None, routes=None):
     """Start the /metrics + /healthz endpoint when --metrics-port was
     passed; prints the bound port as a JSON line (``port=0`` picks an
     ephemeral one — drivers/tests read the line, the reference's
@@ -189,7 +193,7 @@ def _start_metrics_server(args, health_fn=None):
     from tpu_dist_nn.obs import start_http_server
 
     try:
-        server = start_http_server(port, health_fn=health_fn)
+        server = start_http_server(port, health_fn=health_fn, routes=routes)
     except OSError as e:
         raise ValueError(f"--metrics-port {port} could not bind: {e}") from e
     _live_metrics_servers.append([server, None])
@@ -470,6 +474,10 @@ def _infer_over_grpc(args) -> int:
         from tpu_dist_nn.serving.resilience import RetryPolicy
 
         kwargs["retry"] = RetryPolicy(max_attempts=args.retry_max_attempts)
+    if getattr(args, "session_key", None):
+        # Rides as x-tdn-session: the router pins this client's
+        # requests to one replica (an engine server ignores it).
+        kwargs["session_key"] = args.session_key
     client = GrpcClient(args.target, timeout=args.timeout or 30.0, **kwargs)
     try:
         if args.input_index is not None:
@@ -503,6 +511,155 @@ def _infer_over_grpc(args) -> int:
         return 0
     finally:
         client.close()
+
+
+def _parse_targets(text):
+    if not text:
+        return []
+    return [t for t in text.replace(",", " ").split() if t]
+
+
+def cmd_router(args) -> int:
+    """The multi-replica front door (docs/SCALING.md): serve the
+    LayerService surface over a load-aware replica pool, or drive a
+    running router's admin path (``--drain-replica`` / ``--undrain-
+    replica`` / ``--list-replicas`` with ``--admin``)."""
+    # ----- admin-client mode: talk to a RUNNING router's endpoint.
+    admin_action = (
+        ("drain", args.drain_replica) if args.drain_replica
+        else ("undrain", args.undrain_replica) if args.undrain_replica
+        else ("replicas", None) if args.list_replicas
+        else None
+    )
+    if admin_action is not None:
+        if not args.admin:
+            raise ValueError(
+                "--drain-replica/--undrain-replica/--list-replicas need "
+                "--admin HOST:METRICS_PORT (the router's metrics "
+                "endpoint, which mounts the /router/* admin routes)"
+            )
+        import urllib.parse
+
+        verb, target = admin_action
+        path = f"/router/{verb}"
+        if target is not None:
+            path += "?replica=" + urllib.parse.quote(target, safe="")
+        body = _endpoint_get(
+            _endpoint_base(args.admin), path, args.timeout
+        )
+        print(body.decode().strip())
+        return 0
+
+    # ----- serve mode: bring up the pool + the front door.
+    _apply_trace_sample_rate(args)
+    targets = _parse_targets(args.replicas)
+    if not targets and not args.spawn:
+        raise ValueError(
+            "tdn router needs replicas: --replicas host:port[,host:port...] "
+            "(static fleet) and/or --spawn N --config model.json "
+            "(subprocess-managed local replicas)"
+        )
+    if args.spawn and not args.config:
+        raise ValueError("--spawn needs --config (the model the local "
+                         "replicas serve)")
+    if len(set(targets)) != len(targets):
+        # ReplicaPool.add() dedups on target, so a duplicate would
+        # silently run the fleet at N-1 AND shift every later
+        # --replica-metrics endpoint onto the wrong replica — the
+        # same silent-misconfiguration class as the parallel-list
+        # mismatch below. Fail the typo at the flag.
+        dupes = sorted({t for t in targets if targets.count(t) > 1})
+        raise ValueError(
+            f"--replicas lists duplicate target(s): {', '.join(dupes)}"
+        )
+    metrics_targets = _parse_targets(args.replica_metrics)
+    if metrics_targets and len(metrics_targets) != len(targets):
+        # A silent mismatch would leave the tail replicas unscraped:
+        # no gauge-based placement, no healthz drain choreography, and
+        # invisible to --aggregate. Fail the typo at the flag.
+        raise ValueError(
+            f"--replica-metrics must be parallel to --replicas: got "
+            f"{len(metrics_targets)} metrics endpoint(s) for "
+            f"{len(targets)} replica(s)"
+        )
+    from tpu_dist_nn.serving.pool import ReplicaPool
+    from tpu_dist_nn.serving.resilience import GracefulDrain
+    from tpu_dist_nn.serving.router import (
+        admin_routes,
+        router_health,
+        serve_router,
+    )
+
+    pool = ReplicaPool(
+        targets, metrics_targets,
+        load_staleness=args.load_staleness,
+        scrape_interval=args.scrape_interval,
+    )
+    drain = GracefulDrain(grace_seconds=args.drain_grace_seconds)
+    metrics_server = _start_metrics_server(
+        args, health_fn=drain.wrap_health(router_health(pool)),
+        routes=admin_routes(pool),
+    )
+    spawned = []
+    try:
+        if args.spawn:
+            # One engine boot (compile + warmup) can take minutes;
+            # spawning sequentially would cost N x boot before the
+            # router port even prints. Each spawn_local blocks only on
+            # its OWN child's port lines, so boot the fleet in parallel.
+            import concurrent.futures
+
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=args.spawn, thread_name_prefix="tdn-spawn"
+            ) as ex:
+                futs = [
+                    ex.submit(
+                        pool.spawn_local, args.config,
+                        extra_args=["--serve-warm-rows",
+                                    str(args.spawn_warm_rows)],
+                    )
+                    for _ in range(args.spawn)
+                ]
+                for fut in futs:
+                    rep = fut.result()
+                    spawned.append(rep)
+                    print(json.dumps({
+                        "replica": rep.target,
+                        "metrics_target": rep.metrics_target,
+                        "spawned": True,
+                    }), flush=True)
+        pool.start()
+        server, bound = serve_router(pool, args.port)
+        drain.add_server(server)
+        drain.install_signal_handler()
+        print(json.dumps({
+            "router_port": bound,
+            "replicas": pool.targets(),
+        }), flush=True)
+        sampler = None
+        if metrics_server is not None:
+            from tpu_dist_nn.obs import RuntimeSampler, TRACER
+
+            sampler = RuntimeSampler()
+            sampler.add_pool(pool)
+            sampler.add_tracer(TRACER)
+            sampler.start()
+            _attach_metrics_sampler(metrics_server, sampler)
+        try:
+            if args.serve_seconds is not None:
+                drain.wait(args.serve_seconds)
+            else:
+                server.wait_for_termination()
+        except KeyboardInterrupt:
+            log.info("interrupt received; draining router")
+        drain.begin()
+        drain.wait(args.drain_grace_seconds + 10.0)
+        _stop_metrics_server(metrics_server, sampler)
+        return 0
+    finally:
+        # close() owns spawned-child teardown (SIGTERM -> their own
+        # GracefulDrain -> hard kill past the grace budget).
+        pool.close(grace=args.drain_grace_seconds + 10.0)
 
 
 def cmd_train(args) -> int:
@@ -1653,20 +1810,110 @@ def _endpoint_get(base: str, path: str, timeout: float) -> bytes:
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             return resp.read()
+    except urllib.error.HTTPError as e:
+        # Non-200 admin/endpoint replies carry a JSON verdict in the
+        # body (e.g. /router/drain on an unknown replica -> 404
+        # {"draining": false}) — show it, not just the status line.
+        try:
+            detail = e.read().decode(errors="replace").strip()
+        except OSError:
+            detail = ""
+        raise ValueError(
+            f"{url} returned HTTP {e.code}"
+            + (f": {detail}" if detail else "")
+        ) from e
     except (urllib.error.URLError, OSError) as e:
         raise ValueError(f"could not fetch {url}: {e}") from e
+
+
+def _aggregate_fleet(parsed_by_source: dict[str, dict]) -> dict:
+    """Fold per-source /metrics scrapes into one fleet view: counter
+    and histogram series SUM across sources (requests served by the
+    fleet), gauges stay per-source (a queue depth summed across
+    replicas hides which one is backlogged). Returns ``{"kinds":
+    {name: kind}, "summed": {series: total}, "gauges": {series:
+    {source: value}}}``."""
+    kinds: dict[str, str] = {}
+    for parsed in parsed_by_source.values():
+        for k, v in parsed.items():
+            if str(k).startswith("__type__:"):
+                kinds[str(k).split(":", 1)[1]] = v
+    summed: dict[str, float] = {}
+    gauges: dict[str, dict[str, float]] = {}
+    for source, parsed in parsed_by_source.items():
+        for series, value in parsed.items():
+            s = str(series)
+            if s.startswith("__type__:"):
+                continue
+            family = s.split("{", 1)[0]
+            # Histogram series (name_bucket/_sum/_count) resolve to
+            # their family's declared kind.
+            base_family = family
+            for suffix in ("_bucket", "_sum", "_count"):
+                if family.endswith(suffix) and family[: -len(suffix)] in kinds:
+                    base_family = family[: -len(suffix)]
+                    break
+            kind = kinds.get(base_family, "gauge")
+            if kind in ("counter", "histogram"):
+                summed[s] = summed.get(s, 0.0) + float(value)
+            else:
+                gauges.setdefault(s, {})[source] = float(value)
+    return {"kinds": kinds, "summed": summed, "gauges": gauges}
 
 
 def cmd_metrics(args) -> int:
     """One-shot scrape of a running --metrics-port endpoint: fetch
     /metrics, pretty-print the tdn_* families (or dump raw text) —
     `curl | grep` without leaving the tool, and the quickest way to
-    check coalescing efficiency on a live server."""
+    check coalescing efficiency on a live server. ``--aggregate``
+    (against a ROUTER's endpoint) discovers the replica fleet via
+    /router/replicas and folds router + every replica into one view:
+    summed counters, per-replica gauges — fleet state in one command."""
     import urllib.error
     import urllib.request
 
     base = _endpoint_base(args.target)
     text = _endpoint_get(base, "/metrics", args.timeout).decode()
+    if args.aggregate:
+        from tpu_dist_nn.obs import parse_prometheus_text
+
+        try:
+            replicas = json.loads(
+                _endpoint_get(base, "/router/replicas", args.timeout)
+            )
+        except ValueError as e:
+            raise ValueError(
+                f"--aggregate needs a ROUTER metrics endpoint (its "
+                f"/router/replicas admin route answered unexpectedly: {e})"
+            ) from e
+        parsed_by_source = {"router": parse_prometheus_text(text)}
+        unreachable = []
+        for rep in replicas:
+            mt = rep.get("metrics_target")
+            name = rep.get("target", mt)
+            if not mt:
+                unreachable.append((name, "no metrics_target registered"))
+                continue
+            try:
+                rep_text = _endpoint_get(
+                    _endpoint_base(mt), "/metrics", args.timeout
+                ).decode()
+            except ValueError as e:
+                unreachable.append((name, str(e)))
+                continue
+            parsed_by_source[name] = parse_prometheus_text(rep_text)
+        agg = _aggregate_fleet(parsed_by_source)
+        print(f"fleet: router + {len(parsed_by_source) - 1} replica "
+              f"endpoint(s) scraped")
+        for name, why in unreachable:
+            print(f"  unreachable: {name} ({why})")
+        for s in sorted(agg["summed"]):
+            print(f"[sum] {s} = {agg['summed'][s]:g}")
+        for s in sorted(agg["gauges"]):
+            for source in sorted(agg["gauges"][s]):
+                print(f"[gauge] {s} @{source} = "
+                      f"{agg['gauges'][s][source]:g}")
+        return 0
     if args.raw:
         print(text, end="")
         return 0
@@ -2238,9 +2485,82 @@ def build_parser() -> argparse.ArgumentParser:
                         "client retry policy (jittered backoff on "
                         "UNAVAILABLE/DEADLINE_EXCEEDED within --timeout; "
                         "1 = no retries, default 3; docs/ROBUSTNESS.md)")
+    p.add_argument("--session-key",
+                   help="with --target: send this x-tdn-session key on "
+                        "every RPC so a multi-replica router (tdn "
+                        "router) pins the session to one replica; a "
+                        "single server ignores it (docs/SCALING.md)")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace here")
     p.set_defaults(fn=cmd_infer)
+
+    p = sub.add_parser(
+        "router",
+        help="multi-replica front door: load-aware gRPC router over an "
+             "engine replica pool (power-of-two-choices placement, "
+             "session affinity, failover, rolling restarts — "
+             "docs/SCALING.md)")
+    p.add_argument("--port", type=int, default=0,
+                   help="gRPC port the router serves LayerService on "
+                        "(0 = ephemeral, printed as a JSON line)")
+    p.add_argument("--replicas",
+                   help="comma/space-separated host:port gRPC targets "
+                        "of the engine replicas (the static fleet)")
+    p.add_argument("--replica-metrics",
+                   help="comma/space-separated host:port METRICS "
+                        "endpoints, parallel to --replicas: enables "
+                        "gauge-based p2c load (tdn_batcher_pending_rows "
+                        "/ tdn_gen_slot_occupancy_ratio) and the "
+                        "healthz drain choreography; without it the "
+                        "router places by least-outstanding-requests")
+    p.add_argument("--spawn", type=int, default=0, metavar="N",
+                   help="spawn N local engine replicas as subprocesses "
+                        "(tdn up --grpc-port 0 --metrics-port 0 each; "
+                        "needs --config) and manage their lifecycle, "
+                        "including --drain-replica rolling restarts")
+    p.add_argument("--config", help="model JSON the --spawn replicas serve")
+    p.add_argument("--spawn-warm-rows", type=int, default=64,
+                   help="bucket warm for spawned replicas (their "
+                        "--serve-warm-rows; default 64)")
+    p.add_argument("--scrape-interval", type=float, default=1.0,
+                   help="seconds between replica /metrics + /healthz "
+                        "load scrapes (default 1.0)")
+    p.add_argument("--load-staleness", type=float, default=5.0,
+                   help="gauge load older than this many seconds is "
+                        "ignored and placement falls back to least-"
+                        "outstanding-requests (default 5.0)")
+    p.add_argument("--serve-seconds", type=float, default=None,
+                   help="serve for N seconds then drain and exit "
+                        "(default: until interrupted)")
+    p.add_argument("--drain-grace-seconds", type=float, default=5.0,
+                   help="graceful-drain window for the ROUTER itself "
+                        "on SIGTERM (in-flight forwards finish)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="expose /metrics + /healthz + the /router/* "
+                        "admin routes (replica list, drain, undrain) "
+                        "on this port (0 = ephemeral, printed as a "
+                        "JSON line)")
+    p.add_argument("--trace-sample-rate", type=float, default=None,
+                   metavar="RATE",
+                   help="head-sampling rate for router request tracing "
+                        "in [0, 1]")
+    p.add_argument("--admin", metavar="HOST:PORT",
+                   help="admin-client mode: a RUNNING router's metrics "
+                        "endpoint to drive (--drain-replica / "
+                        "--undrain-replica / --list-replicas)")
+    p.add_argument("--drain-replica", metavar="TARGET",
+                   help="with --admin: stop placing on TARGET and let "
+                        "it drain (the zero-downtime rolling-restart "
+                        "step; pool-spawned replicas are also "
+                        "SIGTERMed and respawned on the same address)")
+    p.add_argument("--undrain-replica", metavar="TARGET",
+                   help="with --admin: re-admit a drained replica "
+                        "(fresh circuit breaker on the reused address)")
+    p.add_argument("--list-replicas", action="store_true",
+                   help="with --admin: print the fleet snapshot JSON")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="admin-mode HTTP timeout in seconds (default 5)")
+    p.set_defaults(fn=cmd_router)
 
     p = sub.add_parser("import-torch",
                        help="torch state dict (.pt) -> model JSON")
@@ -2592,6 +2912,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host:port of a running --metrics-port endpoint")
     p.add_argument("--raw", action="store_true",
                    help="dump the Prometheus text exposition as-is")
+    p.add_argument("--aggregate", action="store_true",
+                   help="against a ROUTER endpoint: scrape the router "
+                        "AND every pool replica in one shot (fleet "
+                        "discovery via /router/replicas; counters "
+                        "summed, gauges per replica)")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_metrics)

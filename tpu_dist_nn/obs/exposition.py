@@ -158,14 +158,29 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
                  registry: Registry | None = None, health_fn=None,
-                 tracer=None):
+                 tracer=None, routes=None):
         reg = registry if registry is not None else REGISTRY
         outer = self
+        # Extra GET routes, ``{path: fn(query) -> (status, content_type,
+        # body_bytes)}`` — the admin seam (the router mounts its
+        # /router/* drain + fleet-introspection paths here). A raising
+        # route degrades to a JSON 500, never a handler traceback.
+        self._routes = dict(routes or {})
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
                 path, _, query = self.path.partition("?")
-                if path == "/metrics":
+                if path in outer._routes:
+                    try:
+                        status, ctype, body = outer._routes[path](query)
+                    except Exception as e:  # noqa: BLE001 — degrade
+                        log.warning("route %s failed: %r", path, e)
+                        status, ctype, body = (
+                            500, "application/json",
+                            json.dumps({"error": repr(e)}).encode() + b"\n",
+                        )
+                    self._reply(status, ctype, body)
+                elif path == "/metrics":
                     body = render(reg).encode()
                     self._reply(200, CONTENT_TYPE, body)
                 elif path == "/healthz":
@@ -331,7 +346,9 @@ class MetricsServer:
 
 def start_http_server(port: int = 0, host: str = "0.0.0.0", *,
                       registry: Registry | None = None,
-                      health_fn=None) -> MetricsServer:
+                      health_fn=None, routes=None) -> MetricsServer:
     """Start the /metrics endpoint; returns the server (``.port`` holds
-    the bound port when ``port=0`` picked an ephemeral one)."""
-    return MetricsServer(port, host, registry=registry, health_fn=health_fn)
+    the bound port when ``port=0`` picked an ephemeral one). ``routes``
+    mounts extra GET paths (see :class:`MetricsServer`)."""
+    return MetricsServer(port, host, registry=registry, health_fn=health_fn,
+                         routes=routes)

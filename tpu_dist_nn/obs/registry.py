@@ -132,6 +132,37 @@ class Metric:
                 )
         return child
 
+    def remove(self, **labels) -> None:
+        """Drop one child series — for label values that have left the
+        system (a removed pool replica): without this the label set
+        only ever grows, and the dead series keeps exposing its stale
+        last value. No-op when the child never existed."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got "
+                f"{tuple(labels)}"
+            )
+        key = tuple(str(labels[ln]) for ln in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def remove_matching(self, **labels) -> None:
+        """Drop every child series whose values match the given SUBSET
+        of labels — e.g. all ``outcome`` series of one departed
+        ``replica`` — where :meth:`remove` needs the full label set."""
+        unknown = set(labels) - set(self.labelnames)
+        if unknown:
+            raise ValueError(
+                f"{self.name}: unknown labels {tuple(sorted(unknown))}; "
+                f"has {self.labelnames}"
+            )
+        idx = {ln: i for i, ln in enumerate(self.labelnames)}
+        want = {idx[ln]: str(v) for ln, v in labels.items()}
+        with self._lock:
+            for key in [k for k in self._children
+                        if all(k[i] == v for i, v in want.items())]:
+                self._children.pop(key, None)
+
     # Unlabeled convenience: metric.inc() == metric.labels().inc().
     def _default(self) -> _Child:
         if self.labelnames:

@@ -108,6 +108,26 @@ class RuntimeSampler:
             "the tdn_prefix_cache_* families)",
         )
         self._gen_scheds: list[object] = []
+        # Router replica pools (serving/pool.py): the fleet-state
+        # gauges nobody increments — per-replica outstanding requests
+        # and the blended load view the placement policy compares.
+        self._g_pool_outstanding = reg.gauge(
+            "tdn_router_replica_outstanding",
+            "requests this router currently has in flight on each "
+            "replica (the p2c fallback signal when gauges are stale)",
+            labels=("replica",),
+        )
+        self._g_pool_pending = reg.gauge(
+            "tdn_router_replica_pending_rows",
+            "last scraped tdn_batcher_pending_rows backlog per replica "
+            "(the p2c load signal while fresh)",
+            labels=("replica",),
+        )
+        self._pools: list[object] = []
+        # Replica labels written on the previous tick: membership churn
+        # (pool.remove) must retire the dead series, not leave phantom
+        # last values on /metrics forever.
+        self._pool_replicas_seen: set[str] = set()
         # The tracer observing itself: buffer occupancy plus an
         # eviction counter, so "why is my slow request's trace gone"
         # has a scrapeable answer (dropped > 0: raise the buffer or
@@ -139,6 +159,14 @@ class RuntimeSampler:
         slot gauges (its queue/counter families ride :meth:`add_batcher`
         — the scheduler satisfies the batcher attribute contract)."""
         self._gen_scheds.append(sched)
+
+    def add_pool(self, pool) -> None:
+        """Register a router :class:`~tpu_dist_nn.serving.pool
+        .ReplicaPool` for the per-replica fleet gauges (the pool's own
+        scraper refreshes load; this publishes the router-side view —
+        tdn_router_replica_healthy is written by the pool itself on
+        state transitions, so it is live even without a sampler)."""
+        self._pools.append(pool)
 
     def add_tracer(self, tracer) -> None:
         self._tracers.append(tracer)
@@ -206,6 +234,21 @@ class RuntimeSampler:
                     for s in self._gen_scheds
                 )
             )
+        if self._pools:
+            seen: set[str] = set()
+            for pool in self._pools:
+                for snap in pool.snapshot():
+                    seen.add(snap["target"])
+                    self._g_pool_outstanding.labels(
+                        replica=snap["target"]
+                    ).set(float(snap["outstanding"]))
+                    self._g_pool_pending.labels(replica=snap["target"]).set(
+                        float(snap["pending_rows"] or 0.0)
+                    )
+            for gone in self._pool_replicas_seen - seen:
+                self._g_pool_outstanding.remove(replica=gone)
+                self._g_pool_pending.remove(replica=gone)
+            self._pool_replicas_seen = seen
         if self._engines:
             # (tdn_engine_warm_buckets is NOT sampled here: the engine's
             # warm_buckets method is its single writer — a second writer

@@ -3,10 +3,20 @@
 from tpu_dist_nn.serving.continuous import (  # noqa: F401
     ContinuousScheduler,
 )
+from tpu_dist_nn.serving.pool import (  # noqa: F401
+    Replica,
+    ReplicaPool,
+)
 from tpu_dist_nn.serving.resilience import (  # noqa: F401
     CircuitBreaker,
     GracefulDrain,
     RetryPolicy,
+)
+from tpu_dist_nn.serving.router import (  # noqa: F401
+    Router,
+    admin_routes,
+    router_health,
+    serve_router,
 )
 from tpu_dist_nn.serving.server import (  # noqa: F401
     GrpcClient,
@@ -16,6 +26,7 @@ from tpu_dist_nn.serving.server import (  # noqa: F401
 from tpu_dist_nn.serving.wire import (  # noqa: F401
     GENERATE_METHOD,
     PROCESS_METHOD,
+    SESSION_HEADER,
     decode_matrix,
     encode_matrix,
 )

@@ -41,10 +41,16 @@ import random
 import signal
 import threading
 import time
+import uuid
 
 from tpu_dist_nn.obs.registry import REGISTRY
 
 log = logging.getLogger(__name__)
+
+# Stable for the life of THIS process, different every boot: /healthz
+# carries it (wrap_health) so a poller can distinguish a restarted
+# server on a reused address from the same process still answering.
+BOOT_ID = uuid.uuid4().hex
 
 # Retries the CLIENT issued, per method — the acceptance signal that a
 # faulty run recovered through the policy rather than by luck.
@@ -205,9 +211,14 @@ class CircuitBreaker:
         """Drop the shared breaker for ``target`` (long-lived processes
         dialing many ephemeral targets, or a reused address whose OLD
         incumbent's open state should not greet the new server — the
-        cooldown bounds that window anyway, this removes it)."""
+        cooldown bounds that window anyway, this removes it). Also
+        retires the target's ``tdn_breaker_state`` series: a departed
+        target's stale last value must not sit on /metrics forever,
+        and replica churn must not grow the label set unboundedly
+        (``for_target`` on the reused address recreates it)."""
         with cls._registry_lock:
             cls._registry.pop(target, None)
+        BREAKER_STATE.remove(target=target)
 
     @property
     def state(self) -> str:
@@ -314,7 +325,12 @@ class GracefulDrain:
     def wrap_health(self, health_fn=None):
         """Wrap a ``/healthz`` closure: while draining, ``ready`` is
         forced False (HTTP 503 — NOT_SERVING) and ``draining: true``
-        names why, whatever the engine underneath reports."""
+        names why, whatever the engine underneath reports. Every
+        payload also carries this process's ``boot_id``, so a poller
+        (the router's scraper) can tell a RESTARTED server on a reused
+        address from the same process still answering — a restart fast
+        enough to fall entirely between two polls is otherwise
+        invisible."""
 
         def health():
             if self.draining.is_set():
@@ -328,9 +344,11 @@ class GracefulDrain:
                     base = {"error": repr(e)}
                 base["ready"] = False
                 base["draining"] = True
+                base.setdefault("boot_id", BOOT_ID)
                 return base
             base = dict(health_fn()) if health_fn is not None else {"ready": True}
             base.setdefault("draining", False)
+            base.setdefault("boot_id", BOOT_ID)
             return base
 
         return health

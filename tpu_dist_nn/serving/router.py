@@ -1,0 +1,407 @@
+"""Load-aware gRPC front door over a :class:`ReplicaPool`.
+
+The router speaks the SAME wire surface as one engine server —
+``LayerService/Process`` and ``/Generate``, raw Matrix bytes — so any
+existing client (``GrpcClient``, the reference's stubs, ``tdn infer
+--target``) points at the router unchanged and transparently gains a
+fleet behind it. Per request the router:
+
+1. joins the caller's trace (``x-tdn-trace``) so the hop shows up as
+   a ``router.forward`` stage in ``/profile`` (placement time is the
+   ``tdn_router_placement_seconds`` histogram — microseconds, not
+   worth a span per attempt) — the router hop is attributable, never
+   a black box between client and engine;
+2. picks a replica by power-of-two-choices over live load
+   (:meth:`ReplicaPool.place`), honoring session affinity
+   (``x-tdn-session``) so a follow-up Generate lands on the replica
+   already holding its KV/prefix-cache state;
+3. forwards the RAW request bytes over a persistent channel (the
+   router never decodes a Matrix — the hop costs metadata handling
+   plus one TCP round trip, not a codec pass);
+4. on a TRANSIENT failure (UNAVAILABLE / DEADLINE_EXCEEDED) records
+   the breaker outcome and FAILS OVER to another replica within the
+   caller's remaining budget (deadline and/or ``x-tdn-timeout-ms``
+   hint) — the reference's "clients may retry elsewhere" done FOR the
+   client, with the same budget-carving rule as
+   :class:`~tpu_dist_nn.serving.resilience.RetryPolicy`;
+5. propagates a non-transient status (INVALID_ARGUMENT, INTERNAL,
+   RESOURCE_EXHAUSTED...) verbatim — deterministic failures are the
+   replica's verdict, retrying them elsewhere only doubles the damage.
+
+Metrics (docs/OBSERVABILITY.md): ``tdn_router_requests_total{replica,
+outcome}``, ``tdn_router_placement_seconds``,
+``tdn_router_failovers_total``, plus the pool's
+``tdn_router_replica_healthy{replica}``. Admin: :func:`admin_routes`
+serves ``/router/replicas`` / ``/router/drain`` / ``/router/undrain``
+on the metrics endpoint — the ``tdn router --drain-replica`` path for
+zero-downtime rolling restarts (docs/SCALING.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.parse
+
+import grpc
+
+from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.log import get_logger
+from tpu_dist_nn.obs.registry import REGISTRY
+from tpu_dist_nn.serving.pool import ACTIVE, ReplicaPool
+from tpu_dist_nn.serving.resilience import (
+    RETRYABLE_CODES,
+    CircuitBreaker,
+    RetryPolicy,
+    _code_name,
+)
+from tpu_dist_nn.serving.server import _new_grpc_server, _request_span
+from tpu_dist_nn.serving.wire import SERVICE_NAME, SESSION_HEADER
+
+log = logging.getLogger(__name__)
+slog = get_logger(__name__)
+
+ROUTER_REQUESTS = REGISTRY.counter(
+    "tdn_router_requests_total",
+    "requests the router forwarded (or failed), per replica and "
+    "outcome ('ok' or the gRPC status name; replica 'none' = no "
+    "placement possible)",
+    labels=("replica", "outcome"),
+)
+ROUTER_PLACEMENT = REGISTRY.histogram(
+    "tdn_router_placement_seconds",
+    "time spent choosing a replica for one attempt (p2c + session "
+    "lookup; excludes the forward itself)",
+)
+ROUTER_FAILOVERS = REGISTRY.counter(
+    "tdn_router_failovers_total",
+    "attempts re-placed onto ANOTHER replica after a transient "
+    "failure (the fleet absorbing a replica loss)",
+)
+
+_CLIENT_DEFAULT = object()
+
+
+class Router:
+    """The forwarding core behind both RPC methods (one instance per
+    server; stateless between requests except through the pool)."""
+
+    def __init__(self, pool: ReplicaPool, *, retry=_CLIENT_DEFAULT,
+                 forward_timeout: float | None = 120.0):
+        self.pool = pool
+        # max_attempts bounds attempts per REQUEST (across replicas);
+        # failover to a fresh replica is immediate, the jittered
+        # backoff only paces a second pass over the same replicas.
+        self._retry = (
+            RetryPolicy(base_delay=0.01, max_delay=0.25)
+            if retry is _CLIENT_DEFAULT else retry
+        )
+        # Per-forward cap when the caller sent NO deadline and no
+        # x-tdn-timeout-ms hint: a replica that accepts TCP but never
+        # answers (SIGSTOP, blackhole) must not hold a router worker
+        # thread forever — 32 such requests would wedge the whole
+        # front door. Deadline-carrying requests keep their own budget
+        # (the engine path bounds these the same way: the batcher's
+        # submit_timeout defaults to 120s). None disables the cap.
+        self._forward_timeout = forward_timeout
+
+    # ----------------------------------------------------------- serve
+
+    def handle(self, method: str, payload: bytes, context) -> bytes:
+        span, budget, md = _request_span(context, f"{method}")
+        session = md.get(SESSION_HEADER)
+        try:
+            return self._route(method, payload, context, span, budget,
+                               session)
+        finally:
+            span.end()
+
+    def _abort(self, context, replica: str, code, message: str):
+        ROUTER_REQUESTS.labels(
+            replica=replica, outcome=_code_name(code)
+        ).inc()
+        context.abort(code, message)
+
+    def _route(self, method: str, payload: bytes, context, span, budget,
+               session: str | None) -> bytes:
+        policy = self._retry
+        deadline = time.monotonic() + budget if budget is not None else None
+        attempt = 0
+        tried: set[str] = set()
+        last: grpc.RpcError | None = None
+        prev_failed: str | None = None
+        while True:
+            attempt += 1
+            t0 = time.monotonic()
+            rep = self.pool.place(session_key=session, exclude=tried)
+            if rep is None and tried:
+                # Every placeable replica failed this request once:
+                # widen back to the full set for the next pass.
+                tried.clear()
+                rep = self.pool.place(session_key=session)
+            ROUTER_PLACEMENT.observe(time.monotonic() - t0)
+            if rep is None:
+                span.annotate("no placeable replica")
+                if last is not None:
+                    self._abort(
+                        context, "none", _status_of(last),
+                        f"no replica left to fail over to: "
+                        f"{_details_of(last)}",
+                    )
+                self._abort(
+                    context, "none", grpc.StatusCode.UNAVAILABLE,
+                    "no healthy replica available (pool empty, all "
+                    "draining, or all breakers open)",
+                )
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0.001:
+                    # Label "none": this replica never saw the request
+                    # — the budget died on earlier attempts elsewhere.
+                    span.annotate("budget exhausted before forward")
+                    self._abort(
+                        context, "none",
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "request budget exhausted during failover",
+                    )
+            metadata = [(_trace.TRACE_HEADER, span.ctx.header())]
+            if remaining is not None:
+                metadata.append(
+                    (_trace.TIMEOUT_HEADER,
+                     str(max(0, int(remaining * 1000))))
+                )
+            if session is not None:
+                metadata.append((SESSION_HEADER, session))
+            if prev_failed is not None and rep.target != prev_failed:
+                # Only an actual re-placement onto ANOTHER replica is a
+                # failover — a same-replica retry (single-replica pool,
+                # or the widened pass landing back) is not the fleet
+                # absorbing anything.
+                ROUTER_FAILOVERS.inc()
+            self.pool.begin(rep)
+            err: grpc.RpcError | None = None
+            t_fwd = time.monotonic()
+            try:
+                reply = rep.call(
+                    method, payload,
+                    timeout=(remaining if remaining is not None
+                             else self._forward_timeout),
+                    metadata=metadata,
+                )
+            except grpc.RpcError as e:
+                err = e
+            finally:
+                self.pool.done(rep)
+                _trace.TRACER.record_span(
+                    "router.forward", span.ctx, t_fwd,
+                    time.monotonic() - t_fwd,
+                    attrs={"replica": rep.target, "attempt": attempt,
+                           "ok": err is None},
+                )
+            if err is None:
+                rep.breaker.record_success()
+                ROUTER_REQUESTS.labels(
+                    replica=rep.target, outcome="ok"
+                ).inc()
+                if session is not None:
+                    self.pool.pin(session, rep.target)
+                if attempt > 1:
+                    span.annotate(
+                        f"served by {rep.target} on attempt {attempt}"
+                    )
+                return reply
+            code = _status_of(err)
+            transient = (
+                policy.retryable(code) if policy is not None
+                else _code_name(code) in RETRYABLE_CODES
+            )
+            if transient:
+                rep.breaker.record_failure()
+            else:
+                # The replica ANSWERED (reachability): close a probe
+                # instead of wedging it, exactly like GrpcClient.
+                rep.breaker.record_success()
+            ROUTER_REQUESTS.labels(
+                replica=rep.target, outcome=_code_name(code)
+            ).inc()
+            if not transient:
+                # Deterministic verdicts propagate verbatim — another
+                # replica would say the same thing.
+                span.annotate(
+                    f"{_code_name(code)} from {rep.target}: propagated"
+                )
+                context.abort(code, _details_of(err))
+            last = err
+            tried.add(rep.target)
+            # A fresh replica is tried immediately; the backoff only
+            # paces a renewed pass once every PLACEABLE replica has
+            # failed. Draining / breaker-open replicas don't count —
+            # place() will never return them, and letting them mask
+            # the pacing would hammer the one struggling replica
+            # back-to-back with zero delay.
+            placeable = {
+                r.target for r in self.pool.replicas()
+                if r.state == ACTIVE
+                and r.breaker.state == CircuitBreaker.CLOSED
+            }
+            retry_same_set = not (placeable - tried)
+            # The attempt cap scales with the fleet: policy.max_attempts
+            # is a client-oriented default (3) — on a 5-replica pool
+            # where 3 died together (their breakers still closed, and
+            # dead-fast failures make p2c PREFER them), a fixed cap
+            # aborts with healthy replicas never tried. Every replica
+            # in this request's view gets at least one shot.
+            out_of_attempts = (
+                policy is None
+                or attempt >= max(policy.max_attempts,
+                                  len(placeable | tried))
+            )
+            delay = (
+                policy.backoff(attempt)
+                if not out_of_attempts and retry_same_set else 0.0
+            )
+            out_of_budget = (
+                deadline is not None
+                and time.monotonic() + delay >= deadline
+            )
+            if out_of_attempts or out_of_budget:
+                why = ("attempts exhausted" if out_of_attempts
+                       else "budget exhausted")
+                span.annotate(
+                    f"failover stopped after attempt {attempt} ({why})"
+                )
+                slog.warning(
+                    "router.request_failed", method=method,
+                    replica=rep.target, code=_code_name(code),
+                    attempts=attempt, why=why,
+                )
+                context.abort(code, _details_of(err))
+            prev_failed = rep.target
+            span.annotate(
+                f"failover after {_code_name(code)} from {rep.target}"
+            )
+            if delay:
+                policy.sleep(delay)
+
+
+def _status_of(e: grpc.RpcError):
+    try:
+        code = e.code()
+    except Exception:  # noqa: BLE001 — in-process fakes
+        code = None
+    return code if code is not None else grpc.StatusCode.UNKNOWN
+
+
+def _details_of(e: grpc.RpcError) -> str:
+    try:
+        return e.details() or str(e)
+    except Exception:  # noqa: BLE001
+        return str(e)
+
+
+def _make_router_handler(router: Router):
+    def bind(method: str):
+        def handle(request_bytes: bytes, context) -> bytes:
+            return router.handle(method, request_bytes, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            handle, request_deserializer=bytes, response_serializer=bytes
+        )
+
+    return grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {"Process": bind("Process"), "Generate": bind("Generate")},
+    )
+
+
+def serve_router(pool: ReplicaPool, port: int, *,
+                 host: str = "0.0.0.0", max_workers: int = 32,
+                 retry=_CLIENT_DEFAULT, interceptors=(),
+                 forward_timeout: float | None = 120.0):
+    """Start the router on ``host:port``; returns ``(server,
+    bound_port)``. ``server.router`` / ``server.pool`` expose the
+    internals; ``port=0`` picks an ephemeral port (printed by ``tdn
+    router`` as a JSON line). ``retry=None`` disables failover (one
+    attempt per request — the A/B control arm); ``interceptors`` is
+    the fault-injection seam, same as the engine servers;
+    ``forward_timeout`` caps each forward for deadline-less callers
+    (a wedged replica must not hold worker threads forever)."""
+    router = Router(pool, retry=retry, forward_timeout=forward_timeout)
+    server = _new_grpc_server(max_workers, interceptors)
+    server.add_generic_rpc_handlers((_make_router_handler(router),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OSError(f"could not bind router to port {port}")
+    server.router = router
+    server.pool = pool
+    server.start()
+    slog.info("router.start", port=bound, replicas=pool.targets())
+    return server, bound
+
+
+def router_health(pool: ReplicaPool):
+    """A ``/healthz`` closure for the router's metrics endpoint: ready
+    while at least one replica is placeable (the condition under which
+    the router can serve anything)."""
+
+    def health():
+        snap = pool.snapshot()
+        placeable = [
+            s for s in snap
+            if s["state"] == "active" and s["breaker"] != "open"
+        ]
+        return {
+            "ready": bool(placeable),
+            "role": "router",
+            "replicas": len(snap),
+            "placeable": len(placeable),
+        }
+
+    return health
+
+
+def admin_routes(pool: ReplicaPool) -> dict:
+    """The rolling-restart admin surface, mounted on the router's
+    metrics endpoint (:class:`~tpu_dist_nn.obs.exposition.MetricsServer`
+    ``routes=``): fleet introspection for ``tdn metrics --aggregate``
+    and the drain choreography for ``tdn router --drain-replica``."""
+
+    def replicas(query: str):
+        return 200, "application/json", (
+            json.dumps(pool.snapshot()).encode() + b"\n"
+        )
+
+    def _one_target(query: str) -> str | None:
+        q = urllib.parse.parse_qs(query)
+        vals = q.get("replica")
+        return vals[0] if vals else None
+
+    def drain(query: str):
+        target = _one_target(query)
+        if target is None:
+            return 400, "application/json", \
+                b'{"error": "replica= query parameter required"}\n'
+        ok = pool.drain(target)
+        status = 200 if ok else 404
+        return status, "application/json", json.dumps(
+            {"replica": target, "draining": ok}
+        ).encode() + b"\n"
+
+    def undrain(query: str):
+        target = _one_target(query)
+        if target is None:
+            return 400, "application/json", \
+                b'{"error": "replica= query parameter required"}\n'
+        ok = pool.undrain(target)
+        status = 200 if ok else 404
+        return status, "application/json", json.dumps(
+            {"replica": target, "active": ok}
+        ).encode() + b"\n"
+
+    return {
+        "/router/replicas": replicas,
+        "/router/drain": drain,
+        "/router/undrain": undrain,
+    }

@@ -45,6 +45,7 @@ from tpu_dist_nn.serving.wire import (
     GENERATE_METHOD,
     PROCESS_METHOD,
     SERVICE_NAME,
+    SESSION_HEADER,
     decode_matrix,
     encode_matrix,
 )
@@ -531,9 +532,11 @@ def _request_span(context, method: str):
     caller's sampling decision); without one this is a new locally
     sampled root. Always names the trace back to the caller in
     trailing metadata so a failed RPC tells the client which trace to
-    pull from ``/trace``. Returns ``(span, budget_seconds)`` where the
-    budget is ``min(grpc deadline remaining, x-tdn-timeout-ms hint)``
-    — whichever bounds exist.
+    pull from ``/trace``. Returns ``(span, budget_seconds, metadata)``
+    where the budget is ``min(grpc deadline remaining, x-tdn-timeout-ms
+    hint)`` — whichever bounds exist — and ``metadata`` is the parsed
+    invocation-metadata dict (the router reads ``x-tdn-session`` from
+    it; engine handlers ignore it).
     """
     md = {}
     try:
@@ -562,7 +565,7 @@ def _request_span(context, method: str):
             bounds.append(float(hint) / 1000.0)
         except ValueError:
             pass  # a garbled hint must not fail the RPC
-    return span, (min(bounds) if bounds else None)
+    return span, (min(bounds) if bounds else None), md
 
 
 def _abort(context, method: str, code, message: str):
@@ -675,7 +678,7 @@ def _make_handler(engine, batcher: _Batcher | None):
 
     def process(request_bytes: bytes, context) -> bytes:
         _RPC_REQUESTS.labels(method="Process").inc()
-        span, budget = _request_span(context, "Process")
+        span, budget, _md = _request_span(context, "Process")
         try:
             try:
                 with _trace.TRACER.span("decode", span.ctx):
@@ -808,7 +811,7 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
 
     def generate(request_bytes: bytes, context) -> bytes:
         _RPC_REQUESTS.labels(method="Generate").inc()
-        span, budget = _request_span(context, "Generate")
+        span, budget, _md = _request_span(context, "Generate")
         try:
             try:
                 with _trace.TRACER.span("decode", span.ctx):
@@ -1137,15 +1140,25 @@ class GrpcClient:
     for up to ``ready_timeout`` seconds, raising ``UnavailableError``
     on expiry — instead of the first RPC silently eating the connect
     latency or failing with an opaque UNAVAILABLE.
+
+    ``session_key`` rides every call as ``x-tdn-session`` metadata:
+    against the multi-replica router (docs/SCALING.md) it pins this
+    client's follow-up Generate requests to the replica holding their
+    KV/prefix-cache state; a single engine server ignores it. Per-call
+    override via ``process(..., session_key=)`` / ``generate(...,
+    session_key=)`` for clients multiplexing many sessions over one
+    channel.
     """
 
     def __init__(self, target: str, timeout: float = 30.0, *,
                  retry=_CLIENT_DEFAULT, breaker=_CLIENT_DEFAULT,
-                 wait_for_ready: bool = False, ready_timeout: float = 5.0):
+                 wait_for_ready: bool = False, ready_timeout: float = 5.0,
+                 session_key: str | None = None):
         from tpu_dist_nn.serving.resilience import CircuitBreaker, RetryPolicy
 
         self.target = target
         self.timeout = timeout
+        self.session_key = session_key
         self._retry = RetryPolicy() if retry is _CLIENT_DEFAULT else retry
         self._breaker = (
             CircuitBreaker.for_target(target)
@@ -1201,7 +1214,8 @@ class GrpcClient:
             pass
         return code, trace_id
 
-    def _traced_call(self, call, method: str, payload: bytes) -> bytes:
+    def _traced_call(self, call, method: str, payload: bytes,
+                     session_key=_CLIENT_DEFAULT) -> bytes:
         """One LOGICAL call (original attempt + bounded retries) under
         one client span: the trace context and the remaining-budget
         hint ride the metadata out on every attempt; a final failure
@@ -1214,6 +1228,10 @@ class GrpcClient:
         from tpu_dist_nn.utils.errors import UnavailableError
 
         policy, breaker = self._retry, self._breaker
+        session = (
+            self.session_key if session_key is _CLIENT_DEFAULT
+            else session_key
+        )
         span = _trace.TRACER.start(f"client.{method}")
         deadline = (
             time.monotonic() + self.timeout if self.timeout is not None
@@ -1245,6 +1263,10 @@ class GrpcClient:
                         )
                         raise last_err
                 metadata = ((_trace.TRACE_HEADER, span.ctx.header()),)
+                if session is not None:
+                    # Session affinity key for the router; an engine
+                    # server just never reads it.
+                    metadata += ((SESSION_HEADER, session),)
                 if remaining is not None:
                     # Remaining-budget hint (the grpc-timeout analogue,
                     # readable by the batcher even where a proxy
@@ -1327,20 +1349,25 @@ class GrpcClient:
         finally:
             span.end()
 
-    def process(self, x: np.ndarray) -> np.ndarray:
+    def process(self, x: np.ndarray,
+                session_key=_CLIENT_DEFAULT) -> np.ndarray:
         reply = self._traced_call(
             self._call, "Process",
             encode_matrix(np.asarray(x, np.float64)),
+            session_key=session_key,
         )
         return decode_matrix(reply)
 
-    def generate(self, prompts: np.ndarray) -> np.ndarray:
+    def generate(self, prompts: np.ndarray,
+                 session_key=_CLIENT_DEFAULT) -> np.ndarray:
         """Token-id prompts ``(N, prompt_len)`` -> full sequences
         ``(N, prompt_len + max_new_tokens)`` (ids ride the Matrix wire
-        as doubles — exact)."""
+        as doubles — exact). ``session_key`` overrides the client-level
+        key for this call (None = send no session header)."""
         reply = self._traced_call(
             self._call_generate, "Generate",
             encode_matrix(np.asarray(prompts, np.float64)),
+            session_key=session_key,
         )
         return decode_matrix(reply).astype(np.int64)
 

@@ -169,3 +169,7 @@ PROCESS_METHOD = "/grpc_dist_nn.LayerService/Process"
 # out. A second method on the reference's service, not a new protocol.
 GENERATE_METHOD = "/grpc_dist_nn.LayerService/Generate"
 SERVICE_NAME = "grpc_dist_nn.LayerService"
+# Client -> server session key (serving/router.py): pins a session's
+# follow-up Generate requests to the replica already holding its
+# KV/prefix-cache state. Engine servers ignore it; the router reads it.
+SESSION_HEADER = "x-tdn-session"
