@@ -576,10 +576,67 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
         time_batch(client, rng.uniform(0.0, 1.0, (4096, 784)), "batch4096")
     b = server.batcher
     req0, bat0 = b.requests_total, b.batches_total
+    # SLO summary around the coalesced run (ISSUE 9): ring snapshots
+    # before/after, then the SAME burn-rate evaluator a live server
+    # runs (obs/slo.py) scores the run against a FIXED objective —
+    # fixed so the gated series means "code regression", not "config
+    # change" (the generate-endpoint rule above).
+    from tpu_dist_nn.obs.slo import (
+        SLOTracker,
+        availability_objective,
+        latency_objective,
+    )
+    from tpu_dist_nn.obs.timeseries import TimeSeriesRing
+
+    SLO_P99_MS = 100.0
+    SLO_AVAILABILITY = 0.999
+    slo_ring = TimeSeriesRing(resolution=0.05, retention=3600.0)
+    slo_t0 = time.time()
+    slo_ring.collect(now=slo_t0)
     co = run_concurrent(port)
+    slo_ring.collect(now=max(time.time(), slo_t0 + 0.1))
     co["requests"] = b.requests_total - req0
     co["batches"] = b.batches_total - bat0
     out["coalesced"] = co
+    try:
+        window = max(time.time() - slo_t0 + 1.0, 1.0)
+        tracker = SLOTracker(slo_ring, [
+            latency_objective(
+                "bench_process_latency", "tdn_batch_wait_seconds",
+                SLO_P99_MS / 1e3, q=0.99, match={"method": "Process"},
+            ),
+            availability_objective(
+                "bench_availability", SLO_AVAILABILITY,
+                total_family="tdn_rpc_requests_total",
+                bad_family="tdn_rpc_errors_total",
+            ),
+        ], fast_window=window, slow_window=window)
+        lat_doc, avail_doc = tracker.evaluate()["objectives"]
+        out["slo"] = {
+            "window_s": round(window, 2),
+            "latency": {
+                "objective": lat_doc["objective"],
+                "measured_p99_ms":
+                    lat_doc["windows"]["fast"]["measured_quantile_ms"],
+                "burn_rate": lat_doc["windows"]["fast"]["burn_rate"],
+                "budget_consumed": round(
+                    min(lat_doc["windows"]["slow"]["burn_rate"], 1.0), 4
+                ),
+            },
+            "availability": {
+                "objective": SLO_AVAILABILITY,
+                "measured":
+                    avail_doc["windows"]["fast"]["measured_availability"],
+                "burn_rate": avail_doc["windows"]["fast"]["burn_rate"],
+                "budget_consumed": round(
+                    min(avail_doc["windows"]["slow"]["burn_rate"], 1.0), 4
+                ),
+            },
+        }
+    except Exception as e:  # noqa: BLE001 — summary must not cost the run
+        print(f"# slo summary unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        out["slo"] = None
     client.close()
     server.stop(0)
 

@@ -103,6 +103,13 @@ QUICK_TESTS = {
     "test_expert_parallel": ["test_ep_forward_matches_grouped_oracle[4-2]",
                              "test_top2_training_learns"],
     "test_fastloader": ["test_gather_rows_threads_and_big_batch"],
+    "test_fleet_obs": [
+        # ISSUE 9 quick smokes: /slo + /timeseries endpoints and the
+        # 2-process loopback stitched trace (single trace_id, spans
+        # from both processes, lanes named by process).
+        "test_slo_endpoint_and_gauges_smoke",
+        "test_timeseries_endpoint_smoke",
+        "test_two_process_loopback_stitched_trace"],
     "test_flash_attention": ["test_forward_matches_reference[32-False]",
                              "test_rejects_mismatched_shapes"],
     "test_forward_parity": ["test_forward_matches_oracle_small",

@@ -23,6 +23,10 @@ Gated metrics (docs/PERF.md "Regression gate"):
     gen_prefix_ttft_p99_ms          serving.generate_prefix.ttft_p99_ms
                                                                  lower
     router_rps                      serving.router.rps           higher
+    slo_process_p99_ms              serving.slo.latency.measured_p99_ms
+                                                                 lower
+    slo_availability                serving.slo.availability.measured
+                                                                 higher
 
 Rules:
 
@@ -80,6 +84,15 @@ GATED_METRICS = (
     # fleet's scaling win must not regress once landed. Absent in
     # rounds that predate the section -> per-metric skip.
     ("router_rps", ("serving", "router", "rps"), "higher"),
+    # SLO summary block (ISSUE 9): the serving run scored against the
+    # fixed p99/availability objectives bench.py declares. Gated like
+    # any other family — absent in pre-ISSUE-9 rounds -> per-metric
+    # skip; a later round that blows the measured p99 or availability
+    # past threshold fails the gate.
+    ("slo_process_p99_ms",
+     ("serving", "slo", "latency", "measured_p99_ms"), "lower"),
+    ("slo_availability",
+     ("serving", "slo", "availability", "measured"), "higher"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

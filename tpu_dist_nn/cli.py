@@ -23,6 +23,9 @@
                  via ``GET /profile``) as a "where does the time go"
                  table, optionally with an on-demand ``jax.profiler``
                  device capture (``GET /debug/profile``)
+``tdn top``    — live fleet dashboard (obs/top.py): per-replica rps,
+                 percentiles, slots, breaker state, SLO budget, and
+                 sparklines over a router (or single-server) endpoint
 """
 
 from __future__ import annotations
@@ -225,6 +228,95 @@ def _drain_metrics_servers() -> None:
         _stop_metrics_server(server, sampler)
 
 
+def _add_slo_args(p) -> None:
+    """The SLO flags shared by every serving verb (up/lm/router):
+    declaring an objective turns on the burn-rate tracker over the
+    endpoint's time-series ring (docs/OBSERVABILITY.md 'SLOs & burn
+    rate')."""
+    p.add_argument("--slo-latency-p99-ms", type=float, default=None,
+                   metavar="MS",
+                   help="latency objective: p99 of this command's "
+                        "request-latency histogram must stay <= MS. "
+                        "Exports tdn_slo_burn_rate{window=fast|slow} / "
+                        "tdn_slo_error_budget_remaining, serves GET "
+                        "/slo, and emits rate-limited slo.burn events "
+                        "while the fast window burns > 1.0")
+    p.add_argument("--slo-availability", type=float, default=None,
+                   metavar="FRACTION",
+                   help="availability objective in (0, 1), e.g. 0.999: "
+                        "at least this fraction of requests must "
+                        "succeed (same exports as the latency SLO)")
+
+
+def _validate_slo_flags(args, needs: str | None = None) -> None:
+    """Fail bad SLO flags BEFORE engine bring-up (the file's fail-fast
+    convention). ``needs`` names an additional flag attribute the SLO
+    tracker rides on for this command (e.g. serving must actually be
+    enabled) — without it the flags would be silently inert."""
+    lat = getattr(args, "slo_latency_p99_ms", None)
+    if lat is not None and lat <= 0:
+        raise ValueError(
+            f"--slo-latency-p99-ms must be > 0, got {lat}"
+        )
+    avail = getattr(args, "slo_availability", None)
+    if avail is not None and not 0.0 < avail < 1.0:
+        raise ValueError(
+            f"--slo-availability must be in (0, 1), got {avail} "
+            "(e.g. 0.999 for three nines)"
+        )
+    if lat is None and avail is None:
+        return
+    if getattr(args, "metrics_port", None) is None:
+        raise ValueError(
+            "--slo-latency-p99-ms/--slo-availability need "
+            "--metrics-port: the SLO tracker rides the runtime "
+            "sampler and serves GET /slo there"
+        )
+    if needs is not None and getattr(args, needs.replace("-", "_"),
+                                     None) is None:
+        raise ValueError(
+            f"--slo-latency-p99-ms/--slo-availability need --{needs} "
+            "on this command (no serving path, nothing to measure)"
+        )
+
+
+def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
+                    latency_match=None, availability_kwargs=None):
+    """Attach the fleet-observability plane to one serving command:
+    a time-series ring sampled every tick (GET /timeseries), plus —
+    when SLO flags were passed — the burn-rate tracker (GET /slo,
+    tdn_slo_* gauges, slo.burn events). Returns (ring, tracker)."""
+    if metrics_server is None or sampler is None:
+        return None, None
+    from tpu_dist_nn.obs.slo import (
+        SLOTracker,
+        availability_objective,
+        latency_objective,
+    )
+    from tpu_dist_nn.obs.timeseries import TimeSeriesRing
+
+    ring = TimeSeriesRing()
+    sampler.add_timeseries(ring)
+    objectives = []
+    lat = getattr(args, "slo_latency_p99_ms", None)
+    if lat is not None:
+        objectives.append(latency_objective(
+            "request_latency_p99", latency_family, lat / 1000.0,
+            q=0.99, match=latency_match,
+        ))
+    avail = getattr(args, "slo_availability", None)
+    if avail is not None:
+        objectives.append(availability_objective(
+            "availability", avail, **(availability_kwargs or {}),
+        ))
+    tracker = None
+    if objectives:
+        tracker = SLOTracker(ring, objectives)
+        sampler.add_slo_tracker(tracker)
+    metrics_server.attach(timeseries=ring, slo=tracker)
+    return ring, tracker
+
+
 def _apply_trace_sample_rate(args) -> None:
     """Configure the process tracer's head-sampling rate from
     ``--trace-sample-rate`` (fail-fast: an out-of-range rate is a user
@@ -305,6 +397,7 @@ def _serve_loop(engine, max_seconds: float | None = None, teardown=None,
 
 def cmd_up(args) -> int:
     _apply_trace_sample_rate(args)
+    _validate_slo_flags(args, needs="grpc-port")
     if args.grpc_port is not None and _jax_process_count() > 1:
         # Before engine bring-up: minutes of pod warmup for a flag
         # combination knowable up front.
@@ -365,6 +458,17 @@ def cmd_up(args) -> int:
                 sampler.add_batcher(server.batcher, method="Process")
             sampler.add_engine(engine)
             sampler.add_tracer(TRACER)
+            # Fleet observability plane: /timeseries history + (with
+            # --slo-* flags) burn-rate tracking over the Process path.
+            _wire_fleet_obs(
+                args, metrics_server, sampler,
+                latency_family="tdn_batch_wait_seconds",
+                latency_match={"method": "Process"},
+                availability_kwargs={
+                    "total_family": "tdn_rpc_requests_total",
+                    "bad_family": "tdn_rpc_errors_total",
+                },
+            )
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
 
@@ -552,6 +656,7 @@ def cmd_router(args) -> int:
 
     # ----- serve mode: bring up the pool + the front door.
     _apply_trace_sample_rate(args)
+    _validate_slo_flags(args)
     targets = _parse_targets(args.replicas)
     if not targets and not args.spawn:
         raise ValueError(
@@ -643,6 +748,17 @@ def cmd_router(args) -> int:
             sampler = RuntimeSampler()
             sampler.add_pool(pool)
             sampler.add_tracer(TRACER)
+            # Fleet observability plane: the router's own latency SLO
+            # rides tdn_router_request_seconds; availability counts
+            # every non-ok outcome against the budget.
+            _wire_fleet_obs(
+                args, metrics_server, sampler,
+                latency_family="tdn_router_request_seconds",
+                availability_kwargs={
+                    "total_family": "tdn_router_requests_total",
+                    "bad_exclude": {"outcome": "ok"},
+                },
+            )
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
         try:
@@ -852,6 +968,7 @@ def cmd_lm(args) -> int:
     )
 
     _apply_trace_sample_rate(args)
+    _validate_slo_flags(args, needs="serve-generate")
     moe = args.experts > 0
     # (MoE x --seq-parallel is rejected below with the other
     # seq-parallel compatibility checks, with or without --stages.)
@@ -1770,6 +1887,18 @@ def cmd_lm(args) -> int:
             if server.scheduler is not None:
                 sampler.add_generation_scheduler(server.scheduler)
             sampler.add_tracer(TRACER)
+            # Fleet observability plane for the generation endpoint:
+            # the latency SLO covers submit -> retirement (the wire
+            # figure a client sees), availability the Generate aborts.
+            _wire_fleet_obs(
+                args, metrics_server, sampler,
+                latency_family="tdn_batch_wait_seconds",
+                latency_match={"method": "Generate"},
+                availability_kwargs={
+                    "total_family": "tdn_rpc_requests_total",
+                    "bad_family": "tdn_rpc_errors_total",
+                },
+            )
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
         print(json.dumps(report), flush=True)
@@ -1873,6 +2002,33 @@ def cmd_metrics(args) -> int:
     import urllib.request
 
     base = _endpoint_base(args.target)
+    if getattr(args, "profile", False) and not args.aggregate:
+        raise ValueError(
+            "--profile rides the fleet fan-out: pass --aggregate too "
+            "(for one process, use `tdn profile --target ...`)"
+        )
+    if args.aggregate and getattr(args, "profile", False):
+        # Fleet-wide /profile: per-stage self time merged across the
+        # router (its router.forward lane included) and every replica —
+        # "where does FLEET time go" as one table.
+        from tpu_dist_nn.obs.collect import collect_fleet_profile
+        from tpu_dist_nn.obs.profile import format_profile_table
+
+        merged = collect_fleet_profile(base, timeout=args.timeout)
+        srcs = merged.get("sources", {})
+        print(f"fleet profile: {len(srcs)} endpoint(s) scraped, "
+              f"{merged.get('traces', 0)} traces")
+        for item in merged.get("unreachable", ()):
+            print(f"  unreachable: {item['source']} ({item['error']})")
+        if args.raw:
+            print(json.dumps(merged))
+        else:
+            print(format_profile_table(merged))
+            est = merged.get("merged_estimates", {})
+            if est:
+                print("  (merged estimates: p50 " + est.get("p50_s", "")
+                      + "; p99/max " + est.get("p99_s", "") + ")")
+        return 0
     text = _endpoint_get(base, "/metrics", args.timeout).decode()
     if args.aggregate:
         from tpu_dist_nn.obs import parse_prometheus_text
@@ -1965,11 +2121,54 @@ def cmd_trace(args) -> int:
     trace-event file: ``tdn trace --target host:metrics-port -o
     trace.json`` then open the file in Perfetto (ui.perfetto.dev) or
     ``chrome://tracing`` — where a ``jax.profiler`` capture of the same
-    window can be overlaid for the request-to-device view."""
+    window can be overlaid for the request-to-device view.
+
+    ``--aggregate`` (against a ROUTER's metrics endpoint) discovers the
+    fleet via /router/replicas, pulls every process's /trace, and
+    STITCHES them into one document — spans sharing a trace id land in
+    one tree across per-process lanes, so a request's router hop and
+    its serving replica's span subtree read as one timeline.
+    ``--trace-id`` pulls just that trace (one slow exemplar, not the
+    whole ring) in either mode."""
     base = _endpoint_base(args.target)
+    if args.aggregate:
+        from tpu_dist_nn.obs.collect import collect_fleet_trace
+
+        doc = collect_fleet_trace(
+            base, timeout=args.timeout, limit=args.limit,
+            trace_id=args.trace_id,
+        )
+        events = doc["traceEvents"]
+        body = json.dumps(doc).encode()
+        meta = doc.get("metadata", {})
+        with open(args.out, "wb") as f:
+            f.write(body)
+        spans = [e for e in events if e.get("ph") == "X"]
+        traces = {
+            e["args"]["trace_id"] for e in spans
+            if "trace_id" in e.get("args", {})
+        }
+        print(json.dumps({
+            "out": args.out,
+            "stitched_sources": meta.get("stitched_sources"),
+            "lanes": meta.get("lanes"),
+            "unreachable": meta.get("unreachable"),
+            "events": len(events),
+            "spans": len(spans),
+            "traces": len(traces),
+            "deduped_events": meta.get("deduped_events"),
+            "trace_id_filter": args.trace_id,
+            "open_with": "https://ui.perfetto.dev or chrome://tracing",
+        }))
+        return 0
     path = "/trace"
+    params = []
     if args.limit is not None:
-        path += f"?limit={args.limit}"
+        params.append(f"limit={args.limit}")
+    if args.trace_id is not None:
+        params.append(f"trace_id={args.trace_id}")
+    if params:
+        path += "?" + "&".join(params)
     body = _endpoint_get(base, path, args.timeout)
     try:
         doc = json.loads(body)
@@ -2089,6 +2288,26 @@ def cmd_profile(args) -> int:
                          "ui.perfetto.dev",
         }))
     return 0
+
+
+def cmd_top(args) -> int:
+    """Live fleet dashboard (``tdn top --target host:metrics-port``):
+    polls the router's /router/replicas + every endpoint's /metrics,
+    /timeseries, and /slo on an interval and renders per-replica rps,
+    p50/p99, decode-slot occupancy, pending rows, breaker state,
+    prefix-cache hit ratio, SLO budget, and request-rate sparklines.
+    Against a single server's endpoint it shows that process alone."""
+    from tpu_dist_nn.obs.top import run_top
+
+    if args.interval <= 0:
+        raise ValueError(f"--interval must be > 0, got {args.interval}")
+    color = None
+    if args.no_color:
+        color = False
+    return run_top(
+        _endpoint_base(args.target), interval=args.interval,
+        iterations=args.iterations, timeout=args.timeout, color=color,
+    )
 
 
 def cmd_warmup(args) -> int:
@@ -2462,6 +2681,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "in [0, 1]: 1 traces every request (default), "
                         "0 disables recording entirely (env: "
                         "TDN_TRACE_SAMPLE_RATE)")
+    _add_slo_args(p)
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
@@ -2544,6 +2764,7 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="RATE",
                    help="head-sampling rate for router request tracing "
                         "in [0, 1]")
+    _add_slo_args(p)
     p.add_argument("--admin", metavar="HOST:PORT",
                    help="admin-client mode: a RUNNING router's metrics "
                         "endpoint to drive (--drain-replica / "
@@ -2844,6 +3065,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "in [0, 1] (log-interval spans during the "
                         "loop, per-request spans under "
                         "--serve-generate)")
+    _add_slo_args(p)
     p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("doctor",
@@ -2917,6 +3139,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "AND every pool replica in one shot (fleet "
                         "discovery via /router/replicas; counters "
                         "summed, gauges per replica)")
+    p.add_argument("--profile", action="store_true",
+                   help="with --aggregate: fan /profile out over the "
+                        "fleet and merge per-stage self time across "
+                        "replicas (router.forward lane included) — "
+                        "'where does fleet time go' as one table "
+                        "(--raw dumps the merged JSON)")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_metrics)
@@ -2933,9 +3161,40 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int, default=None,
                    help="at most N most-recent ring-buffer spans "
                         "(slowest-trace exemplars always included)")
+    p.add_argument("--aggregate", action="store_true",
+                   help="against a ROUTER endpoint: pull /trace from "
+                        "the router AND every replica (discovery via "
+                        "/router/replicas) and STITCH them into one "
+                        "Chrome trace — spans joined by trace id, one "
+                        "lane per process")
+    p.add_argument("--trace-id", default=None, metavar="ID",
+                   help="pull only this trace (the id a log line, "
+                        "x-tdn-trace-id trailer, or /slo exemplar "
+                        "named) instead of the whole ring")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "top",
+        help="live fleet dashboard over a --metrics-port endpoint "
+             "(router: every replica; rps, p50/p99, slots, pending, "
+             "breaker state, prefix hit ratio, SLO budget, sparklines)")
+    p.add_argument("--target", required=True,
+                   help="host:port of a running --metrics-port "
+                        "endpoint (a router's for the fleet view)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between polls (default 2)")
+    p.add_argument("--iterations", type=int, default=None, metavar="N",
+                   help="render N frames then exit (default: run until "
+                        "Ctrl-C; the CI/smoke bound)")
+    p.add_argument("--no-color", action="store_true",
+                   help="plain frames without ANSI escapes (also the "
+                        "non-TTY default)")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-request HTTP timeout in seconds "
+                        "(default 3)")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("profile",
                        help="pull a --metrics-port endpoint's per-stage "

@@ -31,6 +31,22 @@ metrics layer the serving/training hot paths publish into:
     trace-correlated, rate-limited records for the serving/engine
     operational paths (``tdn --log-json`` renders the whole process's
     logs as JSON lines).
+  - :mod:`tpu_dist_nn.obs.timeseries` — a bounded in-memory ring the
+    runtime sampler snapshots selected families into (default 5s x 1h),
+    served as ``GET /timeseries`` — history without an external
+    Prometheus.
+  - :mod:`tpu_dist_nn.obs.slo` — declared objectives (latency,
+    availability) evaluated from the ring's windowed deltas into
+    fast/slow error-budget burn rates, the ``tdn_slo_*`` gauges,
+    ``GET /slo``, and the rate-limited ``slo.burn`` event.
+  - :mod:`tpu_dist_nn.obs.collect` — fleet collection: cross-replica
+    trace stitching (one Chrome trace, a lane per process) and
+    ``/profile`` merging behind ``tdn trace --aggregate`` /
+    ``tdn metrics --aggregate --profile`` / the router's
+    ``/trace/fleet``.
+  - :mod:`tpu_dist_nn.obs.top` — the ``tdn top`` live ANSI dashboard
+    over a router fleet or single server (rps, percentiles, slots,
+    breaker state, SLO budget, sparklines).
 
 Every metric this framework publishes is prefixed ``tdn_``; the
 catalog lives in ``docs/OBSERVABILITY.md``. All updates are plain
@@ -43,12 +59,21 @@ from tpu_dist_nn.obs.registry import (  # noqa: F401
     REGISTRY,
     Registry,
     bridge_latency_stats,
+    histogram_quantile,
 )
 from tpu_dist_nn.obs.exposition import (  # noqa: F401
     MetricsServer,
     parse_prometheus_text,
+    parsed_histogram_quantile,
     render,
+    split_series,
     start_http_server,
+)
+from tpu_dist_nn.obs.timeseries import TimeSeriesRing  # noqa: F401
+from tpu_dist_nn.obs.slo import (  # noqa: F401
+    SLOTracker,
+    availability_objective,
+    latency_objective,
 )
 from tpu_dist_nn.obs.runtime import RuntimeSampler  # noqa: F401
 from tpu_dist_nn.obs.trace import (  # noqa: F401
@@ -71,11 +96,18 @@ __all__ = [
     "REGISTRY",
     "Registry",
     "bridge_latency_stats",
+    "histogram_quantile",
     "MetricsServer",
     "parse_prometheus_text",
+    "parsed_histogram_quantile",
     "render",
+    "split_series",
     "start_http_server",
     "RuntimeSampler",
+    "TimeSeriesRing",
+    "SLOTracker",
+    "latency_objective",
+    "availability_objective",
     "SpanContext",
     "TRACE_HEADER",
     "TRACER",

@@ -1,0 +1,389 @@
+"""Fleet observability collection: cross-replica trace stitching and
+profile merging.
+
+PR 8 scaled the data plane to a router + replica fleet, but every
+observability surface stayed per-process: one request's trace lives
+half in the router's ring buffer and half in one replica's, and
+``/profile`` attributes only the spans its own process recorded.
+Following Dapper's collection model (Sigelman et al., 2010 — spans are
+logged locally, joined by trace id centrally), this module is the
+"central" half for a tdn fleet:
+
+* **Discovery** reuses the router's ``/router/replicas`` admin route
+  (the same fan-out ``tdn metrics --aggregate`` does): each replica
+  snapshot carries its ``metrics_target``, which serves ``/trace`` and
+  ``/profile``.
+* **Stitching** (:func:`stitch_chrome_traces`) merges per-process
+  Chrome trace documents into ONE document with a lane per process:
+  the ``x-tdn-trace`` header already carries trace ids across the
+  wire, so spans from the router and the serving replica share a
+  trace id — this module just re-keys ``pid`` per source, names the
+  lanes (``router``, ``replica <target>``; a replica that RESTARTED
+  mid-window gets a second lane per boot, keyed by its original pid),
+  and de-duplicates spans that multiple endpoints exported (an
+  in-process loopback fleet shares one ring).
+* **Profile merging** (:func:`merge_profiles`) folds per-process
+  ``/profile`` breakdowns into one fleet view — counts and self-time
+  totals sum exactly; p50 is count-weighted, p99/max take the fleet
+  worst (percentiles do not merge exactly from summaries, and the
+  fields say which rule produced them via ``merged_estimates``).
+
+Served two ways: ``tdn trace --aggregate`` / ``tdn metrics --aggregate
+--profile`` run the fan-out client-side; the router's metrics endpoint
+mounts the same stitcher as ``GET /trace/fleet``
+(:func:`fleet_trace_route`). Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+def _base_url(target: str) -> str:
+    if "://" not in target:
+        target = f"http://{target}"
+    return target.rstrip("/")
+
+
+def http_get_json(target: str, path: str, timeout: float = 5.0):
+    """GET one endpoint route as parsed JSON; raises ValueError with a
+    nameable reason on any transport/parse failure (the CLI's
+    user-error convention)."""
+    url = _base_url(target) + path
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise ValueError(f"could not fetch {url}: {e}") from e
+
+
+def discover_fleet(router_target: str, timeout: float = 5.0) -> list[dict]:
+    """The router's replica snapshots (``/router/replicas``); raises
+    ValueError when the target is not a router metrics endpoint."""
+    doc = http_get_json(router_target, "/router/replicas", timeout)
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"{router_target}/router/replicas did not return a replica "
+            f"list — is this a ROUTER metrics endpoint?"
+        )
+    return doc
+
+
+def _pull_replicas(snapshots, path: str, timeout: float):
+    """Fan one GET out over replica snapshots (each names its
+    ``metrics_target``) -> ``(docs_by_source, unreachable)`` — the ONE
+    per-replica pull loop behind the client-side fan-outs AND the
+    router-side /trace/fleet route."""
+    docs: dict[str, dict] = {}
+    unreachable: list[dict] = []
+    for rep in snapshots:
+        mt = rep.get("metrics_target")
+        name = f"replica {rep.get('target', mt)}"
+        if not mt:
+            unreachable.append({
+                "source": name,
+                "error": "no metrics_target registered (start the "
+                         "replica with --metrics-port / pass "
+                         "--replica-metrics)",
+            })
+            continue
+        try:
+            docs[name] = http_get_json(mt, path, timeout)
+        except ValueError as e:
+            unreachable.append({"source": name, "error": str(e)})
+    return docs, unreachable
+
+
+def _collect_sources(router_target: str, path: str, timeout: float):
+    """Router (by HTTP) + discovered replicas -> ``(docs_by_source,
+    unreachable)`` — the client-side fan-out (`tdn trace/metrics
+    --aggregate`)."""
+    docs: dict[str, dict] = {}
+    unreachable: list[dict] = []
+    try:
+        docs["router"] = http_get_json(router_target, path, timeout)
+    except ValueError as e:
+        unreachable.append({"source": "router", "error": str(e)})
+    rep_docs, rep_unreachable = _pull_replicas(
+        discover_fleet(router_target, timeout), path, timeout
+    )
+    docs.update(rep_docs)
+    unreachable.extend(rep_unreachable)
+    return docs, unreachable
+
+
+def _trace_path(limit: int | None, trace_id: str | None) -> str:
+    params = []
+    if limit is not None:
+        params.append(f"limit={limit}")
+    if trace_id is not None:
+        params.append(f"trace_id={trace_id}")
+    return "/trace" + ("?" + "&".join(params) if params else "")
+
+
+# ------------------------------------------------------------ stitching
+
+
+def _span_key(event: dict):
+    args = event.get("args") or {}
+    sid = args.get("span_id")
+    if sid:
+        return ("span", sid)
+    return ("anon", event.get("name"), event.get("ts"), event.get("dur"))
+
+
+def stitch_chrome_traces(docs_by_source: dict[str, dict],
+                         trace_id: str | None = None) -> dict:
+    """Merge per-process Chrome trace documents into one stitched
+    document with a lane per process.
+
+    Lanes are keyed by ``(source, original pid)``: one source address
+    that contributed two pids is a replica that RESTARTED inside the
+    collection window (its boot_id changed between scrapes), and its
+    boots must stay separate lanes — folding them would interleave two
+    processes' threads on one track. Lane names are the source label,
+    with ``#N`` suffixes for later boots. Spans exported by more than
+    one endpoint (loopback fleets sharing a ring) de-duplicate by span
+    id, first source wins — sources iterate router-first, so shared
+    spans land on the router lane.
+
+    ``trace_id`` keeps only that trace's events. The result carries a
+    ``metadata`` block (sources, span/trace counts) that Perfetto
+    ignores and ``tdn trace --aggregate`` reports.
+    """
+    lane_pid: dict[tuple, int] = {}
+    lane_name: dict[tuple, str] = {}
+    per_source_pids: dict[str, list] = {}
+    seen_spans = set()
+    seen_instants = set()
+    events: list[dict] = []
+    threads: dict[tuple, str] = {}  # (new_pid, tid) -> name
+    trace_ids = set()
+    deduped = 0
+
+    def lane_of(source: str, orig_pid) -> int:
+        key = (source, orig_pid)
+        if key not in lane_pid:
+            lane_pid[key] = len(lane_pid) + 1
+            boots = per_source_pids.setdefault(source, [])
+            boots.append(orig_pid)
+            lane_name[key] = source if len(boots) == 1 \
+                else f"{source} #{len(boots)}"
+        return lane_pid[key]
+
+    for source, doc in docs_by_source.items():
+        if not isinstance(doc, dict):
+            continue
+        src_threads: dict[tuple, str] = {}
+        for e in doc.get("traceEvents", ()):
+            ph = e.get("ph")
+            if ph == "M":
+                if e.get("name") == "thread_name":
+                    src_threads[(e.get("pid"), e.get("tid"))] = (
+                        (e.get("args") or {}).get("name", "")
+                    )
+                continue
+            args = e.get("args") or {}
+            tid_of_trace = args.get("trace_id")
+            if trace_id is not None and tid_of_trace != trace_id:
+                continue
+            if ph == "X":
+                key = _span_key(e)
+                if key in seen_spans:
+                    deduped += 1
+                    continue
+                seen_spans.add(key)
+            elif ph == "i":
+                key = (args.get("span_id"), e.get("ts"), e.get("name"))
+                if key in seen_instants:
+                    deduped += 1
+                    continue
+                seen_instants.add(key)
+            if tid_of_trace:
+                trace_ids.add(tid_of_trace)
+            new_pid = lane_of(source, e.get("pid"))
+            out = dict(e)
+            out["pid"] = new_pid
+            events.append(out)
+            tname = src_threads.get((e.get("pid"), e.get("tid")))
+            if tname is not None:
+                threads.setdefault((new_pid, e.get("tid")), tname)
+    events.sort(key=lambda e: e.get("ts", 0))
+    meta: list[dict] = []
+    for key, pid in sorted(lane_pid.items(), key=lambda kv: kv[1]):
+        meta.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": lane_name[key]},
+        })
+    for (pid, tid), name in sorted(threads.items()):
+        meta.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": name},
+        })
+    spans = sum(1 for e in events if e.get("ph") == "X")
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "stitched_sources": sorted(docs_by_source),
+            "lanes": [
+                {"pid": pid, "source": key[0], "source_pid": key[1],
+                 "name": lane_name[key]}
+                for key, pid in sorted(lane_pid.items(),
+                                       key=lambda kv: kv[1])
+            ],
+            "spans": spans,
+            "traces": len(trace_ids),
+            "deduped_events": deduped,
+            "trace_id_filter": trace_id,
+        },
+    }
+
+
+def collect_fleet_trace(router_target: str, *, timeout: float = 5.0,
+                        limit: int | None = None,
+                        trace_id: str | None = None) -> dict:
+    """Fan ``GET /trace`` out over router + replicas and stitch
+    (the ``tdn trace --aggregate`` core)."""
+    docs, unreachable = _collect_sources(
+        router_target, _trace_path(limit, trace_id), timeout
+    )
+    stitched = stitch_chrome_traces(docs, trace_id=trace_id)
+    stitched["metadata"]["unreachable"] = unreachable
+    return stitched
+
+
+def fleet_trace_route(pool, tracer=None):
+    """The router-side ``GET /trace/fleet`` route closure (mounted by
+    :func:`tpu_dist_nn.serving.router.admin_routes`): stitches the
+    router's OWN tracer with every replica's ``/trace`` pull — the
+    fleet trace without a client-side fan-out."""
+    import urllib.parse
+
+    def route(query: str):
+        if tracer is None:
+            from tpu_dist_nn.obs.trace import TRACER as t
+        else:
+            t = tracer
+        q = urllib.parse.parse_qs(query)
+        trace_id = (q.get("trace_id") or [None])[0]
+        limit = None
+        raw_limit = (q.get("limit") or [None])[0]
+        if raw_limit:
+            try:
+                limit = int(raw_limit)
+            except ValueError:
+                return 400, "application/json", \
+                    b'{"error": "limit must be an integer"}\n'
+        timeout = 5.0
+        raw_t = (q.get("timeout") or [None])[0]
+        if raw_t:
+            try:
+                timeout = float(raw_t)
+            except ValueError:
+                return 400, "application/json", \
+                    b'{"error": "timeout must be a number"}\n'
+        # The router's own export comes straight off the local tracer
+        # (no HTTP round trip to itself); replicas ride the shared
+        # pull loop the client-side fan-out uses.
+        docs: dict[str, dict] = {
+            "router": t.chrome_trace(limit, trace_id=trace_id),
+        }
+        rep_docs, unreachable = _pull_replicas(
+            pool.snapshot(), _trace_path(limit, trace_id), timeout
+        )
+        docs.update(rep_docs)
+        stitched = stitch_chrome_traces(docs, trace_id=trace_id)
+        stitched["metadata"]["unreachable"] = unreachable
+        return 200, "application/json", \
+            json.dumps(stitched).encode() + b"\n"
+
+    return route
+
+
+# ------------------------------------------------------ profile merging
+
+
+def merge_profiles(docs_by_source: dict[str, dict], top: int = 5) -> dict:
+    """Fold per-process ``/profile`` documents into one fleet
+    breakdown. Self-time totals and counts SUM exactly (self time
+    partitions wall time per process, and processes never share a
+    wall-clock instant's attribution); p50 merges count-weighted,
+    p99/max take the fleet-worst source. Slowest exemplars carry their
+    ``source``."""
+    methods: dict[str, dict] = {}
+    per_source_traces: dict[str, int] = {}
+    for source, doc in docs_by_source.items():
+        if not isinstance(doc, dict):
+            continue
+        per_source_traces[source] = int(doc.get("traces", 0))
+        for method, m in (doc.get("methods") or {}).items():
+            agg = methods.setdefault(method, {
+                "traces": 0, "wall": 0.0, "stages": {}, "slowest": [],
+            })
+            agg["traces"] += int(m.get("traces", 0))
+            agg["wall"] += float(m.get("wall_seconds_total", 0.0))
+            for s in m.get("stages", ()):
+                st = agg["stages"].setdefault(s["stage"], {
+                    "count": 0, "total_s": 0.0, "p50_weighted": 0.0,
+                    "p99_s": 0.0, "max_s": 0.0,
+                })
+                st["count"] += int(s.get("count", 0))
+                st["total_s"] += float(s.get("total_s", 0.0))
+                st["p50_weighted"] += (
+                    float(s.get("p50_s", 0.0)) * int(s.get("count", 0))
+                )
+                st["p99_s"] = max(st["p99_s"], float(s.get("p99_s", 0.0)))
+                st["max_s"] = max(st["max_s"], float(s.get("max_s", 0.0)))
+            for ex in m.get("slowest", ()):
+                agg["slowest"].append({**ex, "source": source})
+    out_methods: dict[str, dict] = {}
+    for method, agg in methods.items():
+        wall = agg["wall"]
+        stages = []
+        for name, st in agg["stages"].items():
+            stages.append({
+                "stage": name,
+                "count": st["count"],
+                "total_s": round(st["total_s"], 6),
+                "share": round(st["total_s"] / wall, 4) if wall else 0.0,
+                "p50_s": round(
+                    st["p50_weighted"] / st["count"], 6
+                ) if st["count"] else 0.0,
+                "p99_s": round(st["p99_s"], 6),
+                "max_s": round(st["max_s"], 6),
+            })
+        stages.sort(key=lambda s: s["total_s"], reverse=True)
+        slowest = sorted(agg["slowest"],
+                         key=lambda e: e.get("wall_s", 0.0), reverse=True)
+        out_methods[method] = {
+            "traces": agg["traces"],
+            "wall_seconds_total": round(wall, 6),
+            "share_sum": round(sum(s["share"] for s in stages), 4),
+            "stages": stages,
+            "slowest": slowest[:max(int(top), 0)],
+        }
+    return {
+        "window_seconds": None,
+        "traces": sum(per_source_traces.values()),
+        "methods": out_methods,
+        "sources": per_source_traces,
+        "merged_estimates": {
+            "p50_s": "count-weighted mean of per-source p50",
+            "p99_s": "fleet-worst source", "max_s": "fleet-worst source",
+        },
+    }
+
+
+def collect_fleet_profile(router_target: str, *, timeout: float = 5.0,
+                          window: float | None = None,
+                          top: int = 5) -> dict:
+    """Fan ``GET /profile`` out over router + replicas and merge
+    (the ``tdn metrics --aggregate --profile`` core)."""
+    path = "/profile" + (f"?window={window}" if window is not None else "")
+    docs, unreachable = _collect_sources(router_target, path, timeout)
+    merged = merge_profiles(docs, top=top)
+    merged["unreachable"] = unreachable
+    return merged

@@ -130,19 +130,112 @@ def parse_prometheus_text(text: str) -> dict:
     return out
 
 
+def split_series(series: str) -> tuple[str, dict[str, str]]:
+    """``'name{a="x",b="y"}'`` -> ``("name", {"a": "x", "b": "y"})`` —
+    the inverse of :func:`render`'s label formatting, for consumers of
+    :func:`parse_prometheus_text` keys (the SLO evaluator's label
+    matching, ``tdn top``'s per-replica views). Handles the escaping
+    render emits; a malformed tail degrades to no labels rather than
+    raising mid-scrape."""
+    name, brace, rest = series.partition("{")
+    if not brace or not rest.endswith("}"):
+        return series, {}
+    labels: dict[str, str] = {}
+    body = rest[:-1]
+    i = 0
+    while i < len(body):
+        eq = body.find('="', i)
+        if eq < 0:
+            break
+        key = body[i:eq]
+        j = eq + 2
+        val: list[str] = []
+        while j < len(body):
+            c = body[j]
+            if c == "\\" and j + 1 < len(body):
+                nxt = body[j + 1]
+                val.append({"n": "\n"}.get(nxt, nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            val.append(c)
+            j += 1
+        labels[key] = "".join(val)
+        i = j + 1
+        if i < len(body) and body[i] == ",":
+            i += 1
+    return name, labels
+
+
+def parsed_histogram_quantile(parsed: dict, family: str, q: float,
+                              **labels) -> float | None:
+    """Quantile estimate for one histogram family out of a
+    :func:`parse_prometheus_text` scrape — the SCRAPE-SIDE twin of
+    ``Histogram.quantile`` (same interpolation, via the shared
+    :func:`~tpu_dist_nn.obs.registry.histogram_quantile`), so ``tdn
+    top`` and fleet SLO views estimate exactly what the serving process
+    itself would. ``labels`` is a SUBSET constraint; series matching it
+    are summed bucket-wise first (e.g. all ``method`` series when no
+    method is pinned). Returns None when no matching buckets exist."""
+    from tpu_dist_nn.obs.registry import histogram_quantile
+
+    prefix = family + "_bucket"
+    cum: dict[float, float] = {}
+    inf = 0.0
+    for series, value in parsed.items():
+        s = str(series)
+        if not s.startswith(prefix):
+            continue
+        name, lbl = split_series(s)
+        if name != prefix or "le" not in lbl:
+            continue
+        if any(lbl.get(k) != str(v) for k, v in labels.items()):
+            continue
+        if lbl["le"] == "+Inf":
+            inf += float(value)
+        else:
+            try:
+                edge = float(lbl["le"])
+            except ValueError:
+                continue
+            cum[edge] = cum.get(edge, 0.0) + float(value)
+    if not cum and inf <= 0:
+        return None
+    edges = sorted(cum)
+    # Cumulative le-series -> per-bucket counts (+Inf tail last).
+    counts = []
+    prev = 0.0
+    for e in edges:
+        counts.append(max(cum[e] - prev, 0.0))
+        prev = cum[e]
+    counts.append(max(inf - prev, 0.0))
+    return histogram_quantile(edges, counts, q)
+
+
 class MetricsServer:
-    """The /metrics + /healthz + /trace + /profile endpoint on a
-    daemon thread.
+    """The /metrics + /healthz + /trace + /profile + /timeseries +
+    /slo endpoint on a daemon thread.
 
     ``health_fn`` is polled per /healthz request (``Engine.health`` in
     the serving wiring); omit it for processes with no engine — the
     endpoint then reports ``{"ready": true}`` for liveness.
 
-    ``GET /trace?limit=N`` exports the process tracer's completed spans
-    (plus its slowest-trace exemplars) as Chrome trace-event JSON —
-    save the body and open it in Perfetto / ``chrome://tracing``, or
-    let ``tdn trace`` do both. ``tracer`` overrides the process-wide
-    :data:`tpu_dist_nn.obs.trace.TRACER` (tests).
+    ``GET /trace?limit=N&trace_id=ID`` exports the process tracer's
+    completed spans (plus its slowest-trace exemplars) as Chrome
+    trace-event JSON — save the body and open it in Perfetto /
+    ``chrome://tracing``, or let ``tdn trace`` do both; ``trace_id``
+    pulls ONE trace (a slow exemplar named by a log line or trailing
+    metadata) without dumping the whole ring. ``tracer`` overrides the
+    process-wide :data:`tpu_dist_nn.obs.trace.TRACER` (tests).
+
+    ``GET /timeseries?family=F&window=S`` serves the attached
+    :class:`~tpu_dist_nn.obs.timeseries.TimeSeriesRing`'s recent
+    samples; ``GET /slo`` the attached
+    :class:`~tpu_dist_nn.obs.slo.SLOTracker`'s objective/burn-rate
+    status. Both 404 with a JSON reason until :meth:`attach` wires the
+    sources in (the endpoint binds BEFORE the sampler exists on the
+    serving bring-up path).
 
     ``GET /profile?window=S&top=N`` serves the per-stage self-time
     breakdown over the same tracer (``tdn profile`` pretty-prints it).
@@ -158,7 +251,7 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
                  registry: Registry | None = None, health_fn=None,
-                 tracer=None, routes=None):
+                 tracer=None, routes=None, timeseries=None, slo=None):
         reg = registry if registry is not None else REGISTRY
         outer = self
         # Extra GET routes, ``{path: fn(query) -> (status, content_type,
@@ -192,6 +285,12 @@ class MetricsServer:
                 elif path == "/profile":
                     status, body = outer._profile_body(query)
                     self._reply(status, "application/json", body)
+                elif path == "/timeseries":
+                    status, body = outer._timeseries_body(query)
+                    self._reply(status, "application/json", body)
+                elif path == "/slo":
+                    status, body = outer._slo_body(query)
+                    self._reply(status, "application/json", body)
                 elif path == "/debug/profile":
                     status, ctype, body = outer._debug_profile_body(query)
                     self._reply(status, ctype, body)
@@ -210,6 +309,8 @@ class MetricsServer:
 
         self._health_fn = health_fn
         self._tracer = tracer
+        self._timeseries = timeseries
+        self._slo = slo
         # One device capture at a time: jax.profiler.trace is a
         # process-global session — a second concurrent start raises
         # deep inside the profiler instead of returning a clean 409.
@@ -244,9 +345,20 @@ class MetricsServer:
 
         return TRACER
 
+    def attach(self, *, timeseries=None, slo=None) -> None:
+        """Late-bind the /timeseries ring and /slo tracker: the serving
+        bring-up binds this endpoint BEFORE the sampler (and the ring
+        it feeds) exists, so the routes 404 until attachment instead of
+        holding the port hostage to construction order."""
+        if timeseries is not None:
+            self._timeseries = timeseries
+        if slo is not None:
+            self._slo = slo
+
     def _trace_body(self, query: str):
         tracer = self._resolve_tracer()
         limit = None
+        trace_id = None
         for part in query.split("&"):
             k, _, v = part.partition("=")
             if k == "limit" and v:
@@ -254,7 +366,47 @@ class MetricsServer:
                     limit = int(v)
                 except ValueError:
                     return 400, b'{"error": "limit must be an integer"}\n'
-        return 200, tracer.render_json(limit).encode() + b"\n"
+            elif k == "trace_id" and v:
+                trace_id = v
+        return 200, tracer.render_json(
+            limit, trace_id=trace_id
+        ).encode() + b"\n"
+
+    def _timeseries_body(self, query: str):
+        ring = self._timeseries
+        if ring is None:
+            return 404, (b'{"error": "no time-series ring attached '
+                         b'(start a serving command with '
+                         b'--metrics-port)"}\n')
+        family = None
+        window = None
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if not v:
+                continue
+            if k == "family":
+                family = v
+            elif k == "window":
+                try:
+                    window = float(v)
+                except ValueError:
+                    return 400, (b'{"error": "window must be a number '
+                                 b'of seconds"}\n')
+        doc = {
+            "resolution_seconds": ring.resolution,
+            "retention_seconds": ring.retention,
+            "families": ring.families(),
+            "series": ring.series(family=family, window=window),
+        }
+        return 200, json.dumps(doc).encode() + b"\n"
+
+    def _slo_body(self, query: str):
+        tracker = self._slo
+        if tracker is None:
+            return 404, (b'{"error": "no SLO tracker attached (pass '
+                         b'--slo-latency-p99-ms / --slo-availability '
+                         b'on the serving command)"}\n')
+        return 200, json.dumps(tracker.status()).encode() + b"\n"
 
     def _profile_body(self, query: str):
         from tpu_dist_nn.obs.profile import profile_snapshot
@@ -346,9 +498,12 @@ class MetricsServer:
 
 def start_http_server(port: int = 0, host: str = "0.0.0.0", *,
                       registry: Registry | None = None,
-                      health_fn=None, routes=None) -> MetricsServer:
+                      health_fn=None, routes=None, timeseries=None,
+                      slo=None) -> MetricsServer:
     """Start the /metrics endpoint; returns the server (``.port`` holds
     the bound port when ``port=0`` picked an ephemeral one). ``routes``
-    mounts extra GET paths (see :class:`MetricsServer`)."""
+    mounts extra GET paths (see :class:`MetricsServer`);
+    ``timeseries``/``slo`` pre-attach the /timeseries and /slo sources
+    (or late-bind them with :meth:`MetricsServer.attach`)."""
     return MetricsServer(port, host, registry=registry, health_fn=health_fn,
-                         routes=routes)
+                         routes=routes, timeseries=timeseries, slo=slo)

@@ -38,6 +38,44 @@ DEFAULT_BUCKETS = (
 POW2_BUCKETS = tuple(float(1 << i) for i in range(17))  # 1 .. 65536
 
 
+def histogram_quantile(buckets, counts, q: float) -> float | None:
+    """Prometheus-style quantile estimate from bucketed counts.
+
+    ``buckets`` are the finite upper edges, ``counts`` the PER-BUCKET
+    (not cumulative) observation counts with one extra entry for the
+    implicit +Inf bucket — exactly a ``_Child``'s ``counts`` layout, and
+    what scrape-side cumulative ``le`` series differentiate back to.
+
+    Linear interpolation inside the containing bucket (lower edge 0 for
+    the first bucket — these are latency/row-count shaped families, all
+    non-negative); an estimate landing in the +Inf bucket clamps to the
+    highest finite edge, same as ``histogram_quantile()`` in PromQL.
+    Returns None when the histogram is empty. Shared by the SLO
+    evaluator, ``tdn top``, and the scrape-side helper in
+    :mod:`tpu_dist_nn.obs.exposition` so the estimate cannot drift
+    between the in-process and fleet views.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, n in enumerate(counts):
+        if n <= 0:
+            continue
+        if cum + n >= rank:
+            if i >= len(buckets):  # +Inf bucket: clamp to top edge
+                return float(buckets[-1]) if buckets else 0.0
+            lo = float(buckets[i - 1]) if i > 0 else 0.0
+            hi = float(buckets[i])
+            frac = (rank - cum) / n
+            return lo + (hi - lo) * max(0.0, min(1.0, frac))
+        cum += n
+    return float(buckets[-1]) if buckets else 0.0
+
+
 class _Child:
     """One labeled series. Value semantics depend on the family kind."""
 
@@ -82,6 +120,15 @@ class _Child:
             self.counts[i] += 1
             self.sum += v
             self.value += 1  # total count
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-interpolated quantile estimate of everything this
+        series has observed (None while empty); the error bound is the
+        containing bucket's width — see :func:`histogram_quantile`."""
+        self._expect("histogram")
+        with self._lock:
+            counts = list(self.counts)
+        return histogram_quantile(self._buckets, counts, q)
 
 
 class Metric:
@@ -183,6 +230,23 @@ class Metric:
 
     def observe(self, value: float) -> None:
         self._default().observe(value)
+
+    def quantile(self, q: float, **labels) -> float | None:
+        """Quantile estimate for one labeled series (the unlabeled one
+        when no labels are given) — does NOT create the child, so
+        probing a series that never observed returns None instead of
+        materializing an empty one."""
+        if self.kind != "histogram":
+            raise ValueError(f"quantile() not valid for a {self.kind}")
+        key = tuple(str(labels.get(ln)) for ln in self.labelnames)
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got "
+                f"{tuple(labels)}"
+            )
+        with self._lock:
+            child = self._children.get(key)
+        return child.quantile(q) if child is not None else None
 
     def samples(self):
         """-> [(label_values_tuple, child)] snapshot for exposition."""

@@ -145,6 +145,12 @@ class RuntimeSampler:
         # tick by DELTA at sample time, so the drop path itself stays a
         # plain int increment with no registry work.
         self._trace_dropped_seen: list[float] = []
+        # Fleet observability plane (ISSUE 9): time-series rings sample
+        # AFTER the gauges above are refreshed (so a ring tick sees
+        # this tick's state, not last tick's), and SLO trackers
+        # evaluate after the rings (their windows read ring deltas).
+        self._timeseries: list = []
+        self._slo_trackers: list = []
 
     # ------------------------------------------------------------ wiring
 
@@ -171,6 +177,16 @@ class RuntimeSampler:
     def add_tracer(self, tracer) -> None:
         self._tracers.append(tracer)
         self._trace_dropped_seen.append(float(tracer.dropped_total))
+
+    def add_timeseries(self, ring) -> None:
+        """Register a :class:`~tpu_dist_nn.obs.timeseries.TimeSeriesRing`
+        to snapshot once per tick (after the gauges refresh)."""
+        self._timeseries.append(ring)
+
+    def add_slo_tracker(self, tracker) -> None:
+        """Register an :class:`~tpu_dist_nn.obs.slo.SLOTracker` to
+        evaluate once per tick (after its ring collected)."""
+        self._slo_trackers.append(tracker)
 
     # ------------------------------------------------------------ loop
 
@@ -276,6 +292,10 @@ class RuntimeSampler:
         if rss is not None:
             self._g_rss.set(rss)
         self._sample_devices()
+        for ring in self._timeseries:
+            ring.collect()
+        for tracker in self._slo_trackers:
+            tracker.evaluate()
 
     def _sample_devices(self) -> None:
         try:

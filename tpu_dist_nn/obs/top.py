@@ -1,0 +1,328 @@
+"""``tdn top``: a live ANSI dashboard over a serving fleet.
+
+The fleet's health story is spread over four HTTP surfaces — /metrics
+(counters/gauges), /router/replicas (membership + breaker state), /slo
+(budget), /timeseries (history). ``tdn top`` polls them on an interval
+and renders the operator's one-screen view: per-replica rps, p50/p99,
+decode-slot occupancy, pending rows, breaker/health state, prefix-
+cache hit ratio, SLO budget remaining, and a sparkline of recent
+request rate per lane.
+
+Pointed at a ROUTER metrics endpoint it discovers the fleet via
+``/router/replicas`` and shows router + every replica; pointed at a
+single server's endpoint it shows that process alone. Rates and
+percentiles are BETWEEN-POLL deltas (the live view), not all-time
+aggregates: differencing two scrapes of cumulative ``le`` buckets
+yields the interval's distribution, fed through the same shared
+quantile estimator the server itself uses.
+
+Plain ANSI (clear + home + inverse header), not curses: renders
+anywhere a terminal escapes, degrades to a frame dump under
+``--no-color``/non-TTY, and stays unit-testable as a pure
+``render_frame``. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from tpu_dist_nn.obs.exposition import (
+    parse_prometheus_text,
+    parsed_histogram_quantile,
+    split_series,
+)
+
+SPARK_CHARS = " ▁▂▃▄▅▆▇█"
+
+CLEAR = "\x1b[2J\x1b[H"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RED = "\x1b[31m"
+YELLOW = "\x1b[33m"
+GREEN = "\x1b[32m"
+RESET = "\x1b[0m"
+
+
+def sparkline(values, width: int = 24) -> str:
+    """Values -> a fixed-width unicode sparkline (newest right;
+    all-equal series render mid-height, empty series render blank)."""
+    vals = list(values)[-width:]
+    if not vals:
+        return " " * width
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if span <= 0:
+            out.append(SPARK_CHARS[4] if hi > 0 else SPARK_CHARS[1])
+        else:
+            idx = 1 + int((v - lo) / span * (len(SPARK_CHARS) - 2))
+            out.append(SPARK_CHARS[min(idx, len(SPARK_CHARS) - 1)])
+    return "".join(out).rjust(width)
+
+
+def _get(base: str, path: str, timeout: float):
+    if "://" not in base:
+        base = f"http://{base}"
+    url = base.rstrip("/") + path
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _sum_family(parsed: dict, family: str, **match) -> float:
+    total = 0.0
+    for series, value in parsed.items():
+        s = str(series)
+        if s.startswith("__type__:"):
+            continue
+        name, labels = split_series(s)
+        if name != family:
+            continue
+        if any(labels.get(k) != str(v) for k, v in match.items()):
+            continue
+        total += float(value)
+    return total
+
+
+def _delta_parsed(prev: dict | None, cur: dict) -> dict:
+    """Pointwise series delta of two scrapes (cumulative families only
+    stay meaningful; the caller picks which families it reads).
+    Negative deltas (restart) clamp to the new value."""
+    if prev is None:
+        return dict(cur)
+    out = {}
+    for k, v in cur.items():
+        if str(k).startswith("__type__:"):
+            out[k] = v
+            continue
+        p = prev.get(k)
+        try:
+            d = float(v) - float(p) if p is not None else float(v)
+        except (TypeError, ValueError):
+            continue
+        out[k] = d if d >= 0 else float(v)
+    return out
+
+
+class FleetPoller:
+    """Polls the fleet's HTTP surfaces and computes per-source rows;
+    keeps the previous scrape per source for between-poll deltas."""
+
+    def __init__(self, target: str, timeout: float = 3.0):
+        self.target = target
+        self.timeout = timeout
+        self._prev: dict[str, tuple[float, dict]] = {}
+
+    def _sources(self) -> tuple[list[tuple[str, str, dict]], bool]:
+        """[(label, metrics_base, replica_snapshot)], fleet_mode."""
+        try:
+            snap = json.loads(
+                _get(self.target, "/router/replicas", self.timeout)
+            )
+            if isinstance(snap, list):
+                out = [("router", self.target, {})]
+                for rep in snap:
+                    out.append((
+                        f"replica {rep.get('target')}",
+                        rep.get("metrics_target") or "",
+                        rep,
+                    ))
+                return out, True
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        return [(self.target, self.target, {})], False
+
+    def _row(self, label: str, base: str, snap: dict, now: float) -> dict:
+        row: dict = {"source": label, "state": snap.get("state", "")}
+        if snap:
+            row["breaker"] = snap.get("breaker", "")
+            row["outstanding"] = snap.get("outstanding")
+        if not base:
+            row["error"] = "no metrics endpoint"
+            return row
+        try:
+            parsed = parse_prometheus_text(
+                _get(base, "/metrics", self.timeout).decode()
+            )
+        except (urllib.error.URLError, OSError) as e:
+            row["error"] = f"unreachable ({e})"
+            return row
+        prev = self._prev.get(label)
+        self._prev[label] = (now, parsed)
+        dt = now - prev[0] if prev else None
+        delta = _delta_parsed(prev[1] if prev else None, parsed)
+        is_router = label == "router"
+        req_family = ("tdn_router_requests_total" if is_router
+                      else "tdn_rpc_requests_total")
+        lat_family = ("tdn_router_request_seconds" if is_router
+                      else "tdn_batch_wait_seconds")
+        if dt and dt > 0:
+            row["rps"] = _sum_family(delta, req_family) / dt
+        for q, key in ((0.50, "p50_ms"), (0.99, "p99_ms")):
+            est = parsed_histogram_quantile(delta if dt else parsed,
+                                            lat_family, q)
+            row[key] = est * 1e3 if est is not None else None
+        row["pending"] = _sum_family(parsed, "tdn_batcher_pending_rows")
+        row["slots"] = _sum_family(parsed, "tdn_gen_slots_active")
+        row["occupancy"] = _sum_family(
+            parsed, "tdn_gen_slot_occupancy_ratio"
+        )
+        hits = _sum_family(parsed, "tdn_prefix_cache_hits_total")
+        misses = _sum_family(parsed, "tdn_prefix_cache_misses_total")
+        row["prefix_hit"] = hits / (hits + misses) if hits + misses else None
+        try:
+            ts = json.loads(_get(
+                base, f"/timeseries?family={req_family}&window=600",
+                self.timeout,
+            ))
+            by_t: dict[float, float] = {}
+            for key, pts in (ts.get("series") or {}).items():
+                if "_bucket" in key or "_sum" in key:
+                    continue
+                for t, v in pts:
+                    by_t[t] = by_t.get(t, 0.0) + v
+            seq = [by_t[t] for t in sorted(by_t)]
+            res = float(ts.get("resolution_seconds") or 1.0)
+            row["spark"] = [
+                max(b - a, 0.0) / res for a, b in zip(seq, seq[1:])
+            ]
+        except (urllib.error.URLError, OSError, ValueError):
+            row["spark"] = None
+        return row
+
+    def poll(self) -> dict:
+        now = time.monotonic()
+        sources, fleet = self._sources()
+        # Per-source fan-out in parallel: a couple of wedged replicas
+        # (each 2 serial GETs x timeout) must not stall the whole frame
+        # past --interval — the same rule ReplicaPool.scrape_once
+        # follows. Rows keep source order.
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, max(len(sources), 1)),
+            thread_name_prefix="tdn-top",
+        ) as ex:
+            rows = list(ex.map(
+                lambda s: self._row(s[0], s[1], s[2], now), sources
+            ))
+        slo = None
+        try:
+            doc = json.loads(_get(self.target, "/slo", self.timeout))
+            if isinstance(doc, dict) and doc.get("objectives") is not None:
+                slo = doc
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        return {"target": self.target, "fleet": fleet, "rows": rows,
+                "slo": slo, "at": time.time()}
+
+
+def _fmt(v, pattern="{:.1f}", dash="-") -> str:
+    if v is None:
+        return dash
+    return pattern.format(v)
+
+
+def render_frame(state: dict, color: bool = True) -> str:
+    """One dashboard frame as text (pure — the unit under test)."""
+    def c(code, s):
+        return f"{code}{s}{RESET}" if color else s
+
+    lines = []
+    mode = "fleet" if state.get("fleet") else "single"
+    lines.append(c(BOLD, (
+        f"tdn top — {state['target']} [{mode}]  "
+        f"{time.strftime('%H:%M:%S', time.localtime(state['at']))}"
+    )))
+    header = (
+        f"{'source':<28} {'state':<9} {'rps':>8} {'p50ms':>8} "
+        f"{'p99ms':>8} {'pend':>6} {'slots':>6} {'occ':>5} "
+        f"{'pfx%':>5}  {'rps trend':<24}"
+    )
+    lines.append(c(DIM, header))
+    for row in state.get("rows", ()):
+        if "error" in row:
+            lines.append(
+                f"{row['source']:<28} " + c(RED, row["error"])
+            )
+            continue
+        st = row.get("state") or "up"
+        breaker = row.get("breaker")
+        if breaker and breaker != "closed":
+            st = f"{st}/{breaker}"
+        st_col = GREEN if st in ("up", "active") else YELLOW
+        spark = sparkline(row["spark"]) if row.get("spark") else " " * 24
+        lines.append(
+            f"{row['source']:<28} " + c(st_col, f"{st:<9}")
+            + f" {_fmt(row.get('rps')):>8}"
+            + f" {_fmt(row.get('p50_ms'), '{:.2f}'):>8}"
+            + f" {_fmt(row.get('p99_ms'), '{:.2f}'):>8}"
+            + f" {_fmt(row.get('pending'), '{:.0f}'):>6}"
+            + f" {_fmt(row.get('slots'), '{:.0f}'):>6}"
+            + f" {_fmt(row.get('occupancy'), '{:.2f}'):>5}"
+            + f" {_fmt(row.get('prefix_hit') and row['prefix_hit'] * 100, '{:.0f}'):>5}"
+            + f"  {spark}"
+        )
+    slo = state.get("slo")
+    if slo and slo.get("objectives"):
+        lines.append("")
+        lines.append(c(DIM, (
+            f"{'SLO':<34} {'objective':<24} {'fast burn':>10} "
+            f"{'slow burn':>10} {'budget left':>12}"
+        )))
+        for obj in slo["objectives"]:
+            fast = obj["windows"]["fast"]["burn_rate"]
+            slow = obj["windows"]["slow"]["burn_rate"]
+            left = obj["error_budget_remaining"]
+            col = RED if obj.get("burning") else (
+                YELLOW if left < 0.25 else GREEN
+            )
+            lines.append(
+                f"{obj['name']:<34} {obj['objective']:<24} "
+                + c(col, f"{fast:>10.2f} {slow:>10.2f} {left:>11.0%}")
+            )
+    else:
+        lines.append("")
+        lines.append(c(DIM, "no SLOs declared (--slo-latency-p99-ms / "
+                           "--slo-availability on the serving command)"))
+    return "\n".join(lines)
+
+
+def run_top(target: str, *, interval: float = 2.0,
+            iterations: int | None = None, timeout: float = 3.0,
+            color: bool | None = None, out=None) -> int:
+    """The ``tdn top`` loop: poll, render, repeat until interrupted
+    (or for ``iterations`` frames — the testable/CI bound). Returns an
+    exit code; a completely unreachable target is a user error (2)."""
+    stream = out if out is not None else sys.stdout
+    use_color = color if color is not None else bool(
+        getattr(stream, "isatty", lambda: False)()
+    )
+    poller = FleetPoller(target, timeout=timeout)
+    frame = 0
+    try:
+        while True:
+            state = poller.poll()
+            if frame == 0 and all(
+                "error" in r for r in state["rows"]
+            ) and not state["fleet"]:
+                print(f"error: {state['rows'][0].get('error', 'unreachable')}"
+                      f" — is {target} a --metrics-port endpoint?",
+                      file=sys.stderr)
+                return 2
+            body = render_frame(state, color=use_color)
+            if use_color:
+                stream.write(CLEAR + body + "\n")
+            else:
+                stream.write(body + "\n" + "-" * 40 + "\n")
+            stream.flush()
+            frame += 1
+            if iterations is not None and frame >= iterations:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

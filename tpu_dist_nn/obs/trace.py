@@ -436,10 +436,14 @@ class Tracer:
 
     # ------------------------------------------------------------ export
 
-    def snapshot(self, limit: int | None = None) -> list[Span]:
+    def snapshot(self, limit: int | None = None,
+                 trace_id: str | None = None) -> list[Span]:
         """Completed spans, oldest first: the ring's last ``limit``
         spans (all when None) plus every exemplar-trace span not
-        already present."""
+        already present. ``trace_id`` keeps only that trace — the
+        "pull one slow exemplar without dumping the whole ring" path
+        (the filter applies AFTER the limit window, so an explicit id
+        is never crowded out of an unlimited pull by later traffic)."""
         with self._lock:
             spans = self._buf[self._head:] + self._buf[:self._head]
             if limit is not None and limit >= 0:
@@ -449,20 +453,25 @@ class Tracer:
                 s for _, _, tr in self._exemplars for s in tr
                 if id(s) not in seen
             ]
-        return extra + spans
+        out = extra + spans
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
 
     def buffer_len(self) -> int:
         with self._lock:
             return len(self._buf)
 
-    def chrome_trace(self, limit: int | None = None) -> dict:
+    def chrome_trace(self, limit: int | None = None,
+                     trace_id: str | None = None) -> dict:
         """The buffer as a Chrome trace-event JSON object —
         ``json.dump`` it and open in Perfetto / ``chrome://tracing``.
         Spans become complete (``ph: "X"``) events with epoch-anchored
         microsecond ``ts``, annotations become thread-scoped instant
         (``ph: "i"``) events, and thread names come along as metadata
-        so the serving pipeline's stages are labelled tracks."""
-        spans = self.snapshot(limit)
+        so the serving pipeline's stages are labelled tracks.
+        ``trace_id`` exports just that trace (``/trace?trace_id=``)."""
+        spans = self.snapshot(limit, trace_id=trace_id)
         events: list[dict] = []
         pid = os.getpid()
         threads: dict[int, str] = {}
@@ -499,8 +508,9 @@ class Tracer:
             })
         return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
-    def render_json(self, limit: int | None = None) -> str:
-        return json.dumps(self.chrome_trace(limit))
+    def render_json(self, limit: int | None = None,
+                    trace_id: str | None = None) -> str:
+        return json.dumps(self.chrome_trace(limit, trace_id=trace_id))
 
 
 # The process-wide tracer every built-in instrumentation site records

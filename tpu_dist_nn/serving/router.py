@@ -79,6 +79,13 @@ ROUTER_FAILOVERS = REGISTRY.counter(
     "attempts re-placed onto ANOTHER replica after a transient "
     "failure (the fleet absorbing a replica loss)",
 )
+ROUTER_LATENCY = REGISTRY.histogram(
+    "tdn_router_request_seconds",
+    "request wall time through the router, per method (placement + "
+    "every forward attempt + failover backoff; the latency-SLO family "
+    "for the fleet's front door)",
+    labels=("method",),
+)
 
 _CLIENT_DEFAULT = object()
 
@@ -111,10 +118,17 @@ class Router:
     def handle(self, method: str, payload: bytes, context) -> bytes:
         span, budget, md = _request_span(context, f"{method}")
         session = md.get(SESSION_HEADER)
+        t0 = time.monotonic()
         try:
             return self._route(method, payload, context, span, budget,
                                session)
         finally:
+            # Observed on EVERY outcome (abort raises through here):
+            # an SLO over this family must see the slow failures, not
+            # just the successes.
+            ROUTER_LATENCY.labels(method=method).observe(
+                time.monotonic() - t0
+            )
             span.end()
 
     def _abort(self, context, replica: str, code, message: str):
@@ -365,8 +379,12 @@ def router_health(pool: ReplicaPool):
 def admin_routes(pool: ReplicaPool) -> dict:
     """The rolling-restart admin surface, mounted on the router's
     metrics endpoint (:class:`~tpu_dist_nn.obs.exposition.MetricsServer`
-    ``routes=``): fleet introspection for ``tdn metrics --aggregate``
-    and the drain choreography for ``tdn router --drain-replica``."""
+    ``routes=``): fleet introspection for ``tdn metrics --aggregate``,
+    the drain choreography for ``tdn router --drain-replica``, and the
+    server-side stitched fleet trace (``GET /trace/fleet`` — the
+    router's own spans merged with every replica's ``/trace`` pull,
+    one lane per process; ``tdn trace --aggregate`` is the client-side
+    twin)."""
 
     def replicas(query: str):
         return 200, "application/json", (
@@ -400,8 +418,11 @@ def admin_routes(pool: ReplicaPool) -> dict:
             {"replica": target, "active": ok}
         ).encode() + b"\n"
 
+    from tpu_dist_nn.obs.collect import fleet_trace_route
+
     return {
         "/router/replicas": replicas,
         "/router/drain": drain,
         "/router/undrain": undrain,
+        "/trace/fleet": fleet_trace_route(pool),
     }
