@@ -806,6 +806,15 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
         print(f"# shared-prefix generate bench unavailable "
               f"({type(e).__name__}: {e})", file=sys.stderr)
         out["generate_prefix"] = None
+    # Codec-only A/B (ISSUE 10): the wire fast lane vs the legacy
+    # scalar path, embedded so a codec regression is attributable
+    # separately from the full-loopback serving numbers above.
+    try:
+        out["wire"] = wire_bench()
+    except Exception as e:  # noqa: BLE001 — must not cost the block
+        print(f"# wire codec bench unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        out["wire"] = None
     # Per-stage attribution of the numbers above (obs/profile over the
     # spans this bench just recorded): the round artifact then carries
     # WHERE the serving time went, and tools/bench_gate.py folds it
@@ -832,6 +841,117 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
         print(f"# serving profile attribution unavailable "
               f"({type(e).__name__}: {e})", file=sys.stderr)
     return out
+
+
+def wire_bench(shapes=((8, 784), (64, 784), (512, 784),
+                       (2048, 128), (256, 16)),
+               reps: int = 7, inner: int | None = None) -> dict:
+    """Codec-only A/B: encode+decode round-trip wall time, vectorized
+    fast lane vs the legacy scalar path, at several (N, D) shapes.
+
+    Pure host work (no jax, no sockets): this isolates the wire-format
+    cost the serving loopback numbers blend with everything else, so a
+    codec regression is attributable on its own. Each shape reports
+    rounds/s and MB/s for both paths plus the speedup ratio; min-of-
+    ``reps`` timing over ``inner`` round-trips per sample (inner sized
+    per shape so one sample is ~0.5-5 ms — above timer jitter, below
+    boredom). Embedded in round artifacts as ``serving.wire``; the
+    quick tier asserts vectorized >= scalar at every shape
+    (tests/test_wire_codec.py).
+
+    Shapes start at 8 rows: below that both paths are fixed-overhead
+    bound (~5 us either way, a coin flip in the noise), and the lane
+    that matters for single-row RPCs — probe + decode-into-staging,
+    which skips the standalone decode's output materialization — only
+    exists inside the serving path, where the loopback A/B measures
+    it (docs/PERF.md "Host data path").
+    """
+    from tpu_dist_nn.serving.wire import (
+        decode_matrix,
+        decode_matrix_scalar,
+        encode_matrix,
+        encode_matrix_scalar,
+    )
+
+    rng = np.random.default_rng(0)
+    out: dict = {"shapes": []}
+    worst = None
+    # Allocator warmup: a few round-trips at the LARGEST benched size
+    # first. Both arms allocate result buffers above glibc's initial
+    # mmap threshold; until the dynamic threshold adapts (it rises as
+    # mmap'd blocks are freed), every mid-size decode pays map/fault/
+    # unmap churn — measured 10-18x on the first pass over a shape and
+    # gone on the second. Warming with the biggest shape adapts the
+    # allocator once, so the timed samples measure the codec, not the
+    # first-touch page faults.
+    big = max(shapes, key=lambda s: s[0] * s[1])
+    xw = rng.normal(size=big)
+    for _ in range(3):
+        decode_matrix(encode_matrix(xw))
+        decode_matrix_scalar(encode_matrix_scalar(xw))
+    for n, d in shapes:
+        x = rng.normal(size=(n, d))
+        x32 = x.astype(np.float32)
+        wire_bytes = len(encode_matrix(x))
+        # Auto-size the inner loop: target ~1M payload bytes per timed
+        # sample for the fast path so tiny shapes aren't timing the
+        # perf counter. The SAME inner count times both arms.
+        k = inner if inner is not None else max(1, (1 << 20) // max(wire_bytes, 1))
+
+        def time_path(enc, dec, src):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.monotonic()
+                for _ in range(k):
+                    dec(enc(src))
+                best = min(best, time.monotonic() - t0)
+            return best / k  # seconds per encode+decode round
+
+        # Vectorized arm gets the engine-dtype (f32) input the serving
+        # path hands it; the scalar arm gets the float64 the old
+        # pipeline REQUIRED (np.asarray(x, f64) pre-cast was part of
+        # its cost, but charging it here would double-count — both
+        # arms measure codec-only work on their native input).
+        fast_s = time_path(encode_matrix, decode_matrix, x32)
+        scalar_s = time_path(encode_matrix_scalar, decode_matrix_scalar, x)
+        ratio = scalar_s / fast_s if fast_s > 0 else float("inf")
+        row = {
+            "shape": [n, d],
+            "wire_bytes": wire_bytes,
+            "vectorized_rounds_per_s": round(1.0 / fast_s, 1),
+            "scalar_rounds_per_s": round(1.0 / scalar_s, 1),
+            "vectorized_mb_per_s": round(wire_bytes / fast_s / 1e6, 1),
+            "scalar_mb_per_s": round(wire_bytes / scalar_s / 1e6, 1),
+            "speedup": round(ratio, 2),
+        }
+        out["shapes"].append(row)
+        if worst is None or ratio < worst:
+            worst = ratio
+    out["min_speedup"] = round(worst, 2) if worst is not None else None
+    out["method"] = (
+        "min-of-reps encode+decode round-trip, codec only (no RPC); "
+        "vectorized = one-buffer broadcast-header encode + structure-"
+        "probing strided decode, scalar = legacy per-row path"
+    )
+    return out
+
+
+def wire_main() -> int:
+    """``bench.py --wire``: the codec-only A/B as one JSON line. Pure
+    host work — no backend bring-up, so it runs anywhere in seconds."""
+    wb = wire_bench()
+    print(
+        json.dumps(
+            {
+                "metric": "wire codec encode+decode (vectorized vs scalar)",
+                "value": wb["min_speedup"],
+                "unit": "x speedup (worst benched shape)",
+                "host_calib_gflops": round(_host_calibration(), 2),
+                "wire": wb,
+            }
+        )
+    )
+    return 0
 
 
 class _PacedEngine:
@@ -1880,6 +2000,8 @@ def main() -> int:
 
 if __name__ == "__main__":
     try:
+        if "--wire" in sys.argv:
+            sys.exit(wire_main())
         if "--serving" in sys.argv:
             sys.exit(serving_main())
         if "--overlap" in sys.argv:

@@ -158,7 +158,10 @@ QUICK_TESTS = {
         # pair in enforce mode.
         "test_loopback_profile_process_shares_sum_to_wall",
         "test_bench_gate_report_only_on_checked_in_rounds",
-        "test_bench_gate_enforce_fails_synthetic_regression"],
+        "test_bench_gate_enforce_fails_synthetic_regression",
+        # ISSUE 10: best-of-history mode must fail the checked-in
+        # r02->r05 host-fed drift that pairwise diffing waved through.
+        "test_bench_gate_history_fails_checked_in_host_fed_drift"],
     "test_profiling": ["test_latency_stats_summary",
                        "test_annotate_inside_jit"],
     "test_quantized": ["test_weight_quantization_roundtrip_error_bounded",
@@ -188,6 +191,16 @@ QUICK_TESTS = {
     "test_tensor_parallel": ["test_forward_matches_single_chip[spec1]",
                              "test_shard_roundtrip"],
     "test_tpu_hardware": ["*"],
+    # ISSUE 10: the codec fast lane's correctness anchor (byte-exact
+    # scalar/vectorized equivalence + fuzz agreement), the decode-into-
+    # staging path through a real batcher, the codec A/B perf smoke,
+    # and the loopback fast-path counter check.
+    "test_wire_codec": [
+        "test_encode_vectorized_matches_scalar_bytes_exactly",
+        "test_decode_fuzz_fast_and_scalar_agree_on_mutated_bytes",
+        "test_batcher_stages_wire_matrices_straight_into_bucket_buffer",
+        "test_bench_wire_smoke_vectorized_beats_scalar",
+        "test_loopback_serving_round_trip_rides_fast_path"],
     "test_trace": ["test_chrome_trace_export_schema",
                    "test_loopback_round_trip_is_one_trace_tree",
                    "test_sampling_rate_edge_cases"],

@@ -402,8 +402,10 @@ def test_engine_single_cast_straight_to_engine_dtype():
     out64 = e.infer(x64)
     out32 = e.infer(x64.astype(np.float32))
     np.testing.assert_allclose(out64, out32, rtol=1e-6)
-    out, _mat, _launch = e._infer_impl(x64.astype(np.float32))
+    out, _mat, _launch, release = e._infer_impl(x64.astype(np.float32))
     assert out.dtype == jnp.float32
+    # Matching dtype means no staging buffer was drawn from the pool.
+    assert release is None
 
 
 def test_cli_warmup_verb_reports_warm_state(monkeypatch, capsys):

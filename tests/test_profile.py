@@ -695,3 +695,115 @@ def test_bench_gate_usage_errors_exit_two(tmp_path):
         capture_output=True, text=True,
     )
     assert proc.returncode == 2  # no rounds to discover
+
+
+# ------------------------------------------- best-of-history gate mode
+
+
+def test_bench_gate_history_fails_checked_in_host_fed_drift():
+    """The ISSUE-10 quick-tier smoke: r02->r05 host-fed drifted −3%/
+    round — under the pairwise 5% threshold every single time — and
+    compounded to −15% vs the r02 best. Best-of-history mode must fail
+    that trajectory on the CHECKED-IN rounds (r01's error record is
+    skipped, not fatal)."""
+    # --current is PINNED to r05: once a later (recovered) round is
+    # checked in, discovery would gate that instead and the drift this
+    # smoke exists to reproduce would vanish.
+    proc = subprocess.run(
+        [sys.executable, BENCH_GATE, "--history", "BENCH_r*.json",
+         "--dir", REPO_ROOT, "--json",
+         "--current", os.path.join(REPO_ROOT, "BENCH_r05.json")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["mode"] == "best-of-history"
+    assert "host_fed_samples_per_sec" in verdict["regressions"]
+    row = next(r for r in verdict["metrics"]
+               if r["metric"] == "host_fed_samples_per_sec")
+    # The bar is the r02 high-water mark, not the r04 predecessor.
+    assert row["best_round"] == "BENCH_r02.json"
+    assert row["regression"] > 0.10
+    # r01 (failed round, no payload) was skipped without killing the run.
+    assert "BENCH_r01.json" not in verdict["history_rounds"]
+    # Report-only still exits 0 on the same trajectory.
+    report = subprocess.run(
+        [sys.executable, BENCH_GATE, "--history", "BENCH_r*.json",
+         "--dir", REPO_ROOT, "--report-only",
+         "--current", os.path.join(REPO_ROOT, "BENCH_r05.json")],
+        capture_output=True, text=True,
+    )
+    assert report.returncode == 0, report.stdout + report.stderr
+
+
+def test_bench_gate_history_passes_flat_trajectory(tmp_path):
+    """A flat (or improving) trajectory with per-round jitter under
+    the threshold passes: best-of-history is a drift gate, not a
+    noise amplifier."""
+    for i, v in enumerate([100000.0, 99000.0, 101000.0, 99500.0], 1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps({"parsed": _round(v)})
+        )
+    proc = subprocess.run(
+        [sys.executable, BENCH_GATE, "--history", "BENCH_r*.json",
+         "--dir", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all gated metrics within threshold" in proc.stdout
+
+
+def test_bench_gate_history_compounding_drift_fails_where_pairwise_passes():
+    """The boiling-frog unit case: −3%/round for 5 rounds. Every
+    pairwise diff is green; best-of-history fails."""
+    gate = _load_bench_gate()
+    values = [100000.0]
+    for _ in range(4):
+        values.append(values[-1] * 0.97)
+    rounds = [(f"BENCH_r{i:02d}.json", _round(v))
+              for i, v in enumerate(values, 1)]
+    cur = rounds[-1][1]
+    # Pairwise: green.
+    pair = gate.compare(rounds[-2][1], cur)
+    assert pair["regressions"] == []
+    # Best-of-history: −11.5% vs r01's high-water mark — fails.
+    hist = gate.compare_history(rounds[:-1], cur)
+    assert "host_fed_samples_per_sec" in hist["regressions"]
+    row = next(r for r in hist["metrics"]
+               if r["metric"] == "host_fed_samples_per_sec")
+    assert row["best_round"] == "BENCH_r01.json"
+
+
+def test_bench_gate_history_skips_other_backend_rounds_per_round():
+    """History legitimately spans a backend flap: rounds from another
+    backend are excluded per-ROUND; only when NO same-backend history
+    exists does the whole gate skip."""
+    gate = _load_bench_gate()
+    history = [
+        ("BENCH_r01.json", _round(500000.0, backend="tpu v4")),
+        ("BENCH_r02.json", _round(100000.0, backend="cpu")),
+    ]
+    cur = _round(98000.0, backend="cpu")
+    v = gate.compare_history(history, cur)
+    assert v["history_rounds"] == ["BENCH_r02.json"]
+    assert v["regressions"] == []  # −2% vs the cpu best, tpu best ignored
+    all_tpu = [("BENCH_r01.json", _round(backend="tpu v4"))]
+    v = gate.compare_history(all_tpu, cur)
+    assert "skipped" in v and "backend" in v["skipped"]
+
+
+def test_bench_gate_history_lower_is_better_uses_min_as_best():
+    gate = _load_bench_gate()
+    history = [
+        ("BENCH_r01.json", _round(ttft=20.0)),
+        ("BENCH_r02.json", _round(ttft=10.0)),  # the TTFT high-water mark
+        ("BENCH_r03.json", _round(ttft=18.0)),
+    ]
+    v = gate.compare_history(history, _round(ttft=11.0))
+    assert "generate_ttft_p99_ms" in v["regressions"]
+    row = next(r for r in v["metrics"]
+               if r["metric"] == "generate_ttft_p99_ms")
+    assert row["best_round"] == "BENCH_r02.json"
+    # Matching the best passes.
+    v = gate.compare_history(history, _round(ttft=10.0))
+    assert v["regressions"] == []

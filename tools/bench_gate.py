@@ -42,6 +42,18 @@ Rules:
   the mode the quick tier runs against the checked-in r04->r05 pair
   (which carries a real ~10% serving_rps regression; the enforced gate
   exists so the NEXT one cannot land silently).
+* ``--history 'BENCH_r*.json'`` gates the current round against the
+  BEST historical value of each metric (same-backend rounds only)
+  instead of just the previous round. Pairwise diffing is blind to
+  slow drift: host-fed throughput lost ~3%/round across r02->r05 —
+  under the pairwise 5% threshold every single time — compounding to
+  −15% vs the r02 best. Best-of-history is the anti-boiling-frog
+  mode: each metric's high-water mark is the bar, so a trajectory of
+  individually-green regressions still fails. Invalid/failed rounds
+  (r01's error record) are skipped, as are rounds from other backends
+  (per-ROUND here, not whole-gate: history legitimately spans a
+  backend flap; only same-backend rounds say anything about the
+  current one).
 
 Exit codes: 0 pass/skip/report-only, 1 enforced regression, 2 usage.
 
@@ -51,6 +63,7 @@ Usage:
         --previous BENCH_r04.json
     python tools/bench_gate.py --threshold 0.05 --report-only
     python tools/bench_gate.py --profile http://host:9100/profile
+    python tools/bench_gate.py --history 'BENCH_r*.json'  # best-of-history
 """
 
 from __future__ import annotations
@@ -220,6 +233,117 @@ def compare(prev: dict, cur: dict,
             "threshold": threshold, "backend": cur_backend}
 
 
+def resolve_history(args) -> tuple[str, list[tuple[str, dict]]]:
+    """(current_path, [(name, parsed), ...]) for ``--history`` mode.
+
+    The glob expands relative to ``--dir``; the current round is
+    ``--current`` (or the highest-numbered match), history is every
+    OTHER lower-numbered valid round. A ``--current`` whose name does
+    not parse as a round number (a fresh un-numbered local run) is
+    gated against EVERY matched round — for a fresh run the whole
+    checked-in history IS the bar; a stderr note says so, since gating
+    an old commit's fresh bench against a glob holding newer rounds
+    would otherwise silently include the future. Rounds that fail to
+    load or carry no payload (a failed round's error record — r01)
+    are skipped with a stderr note, never fatal: the round after a
+    failure is exactly when the gate matters.
+    """
+    import glob as _glob
+
+    pattern = args.history
+    if not os.path.isabs(pattern) and os.path.dirname(pattern) == "":
+        pattern = os.path.join(args.dir, pattern)
+    matches = []
+    for p in _glob.glob(pattern):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            matches.append((int(m.group(1)), p))
+    matches.sort()
+    if not matches:
+        raise FileNotFoundError(f"no BENCH_r*.json match {pattern!r}")
+    if args.current:
+        cur_path = args.current
+        m = _ROUND_RE.search(os.path.basename(cur_path))
+        cur_round = int(m.group(1)) if m else None
+        if cur_round is None:
+            print(
+                f"# --current {cur_path!r} is not a numbered round; "
+                "gating it against EVERY round in the glob (make sure "
+                "none postdates the build under test)",
+                file=sys.stderr,
+            )
+    else:
+        cur_round, cur_path = matches[-1]
+    history = []
+    for n, p in matches:
+        if os.path.abspath(p) == os.path.abspath(cur_path):
+            continue
+        if cur_round is not None and n >= cur_round:
+            continue
+        try:
+            history.append((os.path.basename(p), load_round(p)))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"# skipping invalid round {p}: {e}", file=sys.stderr)
+    if not history:
+        raise FileNotFoundError(
+            f"no valid historical rounds behind {cur_path!r} in {pattern!r}"
+        )
+    return cur_path, history
+
+
+def compare_history(history: list[tuple[str, dict]], cur: dict,
+                    threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Best-of-history verdict: each gated metric regresses when the
+    current value is more than ``threshold`` past the BEST same-backend
+    historical value in its bad direction (max for higher-is-better,
+    min for lower-is-better). Compounding sub-threshold drift therefore
+    fails against the high-water mark even though every pairwise diff
+    stayed green. Metric rows carry ``best``/``best_round``."""
+    cur_backend = str(cur.get("backend"))
+    usable = [(name, doc) for name, doc in history
+              if str(doc.get("backend")) == cur_backend]
+    if not usable:
+        return {
+            "skipped": (
+                f"no historical rounds share the current backend "
+                f"({cur_backend!r}); cross-backend deltas are hardware "
+                "changes, not regressions"
+            ),
+        }
+    metrics = []
+    regressions = []
+    for label, path, direction in GATED_METRICS:
+        c = _dig(cur, path)
+        hist_vals = [(name, _dig(doc, path)) for name, doc in usable]
+        hist_vals = [(name, v) for name, v in hist_vals
+                     if v is not None and v > 0]
+        if c is None or not hist_vals:
+            metrics.append({
+                "metric": label,
+                "skipped": (
+                    "absent in current round" if c is None
+                    else "absent (or not positive) in every same-backend "
+                         "historical round"
+                ),
+            })
+            continue
+        pick = max if direction == "higher" else min
+        best_round, best = pick(hist_vals, key=lambda nv: nv[1])
+        reg = (best - c) / best if direction == "higher" else (c - best) / best
+        row = {
+            "metric": label, "previous": best, "current": c,
+            "best_round": best_round, "direction": direction,
+            "regression": round(reg, 4), "failed": reg > threshold,
+        }
+        metrics.append(row)
+        if row["failed"]:
+            regressions.append(label)
+    return {"metrics": metrics, "regressions": regressions,
+            "threshold": threshold, "backend": cur_backend,
+            "mode": "best-of-history",
+            "history_rounds": [name for name, _ in usable]}
+
+
 def load_profile(source: str | None) -> dict | None:
     """A /profile breakdown for attribution: an http(s) URL (a live
     ``--metrics-port`` endpoint), a JSON file path, or None. Fetch
@@ -277,9 +401,13 @@ def render_report(verdict: dict, cur_path: str, prev_path: str,
             continue
         arrow = "v" if row["regression"] > 0 else "^"
         mark = "FAIL" if row["failed"] else " ok "
+        best = (
+            f"  (best: {row['best_round']})" if row.get("best_round") else ""
+        )
         lines.append(
             f"  {mark} {row['metric']:<34} {row['previous']:>12.1f} -> "
             f"{row['current']:>12.1f}  {arrow}{abs(row['regression']) * 100:.1f}%"
+            f"{best}"
         )
     if verdict["regressions"]:
         lines.append(
@@ -308,6 +436,12 @@ def main(argv=None) -> int:
     ap.add_argument("--previous",
                     help="previous round (default: the current round's "
                          "recorded prev_bench.file, else next-lower round)")
+    ap.add_argument("--history", default=None, metavar="GLOB",
+                    help="gate the current round against the BEST same-"
+                         "backend historical value of each metric across "
+                         "every round matching GLOB (e.g. 'BENCH_r*.json'; "
+                         "relative patterns expand under --dir) — catches "
+                         "sub-threshold drift that compounds across rounds")
     ap.add_argument("--dir", default=".",
                     help="directory holding BENCH_r*.json (default .)")
     ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
@@ -327,12 +461,22 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     try:
-        cur_path, prev_path = resolve_pair(args)
-        cur, prev = load_round(cur_path), load_round(prev_path)
+        if args.history:
+            if args.previous:
+                print("error: --history and --previous are exclusive "
+                      "(best-of-history picks its own bar)", file=sys.stderr)
+                return 2
+            cur_path, history = resolve_history(args)
+            cur = load_round(cur_path)
+            verdict = compare_history(history, cur, args.threshold)
+            prev_path = f"best-of-{len(history)}-rounds"
+        else:
+            cur_path, prev_path = resolve_pair(args)
+            cur, prev = load_round(cur_path), load_round(prev_path)
+            verdict = compare(prev, cur, args.threshold)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    verdict = compare(prev, cur, args.threshold)
     # Attribution source priority: an explicit --profile (live /profile
     # endpoint or saved JSON), else the breakdown bench.py embeds in
     # the current round's serving section.

@@ -131,11 +131,15 @@ class PendingInference:
     the path-correct host read (``np.asarray`` for addressable arrays,
     the replicating collective for process-spanning ones). Pass to
     :meth:`Engine.fetch` — the fetch is the host sync, so everything
-    between dispatch and fetch overlaps with device execution."""
+    between dispatch and fetch overlaps with device execution.
+    ``release`` (when set) returns the launch's pooled host staging
+    buffer; fetch calls it once the device can no longer alias the
+    buffer (same discipline as the serving batcher's staging pool)."""
 
     value: object
     materialize: object
     t0: float
+    release: object = None
 
 
 @dataclasses.dataclass
@@ -235,6 +239,16 @@ class Engine:
         # ONCE, straight to this (the float64 wire contract stops at
         # the serving boundary).
         self._np_dtype = np.dtype(dtype)
+        # Reusable host staging buffers for the feed path, keyed by
+        # launch shape: a host-fed caller whose input needs a cast (or
+        # pad) lands it in a pooled buffer instead of a fresh alloc
+        # per batch. Buffers return to the pool at FETCH time
+        # (PendingInference.release) — a backend that zero-copy-aliases
+        # host memory into device buffers must never see one mutate
+        # mid-flight (the serving batcher's staging rule). Depth 2 per
+        # shape = the double-buffered steady state.
+        self._host_staging: dict[tuple, list[np.ndarray]] = {}
+        self._host_staging_keep = 2
         # Pow2 row buckets already compiled+executed by warm_buckets.
         self._warm_buckets: set[int] = set()
         # One automatic int8-payoff measurement per engine (warm_buckets
@@ -443,7 +457,7 @@ class Engine:
             hook = getattr(self, "launch_hook", None)
             if hook is not None:
                 hook(x)  # fault injection: may raise or delay
-            out, materialize, shape = self._infer_impl(x)
+            out, materialize, shape, release = self._infer_impl(x)
         except Exception:
             _INFER_ERRORS.inc()
             raise
@@ -474,7 +488,7 @@ class Engine:
                 # launch shape was new, so the request likely paid an
                 # XLA compile (hundreds of ms) nothing else explains.
                 _trace.annotate(f"engine.compile_cache_miss shape={shape}")
-        return PendingInference(out, materialize, t0)
+        return PendingInference(out, materialize, t0, release)
 
     def fetch(self, pending: PendingInference) -> np.ndarray:
         """Materialize an :meth:`infer_async` handle as host numpy —
@@ -488,6 +502,16 @@ class Engine:
         except Exception:
             _INFER_ERRORS.inc()
             raise
+        finally:
+            # Return the launch's pooled host staging buffer: after the
+            # materialize attempt the device result is (or will never
+            # be) realized, so the input buffer can no longer alias a
+            # mutating transfer. Cleared first — a double fetch must
+            # not double-free the buffer into the pool.
+            rel = getattr(pending, "release", None)
+            if rel is not None:
+                pending.release = None
+                rel()
         _INFER_SECONDS.observe(time.monotonic() - pending.t0)
         _INFER_ROWS.inc(len(out))
         if _trace.active():
@@ -638,6 +662,40 @@ class Engine:
         this — no device work, mirroring ``is_ready``)."""
         return len(self._warm_buckets)
 
+    def _host_buffer(self, shape) -> tuple[np.ndarray, object]:
+        """Pooled engine-dtype host staging buffer for a feed-path
+        launch shape, plus its return-to-pool callable.
+
+        The host-feed analogue of the batcher's per-bucket staging
+        pool: a caller whose input needs a cast (or shard pad) fills a
+        REUSED buffer instead of paying a fresh alloc per batch. The
+        release callable runs at fetch time (PendingInference.release)
+        — never earlier, so a backend that zero-copy-aliases host
+        memory into device buffers cannot see the buffer mutate under
+        an in-flight batch. getattr-guarded: hand-constructed engines
+        (tests build the single-chip path via ``Engine.__new__``) may
+        predate the pool slot."""
+        pool = getattr(self, "_host_staging", None)
+        if pool is None:
+            pool = self._host_staging = {}
+        bufs = pool.get(shape)
+        buf = None
+        if bufs:
+            try:
+                buf = bufs.pop()
+            except IndexError:  # concurrent infer callers raced the pop
+                buf = None
+        if buf is None:
+            buf = np.empty(shape, self._np_dtype)
+        keep = getattr(self, "_host_staging_keep", 2)
+
+        def release():
+            held = pool.setdefault(shape, [])
+            if len(held) < keep:
+                held.append(buf)
+
+        return buf, release
+
     def _infer_impl(self, x):
         from tpu_dist_nn.utils.errors import UnavailableError, check_input_dim
 
@@ -655,15 +713,23 @@ class Engine:
         # ONE cast, straight to the engine dtype (no float64 staging
         # array): the float64 wire contract lives at the serving
         # boundary only, and the dtype-aware decoder usually lands
-        # rows here already converted — this is then a no-op view.
+        # rows here already converted — this is then a no-op. When a
+        # cast IS needed (host-fed callers with f64/u8 inputs), it
+        # lands in a pooled staging buffer released at fetch, so the
+        # double-buffered feed loop recycles two buffers per shape
+        # instead of allocating per batch.
+        release = None
         if x.dtype != self._np_dtype:
-            x = x.astype(self._np_dtype)
+            buf, release = self._host_buffer((len(x), in_dim))
+            np.copyto(buf, x, casting="unsafe")
+            x = buf
         # The shape the device actually launches (the compile-cache
         # proxy key); branches that pad internally override it.
         launch = (len(x), in_dim)
         if self._hp is not None:
             mb = max(1, len(x) // self.num_microbatches)
-            return self._hp.forward(x, microbatch_size=mb), np.asarray, launch
+            return (self._hp.forward(x, microbatch_size=mb), np.asarray,
+                    launch, release)
         # The int8 serving paths are skipped entirely when the warmup
         # payoff measurement auto-disabled them (measured slower than
         # f32 on this backend; measure_int8_speedup).
@@ -682,7 +748,7 @@ class Engine:
                     num_virtual=self.virtual_stages,
                     num_microbatches=self.num_microbatches,
                 )
-                return out, to_host_numpy, launch
+                return out, to_host_numpy, launch, release
             if use_int8 and self._q_pp is not None:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_quantized,
@@ -692,7 +758,7 @@ class Engine:
                     self.mesh, self._q_pp, self._pp.meta, x,
                     num_microbatches=self.num_microbatches,
                 )
-                return out, to_host_numpy, launch
+                return out, to_host_numpy, launch, release
             if self.virtual_stages > 1:
                 from tpu_dist_nn.parallel.pipeline import (
                     pipeline_forward_interleaved,
@@ -703,11 +769,11 @@ class Engine:
                     num_virtual=self.virtual_stages,
                     num_microbatches=self.num_microbatches,
                 )
-                return out, to_host_numpy, launch
+                return out, to_host_numpy, launch, release
             out = pipeline_forward(
                 self.mesh, self._pp, x, num_microbatches=self.num_microbatches
             )
-            return out, to_host_numpy, launch
+            return out, to_host_numpy, launch, release
         if use_int8 and self._q is not None and not self.data_sharded:
             from tpu_dist_nn.kernels.quantized import fcnn_quantized_forward
 
@@ -718,6 +784,7 @@ class Engine:
                 ),
                 np.asarray,
                 launch,
+                release,
             )
         if use_int8 and self._q is not None:
             # Data-sharded int8: the jnp quantized chain under jit on the
@@ -735,7 +802,26 @@ class Engine:
 
             n = len(x)
             shards = self.mesh_spec.data
-            xb = np.pad(x, ((0, -n % shards), (0, 0)))
+            pad = -n % shards
+            if pad:
+                # Shard padding lands in a pooled staging buffer too
+                # (rows copied in, pad tail zeroed in place) — np.pad
+                # allocated a fresh padded matrix every batch. Chain
+                # the cast buffer's release when one is outstanding so
+                # both return to the pool at fetch.
+                xb, pad_release = self._host_buffer((n + pad, in_dim))
+                np.copyto(xb[:n], x, casting="unsafe")
+                xb[n:] = 0
+                if release is None:
+                    release = pad_release
+                else:
+                    cast_release = release
+
+                    def release(a=cast_release, b=pad_release):
+                        a()
+                        b()
+            else:
+                xb = x
             # jit sees the PADDED batch: that is the compiled shape.
             launch = (len(xb), in_dim)
             if jax.process_count() > 1:
@@ -754,8 +840,9 @@ class Engine:
                 xb = jax.device_put(xb, batch_sharding(self.mesh))
             # The [:n] slice is a lazy device op: the unpadded view
             # materializes at fetch, the launch stays padded.
-            return apply(self._params, xb)[:n], to_host_numpy, launch
-        return apply(self._params, jnp.asarray(x, self.dtype)), np.asarray, launch
+            return apply(self._params, xb)[:n], to_host_numpy, launch, release
+        return (apply(self._params, jnp.asarray(x, self.dtype)), np.asarray,
+                launch, release)
 
     def _quantized_apply(self):
         """Cached jitted (params, xb) -> logits closure over the int8
@@ -814,7 +901,17 @@ class Engine:
         num_classes: int | None = None,
     ) -> InferenceResult:
         """Whole-set or chunked-batch inference with accuracy + latency —
-        the reference client's main loop (run_grpc_inference.py:185-216)."""
+        the reference client's main loop (run_grpc_inference.py:185-216).
+
+        The chunked path is a double-buffered host-feed loop: batch
+        ``i+1`` is staged (pooled cast buffer) and LAUNCHED before
+        batch ``i``'s fetch pays the host sync, so the host->device
+        transfer of the next batch overlaps the previous batch's
+        compute — the same overlap the serving batcher's dispatch/drain
+        split buys, without a thread. Results and their order are
+        identical to the serial loop; ``batch_seconds[i]`` spans batch
+        i's dispatch to its materialized result.
+        """
         inputs = np.asarray(inputs)
         t0 = time.monotonic()
         outputs = []
@@ -824,10 +921,18 @@ class Engine:
             outputs.append(self.infer(inputs))
             batch_seconds.append(time.monotonic() - bt0)
         else:
+            pending = None
+            pt0 = 0.0
             for bx in batch_iterator(inputs, batch_size=batch_size):
                 bt0 = time.monotonic()
-                outputs.append(self.infer(bx))
-                batch_seconds.append(time.monotonic() - bt0)
+                nxt = self.infer_async(bx)
+                if pending is not None:
+                    outputs.append(self.fetch(pending))
+                    batch_seconds.append(time.monotonic() - pt0)
+                pending, pt0 = nxt, bt0
+            if pending is not None:
+                outputs.append(self.fetch(pending))
+                batch_seconds.append(time.monotonic() - pt0)
         outputs = np.concatenate(outputs)
         seconds = time.monotonic() - t0
         metrics = None

@@ -27,6 +27,9 @@ from tpu_dist_nn.serving.wire import (  # noqa: F401
     GENERATE_METHOD,
     PROCESS_METHOD,
     SESSION_HEADER,
+    WireMatrix,
     decode_matrix,
+    decode_matrix_into,
+    decode_matrix_lazy,
     encode_matrix,
 )

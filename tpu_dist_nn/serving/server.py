@@ -46,7 +46,9 @@ from tpu_dist_nn.serving.wire import (
     PROCESS_METHOD,
     SERVICE_NAME,
     SESSION_HEADER,
+    WireMatrix,
     decode_matrix,
+    decode_matrix_lazy,
     encode_matrix,
 )
 
@@ -301,9 +303,10 @@ class _Batcher:
         """
         n = sum(len(it["x"]) for it in group)
         n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
-        if len(group) == 1 and n == n_pad:
+        if (len(group) == 1 and n == n_pad
+                and not isinstance(group[0]["x"], WireMatrix)):
             return group[0]["x"], None, None
-        feat = group[0]["x"].shape[1:]
+        feat = tuple(group[0]["x"].shape[1:])
         dtype = group[0]["x"].dtype
         key = (n_pad, feat, str(dtype))
         pool = self._staging.get(key)
@@ -312,8 +315,16 @@ class _Batcher:
             buf = np.empty((n_pad, *feat), dtype)
         ofs = 0
         for it in group:
-            k = len(it["x"])
-            buf[ofs:ofs + k] = it["x"]
+            x = it["x"]
+            k = len(x)
+            if isinstance(x, WireMatrix):
+                # Decode-into-staging: the request's payload goes wire
+                # bytes -> this bucket buffer in ONE cast-copy (the
+                # handler only probed the structure; nothing was
+                # materialized in between).
+                x.read_into(buf, ofs)
+            else:
+                buf[ofs:ofs + k] = x
             ofs += k
         if ofs < n_pad:
             buf[ofs:] = 0  # zero the pad tail in place
@@ -681,8 +692,13 @@ def _make_handler(engine, batcher: _Batcher | None):
         span, budget, _md = _request_span(context, "Process")
         try:
             try:
+                # Structure probe only on the fast path: a WireMatrix
+                # carries shape/width for validation while the payload
+                # stays untouched until the batcher lands it directly
+                # in a staging buffer (one cast-copy end-to-end). The
+                # fallback (non-uniform layout) decodes fully here.
                 with _trace.TRACER.span("decode", span.ctx):
-                    x = decode_matrix(request_bytes, dtype=wire_dtype)
+                    x = decode_matrix_lazy(request_bytes, dtype=wire_dtype)
             except ValueError as e:
                 span.annotate(f"abort INVALID_ARGUMENT: bad Matrix: {e}")
                 _abort(context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
@@ -715,7 +731,11 @@ def _make_handler(engine, batcher: _Batcher | None):
                 span.annotate(f"error: {type(e).__name__}: {e}")
                 _abort_for_exception(context, e, "inference", "Process")
             with _trace.TRACER.span("encode", span.ctx):
-                return encode_matrix(np.asarray(out, np.float64))
+                # Engine-dtype result straight into the codec: the cast
+                # to wire float64 lands per-stripe in the encode buffer
+                # (the old np.asarray(out, np.float64) full-matrix
+                # materialization is gone).
+                return encode_matrix(out)
         finally:
             span.end()
 
@@ -844,7 +864,10 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
                 span.annotate(f"error: {type(e).__name__}: {e}")
                 _abort_for_exception(context, e, "generation", "Generate")
             with _trace.TRACER.span("encode", span.ctx):
-                return encode_matrix(np.asarray(out, np.float64))
+                # Token ids encode straight from the decoder's int32
+                # output — the per-stripe cast to wire float64 happens
+                # inside the codec's one preallocated buffer.
+                return encode_matrix(out)
         finally:
             span.end()
 
@@ -1351,9 +1374,11 @@ class GrpcClient:
 
     def process(self, x: np.ndarray,
                 session_key=_CLIENT_DEFAULT) -> np.ndarray:
+        # The codec owns the ONE cast to wire float64 (per-stripe into
+        # its output buffer) — pre-casting here would materialize a
+        # float64 copy just for encode_matrix to walk.
         reply = self._traced_call(
-            self._call, "Process",
-            encode_matrix(np.asarray(x, np.float64)),
+            self._call, "Process", encode_matrix(x),
             session_key=session_key,
         )
         return decode_matrix(reply)
@@ -1365,11 +1390,12 @@ class GrpcClient:
         as doubles — exact). ``session_key`` overrides the client-level
         key for this call (None = send no session header)."""
         reply = self._traced_call(
-            self._call_generate, "Generate",
-            encode_matrix(np.asarray(prompts, np.float64)),
+            self._call_generate, "Generate", encode_matrix(prompts),
             session_key=session_key,
         )
-        return decode_matrix(reply).astype(np.int64)
+        # Decode lands token ids straight in int64 — the wire doubles
+        # are exact for ids < 2^53, so the cast-on-decode is lossless.
+        return decode_matrix(reply, dtype=np.int64)
 
     def close(self) -> None:
         self._channel.close()
