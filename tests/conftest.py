@@ -112,6 +112,17 @@ QUICK_TESTS = {
         "test_two_process_loopback_stitched_trace"],
     "test_flash_attention": ["test_forward_matches_reference[32-False]",
                              "test_rejects_mismatched_shapes"],
+    "test_incident": [
+        # ISSUE 11 acceptance smokes: the loopback burn->bundle path,
+        # the 2-replica stitched fleet drill (+ tdn incident/debug
+        # CLI), both crash-path subprocess proofs, and the armed-vs-
+        # disarmed overhead A/B with its bench_gate contract.
+        "test_burn_detector_captures_bundle_with_faulted_span",
+        "test_fleet_drill_burn_trips_router_recorder_stitched_bundle",
+        "test_crash_unhandled_exception_leaves_valid_bundle",
+        "test_crash_sigabrt_leaves_valid_bundle_then_dies_by_signal",
+        "test_incident_overhead_smoke_armed_within_noise",
+        "test_bench_gate_incident_ratio_skip_and_fail"],
     "test_forward_parity": ["test_forward_matches_oracle_small",
                             "test_softmax_stability"],
     "test_generate": ["test_greedy_generation_matches_teacher_forced_oracle",

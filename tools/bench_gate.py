@@ -106,6 +106,12 @@ GATED_METRICS = (
      ("serving", "slo", "latency", "measured_p99_ms"), "lower"),
     ("slo_availability",
      ("serving", "slo", "availability", "measured"), "higher"),
+    # Flight-recorder overhead (ISSUE 11): armed/disarmed serving rps
+    # ratio with no detector firing — must stay ~1.0 (capture is free
+    # until it fires). Absent in pre-ISSUE-11 rounds -> per-metric
+    # skip.
+    ("incident_armed_ratio",
+     ("serving", "incident_overhead", "ratio"), "higher"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -26,6 +26,14 @@
 ``tdn top``    — live fleet dashboard (obs/top.py): per-replica rps,
                  percentiles, slots, breaker state, SLO budget, and
                  sparklines over a router (or single-server) endpoint
+``tdn incident``— browse the flight recorder's anomaly/crash-triggered
+                 diagnostic bundles (obs/incident.py): ls | show ID |
+                 pull ID against a --metrics-port endpoint started
+                 with --incident-dir
+``tdn debug``  — on-demand diagnostic capture (``tdn debug bundle``):
+                 GET /debug/bundle and save the zip; against a router
+                 the capture spans the whole fleet with the traces
+                 stitched
 """
 
 from __future__ import annotations
@@ -317,6 +325,109 @@ def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
     return ring, tracker
 
 
+def _add_incident_args(p) -> None:
+    """The flight-recorder flags shared by every serving verb
+    (up/lm/router): an incident directory arms the detectors
+    (docs/OBSERVABILITY.md 'Incidents & flight recorder')."""
+    p.add_argument("--incident-dir", default=None, metavar="DIR",
+                   help="arm the flight recorder: anomaly detectors "
+                        "(SLO fast burn, error/shed spikes, breaker "
+                        "opens, drain/failover on a router) run on the "
+                        "runtime-sampler tick and snapshot a diagnostic "
+                        "bundle zip (trace ring, /profile, /timeseries "
+                        "window, log ring, /slo, /metrics, manifest) "
+                        "into DIR on trigger; crashes (unhandled "
+                        "exception, SIGABRT) capture too. Costs the "
+                        "request path nothing until a detector fires. "
+                        "Needs --metrics-port (the detectors ride the "
+                        "sampler)")
+    p.add_argument("--incident-max", type=int, default=20, metavar="N",
+                   help="keep at most N incident bundles in "
+                        "--incident-dir; the oldest are pruned "
+                        "(default 20)")
+    p.add_argument("--incident-cooldown", type=float, default=300.0,
+                   metavar="SECONDS",
+                   help="minimum spacing between captures of the SAME "
+                        "detector (default 300); an ongoing incident "
+                        "re-captures after the cooldown, a flapping "
+                        "one cannot fill the store")
+
+
+def _validate_incident_flags(args, needs: str | None = None) -> None:
+    """Fail bad flight-recorder flags BEFORE engine bring-up (the
+    file's fail-fast convention). ``needs`` names the serving flag the
+    recorder rides on for this command (the _validate_slo_flags
+    contract) — without it the flags would be silently inert."""
+    if getattr(args, "incident_max", 20) < 1:
+        raise ValueError(
+            f"--incident-max must be >= 1, got {args.incident_max}"
+        )
+    if getattr(args, "incident_cooldown", 300.0) <= 0:
+        raise ValueError(
+            f"--incident-cooldown must be > 0, got "
+            f"{args.incident_cooldown}"
+        )
+    if getattr(args, "incident_dir", None) is None:
+        return
+    if getattr(args, "metrics_port", None) is None:
+        raise ValueError(
+            "--incident-dir needs --metrics-port: the detectors ride "
+            "the runtime sampler and the bundles are served from "
+            "GET /incidents there"
+        )
+    if needs is not None and getattr(args, needs.replace("-", "_"),
+                                     None) is None:
+        raise ValueError(
+            f"--incident-dir needs --{needs} on this command (no "
+            "serving path, nothing to record)"
+        )
+
+
+def _wire_incident_recorder(args, metrics_server, sampler, ring, tracker,
+                            *, pool=None, router=False):
+    """Attach the flight recorder to one serving command: mounts the
+    incident surface (/incidents, /incidents/get, and — on a router —
+    the fleet-capturing /debug/bundle) on the metrics endpoint, and,
+    when ``--incident-dir`` armed it, registers the detector pass on
+    the sampler tick plus the crash hooks. Returns the recorder (or
+    None without a metrics endpoint)."""
+    if metrics_server is None or sampler is None:
+        return None
+    from tpu_dist_nn.obs.incident import (
+        FlightRecorder,
+        IncidentStore,
+        default_detectors,
+        incident_routes,
+        install_crash_hook,
+    )
+
+    store = None
+    detectors = ()
+    if getattr(args, "incident_dir", None):
+        store = IncidentStore(args.incident_dir,
+                              max_incidents=args.incident_max)
+        detectors = default_detectors(router=router)
+    recorder = FlightRecorder(
+        store, detectors=detectors, ring=ring, slo=tracker, pool=pool,
+        cooldown=getattr(args, "incident_cooldown", 300.0),
+    )
+    # The surface mounts even disarmed: /debug/bundle on-demand capture
+    # (fleet-wide on a router) costs nothing at rest, and /incidents
+    # 404s with the --incident-dir hint.
+    metrics_server.add_routes(incident_routes(recorder))
+    if store is not None:
+        sampler.add_incident_recorder(recorder)
+        install_crash_hook(recorder)
+        print(json.dumps({
+            "incident_dir": store.directory,
+            "incident_max": store.max_incidents,
+            "incident_detectors": [
+                getattr(d, "name", type(d).__name__) for d in detectors
+            ],
+        }), flush=True)
+    return recorder
+
+
 def _apply_trace_sample_rate(args) -> None:
     """Configure the process tracer's head-sampling rate from
     ``--trace-sample-rate`` (fail-fast: an out-of-range rate is a user
@@ -398,6 +509,7 @@ def _serve_loop(engine, max_seconds: float | None = None, teardown=None,
 def cmd_up(args) -> int:
     _apply_trace_sample_rate(args)
     _validate_slo_flags(args, needs="grpc-port")
+    _validate_incident_flags(args, needs="grpc-port")
     if args.grpc_port is not None and _jax_process_count() > 1:
         # Before engine bring-up: minutes of pod warmup for a flag
         # combination knowable up front.
@@ -460,7 +572,7 @@ def cmd_up(args) -> int:
             sampler.add_tracer(TRACER)
             # Fleet observability plane: /timeseries history + (with
             # --slo-* flags) burn-rate tracking over the Process path.
-            _wire_fleet_obs(
+            ring, tracker = _wire_fleet_obs(
                 args, metrics_server, sampler,
                 latency_family="tdn_batch_wait_seconds",
                 latency_match={"method": "Process"},
@@ -469,6 +581,11 @@ def cmd_up(args) -> int:
                     "bad_family": "tdn_rpc_errors_total",
                 },
             )
+            # Flight recorder (ISSUE 11): detectors on the sampler
+            # tick, bundles into --incident-dir, /debug/bundle +
+            # /incidents on the endpoint.
+            _wire_incident_recorder(args, metrics_server, sampler,
+                                    ring, tracker)
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
 
@@ -657,6 +774,7 @@ def cmd_router(args) -> int:
     # ----- serve mode: bring up the pool + the front door.
     _apply_trace_sample_rate(args)
     _validate_slo_flags(args)
+    _validate_incident_flags(args)
     targets = _parse_targets(args.replicas)
     if not targets and not args.spawn:
         raise ValueError(
@@ -751,7 +869,7 @@ def cmd_router(args) -> int:
             # Fleet observability plane: the router's own latency SLO
             # rides tdn_router_request_seconds; availability counts
             # every non-ok outcome against the budget.
-            _wire_fleet_obs(
+            ring, tracker = _wire_fleet_obs(
                 args, metrics_server, sampler,
                 latency_family="tdn_router_request_seconds",
                 availability_kwargs={
@@ -759,6 +877,12 @@ def cmd_router(args) -> int:
                     "bad_exclude": {"outcome": "ok"},
                 },
             )
+            # Flight recorder, fleet flavor: on trigger the router
+            # fans /debug/bundle out to every replica within the tick
+            # and stitches the fleet trace into ONE incident.
+            _wire_incident_recorder(args, metrics_server, sampler,
+                                    ring, tracker, pool=pool,
+                                    router=True)
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
         try:
@@ -969,6 +1093,7 @@ def cmd_lm(args) -> int:
 
     _apply_trace_sample_rate(args)
     _validate_slo_flags(args, needs="serve-generate")
+    _validate_incident_flags(args, needs="serve-generate")
     moe = args.experts > 0
     # (MoE x --seq-parallel is rejected below with the other
     # seq-parallel compatibility checks, with or without --stages.)
@@ -1890,7 +2015,7 @@ def cmd_lm(args) -> int:
             # Fleet observability plane for the generation endpoint:
             # the latency SLO covers submit -> retirement (the wire
             # figure a client sees), availability the Generate aborts.
-            _wire_fleet_obs(
+            ring, tracker = _wire_fleet_obs(
                 args, metrics_server, sampler,
                 latency_family="tdn_batch_wait_seconds",
                 latency_match={"method": "Generate"},
@@ -1899,6 +2024,10 @@ def cmd_lm(args) -> int:
                     "bad_family": "tdn_rpc_errors_total",
                 },
             )
+            # Flight recorder over the generation endpoint: a burn,
+            # shed storm, or crash mid-decode leaves its bundle.
+            _wire_incident_recorder(args, metrics_server, sampler,
+                                    ring, tracker)
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
         print(json.dumps(report), flush=True)
@@ -2007,6 +2136,11 @@ def cmd_metrics(args) -> int:
             "--profile rides the fleet fan-out: pass --aggregate too "
             "(for one process, use `tdn profile --target ...`)"
         )
+    if getattr(args, "timeseries", None) and not args.aggregate:
+        raise ValueError(
+            "--timeseries rides the fleet fan-out: pass --aggregate "
+            "too (for one process, curl GET /timeseries?family=...)"
+        )
     if args.aggregate and getattr(args, "profile", False):
         # Fleet-wide /profile: per-stage self time merged across the
         # router (its router.forward lane included) and every replica —
@@ -2069,6 +2203,37 @@ def cmd_metrics(args) -> int:
             for source in sorted(agg["gauges"][s]):
                 print(f"[gauge] {s} @{source} = "
                       f"{agg['gauges'][s][source]:g}")
+        # Fleet SLO verdict (ISSUE 11 satellite): /slo fanned out and
+        # merged — burn rates recomputed from summed bad/total, never
+        # averaged per process. Silent skip when no process declared
+        # an objective (the common static-fleet shape).
+        try:
+            from tpu_dist_nn.obs.collect import collect_fleet_slo
+
+            slo = collect_fleet_slo(base, timeout=args.timeout)
+        except ValueError:
+            slo = None
+        if slo and slo.get("objectives"):
+            print("fleet SLO (merged from "
+                  + ", ".join(sorted({
+                      s for o in slo["objectives"]
+                      for s in o.get("sources", ())
+                  })) + "):")
+            for obj in slo["objectives"]:
+                fast = obj["windows"].get("fast", {})
+                slow = obj["windows"].get("slow", {})
+                print(f"[slo] {obj['name']}: {obj.get('objective', '')} "
+                      f"fast_burn={fast.get('burn_rate', 0):g} "
+                      f"slow_burn={slow.get('burn_rate', 0):g} "
+                      f"budget_left={obj['error_budget_remaining']:g}"
+                      + (" BURNING" if obj.get("burning") else ""))
+        if getattr(args, "timeseries", None):
+            from tpu_dist_nn.obs.collect import collect_fleet_timeseries
+
+            ts = collect_fleet_timeseries(
+                base, family=args.timeseries, timeout=args.timeout
+            )
+            print(json.dumps(ts))
         return 0
     if args.raw:
         print(text, end="")
@@ -2132,6 +2297,15 @@ def cmd_trace(args) -> int:
     whole ring) in either mode."""
     base = _endpoint_base(args.target)
     if args.aggregate:
+        if getattr(args, "since", None) is not None:
+            # The stitcher pulls whole rings per process and carries no
+            # per-source cursor — a silently ignored --since would look
+            # like an active incremental poll (fail-fast convention).
+            raise ValueError(
+                "--since is a single-endpoint incremental cursor and "
+                "does not combine with --aggregate (the fleet stitch "
+                "pulls every process's ring)"
+            )
         from tpu_dist_nn.obs.collect import collect_fleet_trace
 
         doc = collect_fleet_trace(
@@ -2167,6 +2341,8 @@ def cmd_trace(args) -> int:
         params.append(f"limit={args.limit}")
     if args.trace_id is not None:
         params.append(f"trace_id={args.trace_id}")
+    if getattr(args, "since", None) is not None:
+        params.append(f"since={args.since}")
     if params:
         path += "?" + "&".join(params)
     body = _endpoint_get(base, path, args.timeout)
@@ -2214,6 +2390,9 @@ def cmd_trace(args) -> int:
             for r in by_self
         ],
         "slowest_ranked_by": "self_time",
+        # Pass back as --since on the next poll: only spans that
+        # finished after this cursor come down the wire.
+        "cursor": doc.get("cursor"),
         "open_with": "https://ui.perfetto.dev or chrome://tracing",
     }))
     return 0
@@ -2287,6 +2466,122 @@ def cmd_profile(args) -> int:
             "open_with": "unzip, then tensorboard --logdir <dir> or "
                          "ui.perfetto.dev",
         }))
+    return 0
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 90:
+        return f"{seconds:.0f}s"
+    if seconds < 5400:
+        return f"{seconds / 60:.0f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def cmd_incident(args) -> int:
+    """Browse a serving endpoint's flight-recorder store (``tdn
+    incident ls|show|pull --target host:metrics-port``): list captured
+    incident bundles, print one bundle's manifest, or download the
+    zip for offline digging (its trace.json opens in Perfetto, its
+    logs/timeseries/slo sections are plain JSON)."""
+    import urllib.parse
+
+    base = _endpoint_base(args.target)
+    if args.action == "ls":
+        doc = json.loads(_endpoint_get(base, "/incidents", args.timeout))
+        incidents = doc.get("incidents", [])
+        print(f"{len(incidents)} incident(s) in {doc.get('directory')} "
+              f"(max {doc.get('max_incidents')}, "
+              f"{doc.get('captured_total', 0)} captured this boot)")
+        now = time.time()
+        for m in incidents:
+            if "error" in m and "trigger" not in m:
+                print(f"  {m.get('incident_id', '?'):<44} {m['error']}")
+                continue
+            age = _fmt_age(max(now - float(m.get("captured_at", now)), 0))
+            size = int(m.get("bytes", 0))
+            reason = str(m.get("reason", ""))[:60]
+            print(f"  {m.get('incident_id', '?'):<44} "
+                  f"{m.get('trigger', '?'):<22} {age:>5} ago "
+                  f"{size / 1024:>7.1f}KB  {reason}")
+        return 0
+    if not args.id:
+        raise ValueError(
+            f"tdn incident {args.action} needs an incident id "
+            "(see `tdn incident ls`)"
+        )
+    if args.action == "show":
+        doc = json.loads(_endpoint_get(base, "/incidents", args.timeout))
+        for m in doc.get("incidents", []):
+            if m.get("incident_id") == args.id:
+                print(json.dumps(m, indent=2))
+                return 0
+        raise ValueError(f"no incident {args.id!r} on {base} "
+                         "(see `tdn incident ls`)")
+    # pull
+    data = _endpoint_get(
+        base, "/incidents/get?id=" + urllib.parse.quote(args.id, safe=""),
+        args.timeout,
+    )
+    if not data.startswith(b"PK"):
+        raise ValueError(
+            f"{base}/incidents/get did not return a bundle zip: "
+            f"{data[:200].decode(errors='replace')}"
+        )
+    out = args.out or f"{args.id}.zip"
+    with open(out, "wb") as f:
+        f.write(data)
+    print(json.dumps({
+        "out": out, "incident_id": args.id, "bytes": len(data),
+        "open_with": "unzip; trace.json loads in ui.perfetto.dev",
+    }))
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Manual diagnostic capture (``tdn debug bundle --target
+    host:metrics-port``): GET /debug/bundle on a running endpoint —
+    against a router this captures the WHOLE fleet (every replica's
+    bundle embedded, traces stitched) — and save the zip locally.
+    The on-demand twin of the detector-triggered captures."""
+    import io as _io
+    import urllib.parse
+    import zipfile as _zipfile
+
+    # argparse fixes args.what to "bundle" today; the positional keeps
+    # the verb extensible (tdn debug <what>) without a breaking rename.
+    base = _endpoint_base(args.target)
+    params = []
+    if args.no_fleet:
+        params.append("fleet=0")
+    if args.reason:
+        params.append("reason=" + urllib.parse.quote(args.reason, safe=""))
+    path = "/debug/bundle" + ("?" + "&".join(params) if params else "")
+    # The HTTP wait covers the capture itself (a router fans out to
+    # every replica within its fleet timeout) — give it headroom.
+    data = _endpoint_get(base, path, args.timeout + 30.0)
+    if not data.startswith(b"PK"):
+        raise ValueError(
+            f"{base}{path} did not return a bundle zip: "
+            f"{data[:200].decode(errors='replace')}"
+        )
+    with open(args.out, "wb") as f:
+        f.write(data)
+    summary = {"out": args.out, "bytes": len(data)}
+    try:
+        with _zipfile.ZipFile(_io.BytesIO(data)) as z:
+            manifest = json.loads(z.read("manifest.json"))
+        summary["incident_id"] = manifest.get("incident_id")
+        summary["sections"] = manifest.get("sections")
+        replicas = manifest.get("replicas")
+        if replicas is not None:
+            summary["replicas"] = [
+                {k: r[k] for k in ("target", "error") if k in r}
+                for r in replicas
+            ]
+    except (KeyError, ValueError, _zipfile.BadZipFile):
+        summary["warning"] = "bundle has no readable manifest.json"
+    summary["open_with"] = "unzip; trace.json loads in ui.perfetto.dev"
+    print(json.dumps(summary))
     return 0
 
 
@@ -2682,6 +2977,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 disables recording entirely (env: "
                         "TDN_TRACE_SAMPLE_RATE)")
     _add_slo_args(p)
+    _add_incident_args(p)
     p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("infer", help="run inference (client)")
@@ -2765,6 +3061,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="head-sampling rate for router request tracing "
                         "in [0, 1]")
     _add_slo_args(p)
+    _add_incident_args(p)
     p.add_argument("--admin", metavar="HOST:PORT",
                    help="admin-client mode: a RUNNING router's metrics "
                         "endpoint to drive (--drain-replica / "
@@ -3066,6 +3363,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "loop, per-request spans under "
                         "--serve-generate)")
     _add_slo_args(p)
+    _add_incident_args(p)
     p.set_defaults(fn=cmd_lm)
 
     p = sub.add_parser("doctor",
@@ -3145,6 +3443,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "replicas (router.forward lane included) — "
                         "'where does fleet time go' as one table "
                         "(--raw dumps the merged JSON)")
+    p.add_argument("--timeseries", default=None, metavar="FAMILY",
+                   help="with --aggregate: also fan /timeseries out "
+                        "over the fleet for FAMILY and dump the "
+                        "merged per-source series as JSON")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_metrics)
@@ -3171,6 +3473,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pull only this trace (the id a log line, "
                         "x-tdn-trace-id trailer, or /slo exemplar "
                         "named) instead of the whole ring")
+    p.add_argument("--since", type=int, default=None, metavar="CURSOR",
+                   help="incremental pull: only spans that finished "
+                        "after this cursor (the 'cursor' value the "
+                        "previous pull printed) — pollers stop "
+                        "re-downloading the whole ring every tick")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_trace)
@@ -3221,6 +3528,47 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=5.0,
                    help="HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser(
+        "incident",
+        help="browse a serving endpoint's flight-recorder store: "
+             "anomaly/crash-triggered diagnostic bundles "
+             "(docs/OBSERVABILITY.md 'Incidents & flight recorder')")
+    p.add_argument("action", choices=["ls", "show", "pull"],
+                   help="ls = list captured bundles; show ID = print "
+                        "one manifest; pull ID = download the zip")
+    p.add_argument("id", nargs="?", default=None,
+                   help="incident id (from `tdn incident ls`)")
+    p.add_argument("--target", required=True,
+                   help="host:port of a running --metrics-port "
+                        "endpoint started with --incident-dir")
+    p.add_argument("-o", "--out", default=None,
+                   help="pull: output path (default <id>.zip)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="HTTP timeout in seconds (default 5)")
+    p.set_defaults(fn=cmd_incident)
+
+    p = sub.add_parser(
+        "debug",
+        help="on-demand diagnostic capture from a running endpoint "
+             "(tdn debug bundle --target ...; a router captures the "
+             "whole fleet and stitches the trace)")
+    p.add_argument("what", choices=["bundle"],
+                   help="bundle = GET /debug/bundle and save the zip")
+    p.add_argument("--target", required=True,
+                   help="host:port of a running --metrics-port "
+                        "endpoint (a router's for fleet capture)")
+    p.add_argument("-o", "--out", default="bundle.zip",
+                   help="output path (default bundle.zip)")
+    p.add_argument("--reason", default=None,
+                   help="free-text reason recorded in the manifest")
+    p.add_argument("--no-fleet", action="store_true",
+                   help="against a router: capture the router process "
+                        "only, skip the replica fan-out")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="HTTP timeout in seconds (default 10; the "
+                        "request itself gets +30s for the capture)")
+    p.set_defaults(fn=cmd_debug)
 
     return parser
 

@@ -47,6 +47,14 @@ metrics layer the serving/training hot paths publish into:
   - :mod:`tpu_dist_nn.obs.top` — the ``tdn top`` live ANSI dashboard
     over a router fleet or single server (rps, percentiles, slots,
     breaker state, SLO budget, sparklines).
+  - :mod:`tpu_dist_nn.obs.incident` — the flight recorder: detectors
+    on the sampler tick (SLO fast burn, error/shed spikes, breaker
+    opens, drain/failover) plus crash hooks, each trigger freezing a
+    diagnostic bundle (trace ring + profile + timeseries window + log
+    ring + /slo + /metrics + manifest) into a bounded on-disk incident
+    store; on a router the capture fans out to every replica and
+    stitches the fleet trace. ``GET /debug/bundle``, ``GET
+    /incidents``, ``tdn incident``, ``tdn debug bundle``.
 
 Every metric this framework publishes is prefixed ``tdn_``; the
 catalog lives in ``docs/OBSERVABILITY.md``. All updates are plain
@@ -87,9 +95,19 @@ from tpu_dist_nn.obs.profile import (  # noqa: F401
     profile_snapshot,
 )
 from tpu_dist_nn.obs.log import (  # noqa: F401
+    LOG_RING,
     JsonFormatter,
+    LogRing,
     get_logger,
     setup_json_logging,
+)
+from tpu_dist_nn.obs.incident import (  # noqa: F401
+    FlightRecorder,
+    IncidentStore,
+    capture_bundle,
+    default_detectors,
+    incident_routes,
+    install_crash_hook,
 )
 
 __all__ = [
@@ -117,4 +135,12 @@ __all__ = [
     "get_logger",
     "setup_json_logging",
     "JsonFormatter",
+    "LogRing",
+    "LOG_RING",
+    "FlightRecorder",
+    "IncidentStore",
+    "capture_bundle",
+    "default_detectors",
+    "incident_routes",
+    "install_crash_hook",
 ]

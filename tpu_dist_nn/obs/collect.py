@@ -387,3 +387,168 @@ def collect_fleet_profile(router_target: str, *, timeout: float = 5.0,
     merged = merge_profiles(docs, top=top)
     merged["unreachable"] = unreachable
     return merged
+
+
+# ---------------------------------------------------------- SLO merging
+
+
+def merge_slo(docs_by_source: dict[str, dict]) -> dict:
+    """Fold per-process ``/slo`` documents into one fleet verdict.
+
+    Objectives group by name. Per window, ``bad`` and ``total`` are
+    EVENT COUNTS over the same wall-clock window on every process, so
+    they sum exactly — the fleet burn rate recomputes from the summed
+    fraction rather than averaging per-process rates (a busy replica
+    burning hard must outweigh an idle one coasting). Measured
+    availability recomputes the same way; a latency objective's
+    measured quantile takes the FLEET-WORST source (quantiles do not
+    merge from summaries — the rule is named in ``merged_estimates``,
+    the profile-merge convention)."""
+    objectives: dict[str, dict] = {}
+    order: list[str] = []
+    fast_s = slow_s = None
+    for source, doc in docs_by_source.items():
+        if not isinstance(doc, dict):
+            continue
+        fast_s = fast_s or doc.get("fast_window_seconds")
+        slow_s = slow_s or doc.get("slow_window_seconds")
+        for obj in doc.get("objectives", ()):
+            name = obj.get("name")
+            if name is None:
+                continue
+            agg = objectives.get(name)
+            if agg is None:
+                agg = objectives[name] = {
+                    "describe": {
+                        k: v for k, v in obj.items() if k not in (
+                            "windows", "error_budget_remaining", "burning",
+                        )
+                    },
+                    "budget_fraction": float(
+                        obj.get("budget_fraction") or 0.0
+                    ),
+                    "windows": {},
+                    "sources": [],
+                }
+                order.append(name)
+            agg["sources"].append(source)
+            for label, win in (obj.get("windows") or {}).items():
+                w = agg["windows"].setdefault(label, {
+                    "seconds": win.get("seconds"),
+                    "bad": 0.0, "total": 0.0, "worst_quantile_ms": None,
+                })
+                w["bad"] += float(win.get("bad") or 0.0)
+                w["total"] += float(win.get("total") or 0.0)
+                q = win.get("measured_quantile_ms")
+                if q is not None:
+                    w["worst_quantile_ms"] = (
+                        q if w["worst_quantile_ms"] is None
+                        else max(w["worst_quantile_ms"], q)
+                    )
+    out = []
+    for name in order:
+        agg = objectives[name]
+        budget = agg["budget_fraction"]
+        windows = {}
+        for label, w in agg["windows"].items():
+            bad_frac = (w["bad"] / w["total"]) if w["total"] > 0 else 0.0
+            burn = bad_frac / budget if budget > 0 else 0.0
+            win_doc = {
+                "seconds": w["seconds"],
+                "bad": round(w["bad"], 3),
+                "total": round(w["total"], 3),
+                "bad_fraction": round(bad_frac, 6),
+                "burn_rate": round(burn, 4),
+            }
+            if agg["describe"].get("kind") == "latency":
+                win_doc["measured_quantile_ms"] = w["worst_quantile_ms"]
+            else:
+                win_doc["measured_availability"] = (
+                    round(1.0 - bad_frac, 6) if w["total"] > 0 else None
+                )
+            windows[label] = win_doc
+        slow_burn = (windows.get("slow") or {}).get("burn_rate", 0.0)
+        fast = windows.get("fast") or {}
+        out.append({
+            **agg["describe"],
+            "windows": windows,
+            "error_budget_remaining": round(
+                max(0.0, 1.0 - slow_burn), 4
+            ),
+            "burning": (fast.get("burn_rate", 0.0) > 1.0
+                        and fast.get("total", 0.0) > 0),
+            "sources": sorted(agg["sources"]),
+        })
+    return {
+        "fleet": True,
+        "fast_window_seconds": fast_s,
+        "slow_window_seconds": slow_s,
+        "objectives": out,
+        "merged_estimates": {
+            "burn_rate": "recomputed from summed bad/total",
+            "measured_quantile_ms": "fleet-worst source",
+        },
+    }
+
+
+def collect_fleet_slo(router_target: str, *,
+                      timeout: float = 5.0) -> dict:
+    """Fan ``GET /slo`` out over router + replicas and merge (the
+    ``tdn metrics --aggregate`` / ``tdn top`` fleet-SLO core). A
+    source without a tracker attached (404) lands in ``unreachable``
+    with its reason — declaring the SLO on only the router is the
+    common shape and must not fail the whole view."""
+    docs, unreachable = _collect_sources(router_target, "/slo", timeout)
+    merged = merge_slo(docs)
+    merged["unreachable"] = unreachable
+    return merged
+
+
+# --------------------------------------------------- timeseries merging
+
+
+def merge_timeseries(docs_by_source: dict[str, dict]) -> dict:
+    """Fold per-process ``/timeseries`` documents into one fleet view.
+
+    Series stay NAMESPACED per source (``{series: {source: points}}``)
+    — cumulative counters from different processes can be summed by a
+    consumer that wants fleet totals, but collapsing them here would
+    hide which replica moved, the exact question a fleet view answers
+    (the ``--aggregate`` gauges-stay-per-source rule)."""
+    families: set[str] = set()
+    series: dict[str, dict[str, list]] = {}
+    resolution = None
+    for source, doc in docs_by_source.items():
+        if not isinstance(doc, dict):
+            continue
+        families.update(doc.get("families") or ())
+        if resolution is None:
+            resolution = doc.get("resolution_seconds")
+        for key, pts in (doc.get("series") or {}).items():
+            series.setdefault(key, {})[source] = pts
+    return {
+        "fleet": True,
+        "resolution_seconds": resolution,
+        "families": sorted(families),
+        "series": series,
+        "sources": sorted(
+            s for s, d in docs_by_source.items() if isinstance(d, dict)
+        ),
+    }
+
+
+def collect_fleet_timeseries(router_target: str, *,
+                             family: str | None = None,
+                             window: float | None = None,
+                             timeout: float = 5.0) -> dict:
+    """Fan ``GET /timeseries`` out over router + replicas and merge."""
+    params = []
+    if family is not None:
+        params.append(f"family={family}")
+    if window is not None:
+        params.append(f"window={window}")
+    path = "/timeseries" + ("?" + "&".join(params) if params else "")
+    docs, unreachable = _collect_sources(router_target, path, timeout)
+    merged = merge_timeseries(docs)
+    merged["unreachable"] = unreachable
+    return merged

@@ -215,7 +215,13 @@ def parsed_histogram_quantile(parsed: dict, family: str, q: float,
 
 class MetricsServer:
     """The /metrics + /healthz + /trace + /profile + /timeseries +
-    /slo endpoint on a daemon thread.
+    /slo + /logs + /debug/bundle endpoint on a daemon thread.
+
+    ``GET /logs?window=S&level=L&limit=N`` serves the process log ring
+    (:data:`tpu_dist_nn.obs.log.LOG_RING`); ``GET /debug/bundle``
+    captures an on-demand diagnostic bundle zip (trace ring, profile,
+    timeseries window, SLO state, log ring, /metrics text + manifest —
+    :mod:`tpu_dist_nn.obs.incident`).
 
     ``health_fn`` is polled per /healthz request (``Engine.health`` in
     the serving wiring); omit it for processes with no engine — the
@@ -282,6 +288,12 @@ class MetricsServer:
                 elif path == "/trace":
                     status, body = outer._trace_body(query)
                     self._reply(status, "application/json", body)
+                elif path == "/logs":
+                    status, body = outer._logs_body(query)
+                    self._reply(status, "application/json", body)
+                elif path == "/debug/bundle":
+                    status, ctype, body = outer._debug_bundle_body(query)
+                    self._reply(status, ctype, body)
                 elif path == "/profile":
                     status, body = outer._profile_body(query)
                     self._reply(status, "application/json", body)
@@ -307,6 +319,7 @@ class MetricsServer:
             def log_message(self, fmt, *args):  # scrapes are not news
                 log.debug("metrics http: " + fmt, *args)
 
+        self._registry = reg
         self._health_fn = health_fn
         self._tracer = tracer
         self._timeseries = timeseries
@@ -355,10 +368,20 @@ class MetricsServer:
         if slo is not None:
             self._slo = slo
 
+    def add_routes(self, routes: dict) -> None:
+        """Late-mount extra GET routes (same shape as ``routes=``):
+        the incident recorder's ``/incidents`` + fleet
+        ``/debug/bundle`` bind here AFTER the serving bring-up built
+        the recorder — the same construction-order seam as
+        :meth:`attach`. Later mounts win (a router's fleet-capturing
+        ``/debug/bundle`` overrides the built-in local one)."""
+        self._routes.update(routes)
+
     def _trace_body(self, query: str):
         tracer = self._resolve_tracer()
         limit = None
         trace_id = None
+        since = None
         for part in query.split("&"):
             k, _, v = part.partition("=")
             if k == "limit" and v:
@@ -368,9 +391,73 @@ class MetricsServer:
                     return 400, b'{"error": "limit must be an integer"}\n'
             elif k == "trace_id" and v:
                 trace_id = v
+            elif k == "since" and v:
+                # Monotonic cursor: only spans recorded AFTER sequence
+                # number N (the previous reply's "cursor"), so a poller
+                # stops re-downloading the whole ring every tick.
+                try:
+                    since = int(v)
+                except ValueError:
+                    return 400, b'{"error": "since must be an integer"}\n'
         return 200, tracer.render_json(
-            limit, trace_id=trace_id
+            limit, trace_id=trace_id, since=since
         ).encode() + b"\n"
+
+    def _logs_body(self, query: str):
+        from tpu_dist_nn.obs.log import LOG_RING
+
+        window = None
+        level = None
+        limit = None
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if not v:
+                continue
+            try:
+                if k == "window":
+                    window = float(v)
+                elif k == "limit":
+                    limit = int(v)
+                elif k == "level":
+                    level = v
+            except ValueError:
+                return 400, (b'{"error": "window must be a number of '
+                             b'seconds, limit an integer"}\n')
+        try:
+            records = LOG_RING.snapshot(window=window, level=level,
+                                        limit=limit)
+        except ValueError as e:
+            return 400, json.dumps({"error": str(e)}).encode() + b"\n"
+        return 200, json.dumps({
+            "capacity": LOG_RING.capacity,
+            "dropped_total": LOG_RING.dropped_total,
+            "records": records,
+        }, default=repr).encode() + b"\n"
+
+    def _debug_bundle_body(self, query: str):
+        """Process-local on-demand diagnostic bundle: the stock route
+        every ``--metrics-port`` endpoint serves (a router's recorder
+        overrides it via :meth:`add_routes` with the fleet version).
+        Captures whatever is attached to THIS endpoint — tracer,
+        timeseries ring, SLO tracker, the log ring, /metrics text."""
+        import urllib.parse
+
+        from tpu_dist_nn.obs.incident import capture_bundle
+
+        q = urllib.parse.parse_qs(query)
+        reason = (q.get("reason") or ["on-demand capture"])[0]
+        try:
+            _iid, data = capture_bundle(
+                "manual", reason,
+                tracer=self._resolve_tracer(), registry=self._registry,
+                ring=self._timeseries, slo=self._slo,
+            )
+        except Exception as e:  # noqa: BLE001 — degrade, never traceback
+            log.warning("debug bundle capture failed: %r", e)
+            return (500, "application/json", json.dumps(
+                {"error": repr(e)}
+            ).encode() + b"\n")
+        return 200, "application/zip", data
 
     def _timeseries_body(self, query: str):
         ring = self._timeseries

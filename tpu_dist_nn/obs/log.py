@@ -18,6 +18,12 @@ structured channel the serving/engine modules log through:
   and the next emitted record carries ``suppressed=N`` so the gap is
   visible instead of silent. Events that fire once (startup) are never
   affected.
+* **Bounded in-memory ring.** Every record that passes the limiter
+  also lands in the process-wide :data:`LOG_RING` (default 4096
+  records), so the recent log tail is queryable AFTER the fact —
+  ``GET /logs?window=S&level=L`` on the metrics endpoint, and the
+  flight recorder (:mod:`tpu_dist_nn.obs.incident`) freezes it into
+  every diagnostic bundle. stderr never leaves the box; the ring does.
 * **Readable either way.** Through the default CLI handler a record
   renders ``event key=value ...``; with :func:`setup_json_logging`
   (``tdn --log-json`` / ``TDN_LOG_JSON=1``) the same record renders as
@@ -115,6 +121,77 @@ class _TokenBucket:
             return False, 0
 
 
+class LogRing:
+    """Bounded ring of structured log records (plain dicts), the
+    queryable tail behind ``GET /logs`` and the incident bundles.
+
+    Appends sit BEHIND the rate limiter (a storm costs the ring its
+    ``rate``-per-second trickle, not one append per suppressed call)
+    and are one dict build + deque append under a lock — cheap enough
+    for every emitted record. ``dropped_total`` counts ring evictions
+    so "the window you wanted is gone" is visible, the tracer-ring
+    convention."""
+
+    __slots__ = ("_buf", "_lock", "capacity", "dropped_total")
+
+    def __init__(self, capacity: int = 4096):
+        import collections
+
+        self.capacity = int(capacity)
+        self._buf: "collections.deque" = collections.deque(
+            maxlen=self.capacity
+        )
+        self._lock = threading.Lock()
+        self.dropped_total = 0
+
+    def append(self, record: dict) -> None:
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self.dropped_total += 1
+            self._buf.append(record)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def snapshot(self, window: float | None = None,
+                 level: str | None = None,
+                 limit: int | None = None) -> list[dict]:
+        """Records oldest-first; ``window`` keeps the last S seconds,
+        ``level`` is a MINIMUM severity name (``warning`` returns
+        warnings and errors), ``limit`` keeps the newest N after the
+        filters."""
+        cutoff = None if window is None else time.time() - float(window)
+        floor = None
+        if level is not None:
+            floor = logging.getLevelName(str(level).upper())
+            if not isinstance(floor, int):
+                raise ValueError(f"unknown log level {level!r}")
+        with self._lock:
+            records = list(self._buf)
+        out = [
+            r for r in records
+            if (cutoff is None or r.get("ts", 0.0) >= cutoff)
+            and (floor is None
+                 or logging.getLevelName(
+                     str(r.get("level", "info")).upper()
+                 ) >= floor)
+        ]
+        if limit is not None and limit >= 0:
+            out = out[-limit:] if limit else []
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.dropped_total = 0
+
+
+# The process-wide ring every StructuredLogger feeds (mirrors REGISTRY
+# and TRACER: one per process, served by the metrics endpoint).
+LOG_RING = LogRing()
+
+
 class StructuredLogger:
     """Event-shaped logging facade over one stdlib logger.
 
@@ -143,6 +220,13 @@ class StructuredLogger:
             fields.setdefault("span_id", ids[1])
         if suppressed:
             fields["suppressed"] = suppressed
+        LOG_RING.append({
+            "ts": time.time(),
+            "level": logging.getLevelName(level).lower(),
+            "logger": self._logger.name,
+            "event": event,
+            "fields": dict(fields),
+        })
         msg = event + "".join(
             f" {k}={self._render(v)}" for k, v in fields.items()
         )

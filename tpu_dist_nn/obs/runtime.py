@@ -151,6 +151,10 @@ class RuntimeSampler:
         # evaluate after the rings (their windows read ring deltas).
         self._timeseries: list = []
         self._slo_trackers: list = []
+        # Flight recorders (ISSUE 11) check their detectors LAST in a
+        # tick: the rings have collected and the SLO trackers have
+        # evaluated, so a detector sees this tick's state.
+        self._incident_recorders: list = []
 
     # ------------------------------------------------------------ wiring
 
@@ -187,6 +191,14 @@ class RuntimeSampler:
         """Register an :class:`~tpu_dist_nn.obs.slo.SLOTracker` to
         evaluate once per tick (after its ring collected)."""
         self._slo_trackers.append(tracker)
+
+    def add_incident_recorder(self, recorder) -> None:
+        """Register a :class:`~tpu_dist_nn.obs.incident.FlightRecorder`
+        whose detectors run once per tick, after the rings collected
+        and the SLO trackers evaluated — arming the recorder adds ONE
+        host-side detector pass per tick to this daemon thread and
+        nothing to any request path."""
+        self._incident_recorders.append(recorder)
 
     # ------------------------------------------------------------ loop
 
@@ -296,6 +308,11 @@ class RuntimeSampler:
             ring.collect()
         for tracker in self._slo_trackers:
             tracker.evaluate()
+        for recorder in self._incident_recorders:
+            # check() contains its own per-detector/per-capture guards;
+            # anything escaping still only costs this tick (the
+            # _safe_sample wrapper), never the serving path.
+            recorder.check()
 
     def _sample_devices(self) -> None:
         try:

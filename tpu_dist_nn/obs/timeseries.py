@@ -188,6 +188,18 @@ class TimeSeriesRing:
         with self._lock:
             return sorted(set(self._family_of.values()))
 
+    def keys(self, family: str | None = None) -> list[str]:
+        """Series KEYS only (``family`` filters like :meth:`series`) —
+        for consumers that enumerate then :meth:`delta` per key (the
+        incident spike detectors): materializing every point list just
+        to read the dict keys would allocate the whole retained window
+        per tick."""
+        with self._lock:
+            return [
+                k for k in self._data
+                if family is None or self._family_of[k] == family
+            ]
+
     def series(self, family: str | None = None,
                window: float | None = None,
                now: float | None = None) -> dict[str, list]:

@@ -210,15 +210,54 @@ class FleetPoller:
             rows = list(ex.map(
                 lambda s: self._row(s[0], s[1], s[2], now), sources
             ))
-        slo = None
-        try:
-            doc = json.loads(_get(self.target, "/slo", self.timeout))
-            if isinstance(doc, dict) and doc.get("objectives") is not None:
-                slo = doc
-        except (urllib.error.URLError, OSError, ValueError):
-            pass
+        slo = self._poll_slo(sources, fleet)
         return {"target": self.target, "fleet": fleet, "rows": rows,
                 "slo": slo, "at": time.time()}
+
+    def _poll_slo(self, sources, fleet: bool):
+        """The SLO table's source: single endpoint -> that process's
+        /slo verbatim; fleet -> every source's /slo folded through
+        :func:`~tpu_dist_nn.obs.collect.merge_slo` (the same merge
+        ``tdn metrics --aggregate`` reports), so a burn on a REPLICA
+        that declared its own objective pages on the router's
+        dashboard too. Sources without a tracker (404) just drop out.
+        The fleet fetch fans out in parallel — the same wedged-replica
+        rule as the row fan-out: a couple of dead endpoints must not
+        stall every frame by a timeout apiece."""
+        import concurrent.futures
+
+        def fetch(src):
+            label, base, _snap = src
+            if not base:
+                return None
+            try:
+                doc = json.loads(_get(base, "/slo", self.timeout))
+            except (urllib.error.URLError, OSError, ValueError):
+                return None
+            if isinstance(doc, dict) and doc.get("objectives"):
+                return label, doc
+            return None
+
+        docs: dict[str, dict] = {}
+        if fleet:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(16, max(len(sources), 1)),
+                thread_name_prefix="tdn-top-slo",
+            ) as ex:
+                for hit in ex.map(fetch, sources):
+                    if hit is not None:
+                        docs[hit[0]] = hit[1]
+        else:
+            hit = fetch(sources[0]) if sources else None
+            if hit is not None:
+                docs[hit[0]] = hit[1]
+        if not docs:
+            return None
+        if not fleet:
+            return next(iter(docs.values()))
+        from tpu_dist_nn.obs.collect import merge_slo
+
+        return merge_slo(docs)
 
 
 def _fmt(v, pattern="{:.1f}", dash="-") -> str:

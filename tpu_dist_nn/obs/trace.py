@@ -116,7 +116,7 @@ class Span:
 
     __slots__ = ("_tracer", "name", "trace_id", "span_id", "parent_id",
                  "parent_remote", "t0", "dur", "tid", "tname", "attrs",
-                 "annotations", "_ended")
+                 "annotations", "_ended", "seq")
 
     def __init__(self, tracer, name, trace_id, span_id, parent_id,
                  parent_remote, t0, attrs=None):
@@ -134,6 +134,9 @@ class Span:
         self.attrs = dict(attrs) if attrs else {}
         self.annotations: list[tuple[float, str]] = []
         self._ended = False
+        # Completion sequence number, assigned by the tracer at finish
+        # time: the /trace?since= cursor (0 = not yet finished).
+        self.seq = 0
 
     @property
     def sampled(self) -> bool:
@@ -304,6 +307,11 @@ class Tracer:
         # wire-joined handler must not burn two slots on one trace).
         self._exemplars: list[tuple[float, str, list[Span]]] = []
         self.dropped_total = 0
+        # Monotonic completion counter: every finished span gets the
+        # next value, and /trace?since=N returns only spans with
+        # seq > N — an incremental poller re-downloads nothing. Never
+        # reset (a cursor must stay monotonic for the process life).
+        self.seq = 0
 
     # ------------------------------------------------------------ config
 
@@ -381,6 +389,8 @@ class Tracer:
     def _finish(self, span: Span) -> None:
         buf_copy = None
         with self._lock:
+            self.seq += 1
+            span.seq = self.seq
             if len(self._buf) < self._capacity:
                 self._buf.append(span)
             else:
@@ -437,13 +447,18 @@ class Tracer:
     # ------------------------------------------------------------ export
 
     def snapshot(self, limit: int | None = None,
-                 trace_id: str | None = None) -> list[Span]:
+                 trace_id: str | None = None,
+                 since: int | None = None) -> list[Span]:
         """Completed spans, oldest first: the ring's last ``limit``
         spans (all when None) plus every exemplar-trace span not
         already present. ``trace_id`` keeps only that trace — the
         "pull one slow exemplar without dumping the whole ring" path
         (the filter applies AFTER the limit window, so an explicit id
-        is never crowded out of an unlimited pull by later traffic)."""
+        is never crowded out of an unlimited pull by later traffic).
+        ``since`` keeps only spans that FINISHED after that cursor
+        value (:attr:`seq`) — the incremental-poll form; exemplar
+        extras obey it too, so a poller is never re-sent the same
+        slow trace every tick."""
         with self._lock:
             spans = self._buf[self._head:] + self._buf[:self._head]
             if limit is not None and limit >= 0:
@@ -456,6 +471,8 @@ class Tracer:
         out = extra + spans
         if trace_id is not None:
             out = [s for s in out if s.trace_id == trace_id]
+        if since is not None:
+            out = [s for s in out if s.seq > since]
         return out
 
     def buffer_len(self) -> int:
@@ -463,15 +480,25 @@ class Tracer:
             return len(self._buf)
 
     def chrome_trace(self, limit: int | None = None,
-                     trace_id: str | None = None) -> dict:
+                     trace_id: str | None = None,
+                     since: int | None = None) -> dict:
         """The buffer as a Chrome trace-event JSON object —
         ``json.dump`` it and open in Perfetto / ``chrome://tracing``.
         Spans become complete (``ph: "X"``) events with epoch-anchored
         microsecond ``ts``, annotations become thread-scoped instant
         (``ph: "i"``) events, and thread names come along as metadata
         so the serving pipeline's stages are labelled tracks.
-        ``trace_id`` exports just that trace (``/trace?trace_id=``)."""
-        spans = self.snapshot(limit, trace_id=trace_id)
+        ``trace_id`` exports just that trace (``/trace?trace_id=``);
+        ``since`` exports only spans finished after that cursor. The
+        document carries a top-level ``cursor`` (the newest completion
+        sequence number) to pass back as the next ``since`` — an extra
+        key Perfetto ignores."""
+        # Cursor read BEFORE the snapshot: a span finishing in between
+        # is then re-sent on the next poll (pollers dedupe by span_id)
+        # rather than silently skipped forever.
+        with self._lock:
+            cursor = self.seq
+        spans = self.snapshot(limit, trace_id=trace_id, since=since)
         events: list[dict] = []
         pid = os.getpid()
         threads: dict[int, str] = {}
@@ -506,11 +533,14 @@ class Tracer:
                 "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                 "args": {"name": tname},
             })
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "cursor": cursor}
 
     def render_json(self, limit: int | None = None,
-                    trace_id: str | None = None) -> str:
-        return json.dumps(self.chrome_trace(limit, trace_id=trace_id))
+                    trace_id: str | None = None,
+                    since: int | None = None) -> str:
+        return json.dumps(self.chrome_trace(limit, trace_id=trace_id,
+                                            since=since))
 
 
 # The process-wide tracer every built-in instrumentation site records

@@ -255,6 +255,12 @@ class ReplicaPool:
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Cumulative membership/drain state transitions (drain, undrain,
+        # remove, crash-respawn, scrape-observed drains): the flight
+        # recorder's drain/failover detector fires on the DELTA, so the
+        # choreography itself is an incident trigger without the
+        # detector having to diff per-replica states.
+        self.transitions_total = 0
         # Lazy: created at the first multi-replica scrape, shut down in
         # close(). Persistent so a sub-second scrape interval is not a
         # per-tick thread create/teardown churn.
@@ -291,6 +297,7 @@ class ReplicaPool:
             if rep is None:
                 return
             rep.state = REMOVED
+            self.transitions_total += 1
             # Unpin every session that pointed here; their next request
             # re-places (their KV state died with the replica anyway).
             for k in [k for k, v in self._sessions.items() if v == target]:
@@ -319,6 +326,8 @@ class ReplicaPool:
             rep = self._replicas.get(target)
             if rep is None or rep.state == REMOVED:
                 return False
+            if rep.state != DRAINING:
+                self.transitions_total += 1
             rep.state = DRAINING
             REPLICA_HEALTHY.labels(replica=target).set(0.0)
         if signal_process and rep.proc is not None \
@@ -341,6 +350,7 @@ class ReplicaPool:
             if rep is None or rep.state != DRAINING:
                 return False
             rep.state = ACTIVE
+            self.transitions_total += 1
             rep.reported_draining = False
             rep.drain_observed = False
             # Reused address: the OLD server's failure history must not
@@ -576,6 +586,7 @@ class ReplicaPool:
                 # The replica began its own drain (operator SIGTERM):
                 # stop placing — the other half of the choreography.
                 rep.state = DRAINING
+                self.transitions_total += 1
                 REPLICA_HEALTHY.labels(replica=rep.target).set(0.0)
                 slog.info("router.replica_draining", replica=rep.target,
                           source="healthz")
@@ -615,6 +626,7 @@ class ReplicaPool:
                 # stop placing now, respawn, let the ready scrape
                 # re-admit it with a fresh breaker.
                 rep.state = DRAINING
+                self.transitions_total += 1
                 rep.drain_observed = True
                 REPLICA_HEALTHY.labels(replica=rep.target).set(0.0)
                 slog.warning("router.replica_exited_unexpectedly",

@@ -376,7 +376,7 @@ def router_health(pool: ReplicaPool):
     return health
 
 
-def admin_routes(pool: ReplicaPool) -> dict:
+def admin_routes(pool: ReplicaPool, recorder=None) -> dict:
     """The rolling-restart admin surface, mounted on the router's
     metrics endpoint (:class:`~tpu_dist_nn.obs.exposition.MetricsServer`
     ``routes=``): fleet introspection for ``tdn metrics --aggregate``,
@@ -384,7 +384,13 @@ def admin_routes(pool: ReplicaPool) -> dict:
     server-side stitched fleet trace (``GET /trace/fleet`` — the
     router's own spans merged with every replica's ``/trace`` pull,
     one lane per process; ``tdn trace --aggregate`` is the client-side
-    twin)."""
+    twin).
+
+    ``recorder`` (a :class:`~tpu_dist_nn.obs.incident.FlightRecorder`
+    fronting this pool) additionally mounts the incident surface —
+    ``/incidents``, ``/incidents/get``, and a ``/debug/bundle`` that
+    captures the WHOLE fleet (every replica's bundle pulled and the
+    traces stitched) instead of the endpoint's process-local default."""
 
     def replicas(query: str):
         return 200, "application/json", (
@@ -420,9 +426,14 @@ def admin_routes(pool: ReplicaPool) -> dict:
 
     from tpu_dist_nn.obs.collect import fleet_trace_route
 
-    return {
+    routes = {
         "/router/replicas": replicas,
         "/router/drain": drain,
         "/router/undrain": undrain,
         "/trace/fleet": fleet_trace_route(pool),
     }
+    if recorder is not None:
+        from tpu_dist_nn.obs.incident import incident_routes
+
+        routes.update(incident_routes(recorder))
+    return routes

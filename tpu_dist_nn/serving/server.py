@@ -242,6 +242,7 @@ class _Batcher:
         t_submit = time.monotonic()
         item["t_submit"] = t_submit
         n = len(x)
+        shed_pending = None
         with self._cond:
             if self._closed:
                 raise UnavailableError("server is shutting down")
@@ -254,15 +255,28 @@ class _Batcher:
                     and self.pending_rows + n > self._max_pending_rows):
                 self.shed_total += 1
                 self._m_shed.inc()
-                raise ResourceExhaustedError(
-                    f"serving queue at capacity ({self.pending_rows} rows "
-                    f"pending, watermark {self._max_pending_rows}); "
-                    "back off and retry"
-                )
-            self._pending.append(item)
-            self.pending_rows += n
-            self.requests_total += 1
-            self._cond.notify()
+                shed_pending = self.pending_rows
+            else:
+                self._pending.append(item)
+                self.pending_rows += n
+                self.requests_total += 1
+                self._cond.notify()
+        if shed_pending is not None:
+            # Structured (and thereby log-ring) evidence for the flight
+            # recorder's shed-spike detector. Emitted OUTSIDE _cond:
+            # the record write blocks on stderr, and one stalled log
+            # consumer holding the admission lock would wedge every
+            # submit and the dispatch loop behind it.
+            slog.warning(
+                "batcher.shed", method=self.method,
+                pending_rows=shed_pending, rows=n,
+                watermark=self._max_pending_rows,
+            )
+            raise ResourceExhaustedError(
+                f"serving queue at capacity ({shed_pending} rows "
+                f"pending, watermark {self._max_pending_rows}); "
+                "back off and retry"
+            )
         self._m_submits.inc()
         bounds = [t for t in (self._submit_timeout, timeout) if t is not None]
         wait = min(bounds) if bounds else None
