@@ -826,6 +826,18 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
         print(f"# incident overhead bench unavailable "
               f"({type(e).__name__}: {e})", file=sys.stderr)
         out["incident_overhead"] = None
+    # Fleet autopilot diurnal A/B (ISSUE 12): autoscaled vs static
+    # peak-sized fleet over a synthetic low-peak-low load, embedded so
+    # tools/bench_gate.py gates autoscale_replica_seconds_ratio (lower
+    # is better — the capacity bill of holding the SLO).
+    try:
+        out["autoscale"] = diurnal_bench(
+            phases=((1.0, 2), (3.5, 8), (2.5, 2))
+        )
+    except Exception as e:  # noqa: BLE001 — must not cost the block
+        print(f"# diurnal autoscale bench unavailable "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        out["autoscale"] = None
     # Per-stage attribution of the numbers above (obs/profile over the
     # spans this bench just recorded): the round artifact then carries
     # WHERE the serving time went, and tools/bench_gate.py folds it
@@ -1114,6 +1126,279 @@ def router_main() -> int:
                           "(p2c placement, 1 vs N loopback replicas)",
                 "value": ab["rps"],
                 "unit": "requests/sec",
+                **ab,
+            }
+        )
+    )
+    return 0
+
+
+def diurnal_bench(jax=None, *, per_row_ms: float = 8.0, dim: int = 16,
+                  phases=((1.5, 2), (5.0, 10), (3.5, 2)),
+                  min_replicas: int = 1, max_replicas: int = 3,
+                  slo_p99_ms: float = 400.0,
+                  hedge_ratio: float = 0.3) -> dict:
+    """Synthetic diurnal-load A/B for the fleet autopilot (ISSUE 12).
+
+    ``phases`` is the load shape — (seconds, concurrent clients) —
+    low → peak → low, driven closed-loop through a real router over
+    :class:`_PacedEngine` loopback replicas (the controlled regime:
+    each replica is launch-bound, so capacity IS replica count). Two
+    arms serve the same shape:
+
+    * **static** — the fleet parked at ``max_replicas`` (peak size)
+      the whole time: the reference posture, peak-provisioned forever.
+    * **autoscaled** — starts at ``min_replicas`` with a real
+      :class:`~tpu_dist_nn.serving.autoscale.Autoscaler` driven on a
+      fast tick (spawner adds an in-process replica): the fleet grows
+      for the peak and drains back down after it.
+
+    The gated figure is ``replica_seconds_ratio`` = autoscaled
+    replica-seconds / static replica-seconds (lower is better; the
+    capacity bill for holding the same SLO). SLO attainment is scored
+    by a REAL SLOTracker over the router's latency histogram deltas
+    (burn_rate{fast} at the post-peak steady state), plus raw p99s.
+
+    A hedging arm rides the same regime: the static fleet with one
+    deliberate straggler replica (5x per-row cost), Process p99 with
+    and without ``HedgePolicy`` — the classic tail-at-scale rescue.
+    """
+    import threading
+
+    from tpu_dist_nn.obs.slo import SLOTracker, latency_objective
+    from tpu_dist_nn.obs.timeseries import TimeSeriesRing
+    from tpu_dist_nn.serving.autoscale import Autoscaler
+    from tpu_dist_nn.serving.pool import ReplicaPool
+    from tpu_dist_nn.serving.router import HedgePolicy, serve_router
+    from tpu_dist_nn.serving.server import GrpcClient, serve_engine
+
+    rng = np.random.default_rng(0)
+    row = rng.uniform(0.0, 1.0, (1, dim))
+    total_s = sum(p[0] for p in phases)
+    steady_s = phases[-1][0]
+
+    def run_arm(autoscaled: bool, straggler: bool = False,
+                hedge=None, shape=None) -> dict:
+        arm_phases = phases if shape is None else shape
+        arm_total_s = sum(p[0] for p in arm_phases)
+        arm_steady_s = arm_phases[-1][0]
+        engines, servers, targets = [], [], []
+
+        def add_replica(slow: bool = False):
+            e = _PacedEngine(dim, per_row_ms * (5.0 if slow else 1.0))
+            srv, port = serve_engine(e, 0, host="127.0.0.1")
+            engines.append(e)
+            servers.append(srv)
+            t = f"127.0.0.1:{port}"
+            targets.append(t)
+            return t
+
+        n0 = min_replicas if autoscaled else max_replicas
+        for i in range(n0):
+            add_replica(slow=(straggler and i == 0))
+        pool = ReplicaPool(targets[:], seed=0)
+        rsrv, rport = serve_router(pool, 0, host="127.0.0.1",
+                                   hedge=hedge)
+        ring = TimeSeriesRing(resolution=0.25)
+        tracker = SLOTracker(ring, [latency_objective(
+            "diurnal_p99", "tdn_router_request_seconds",
+            slo_p99_ms / 1e3, q=0.99, match={"method": "Process"},
+        )], fast_window=arm_steady_s, slow_window=arm_total_s + 5.0)
+        scaler = None
+        if autoscaled:
+            scaler = Autoscaler(
+                pool, min_replicas=min_replicas,
+                max_replicas=max_replicas,
+                spawner=lambda: pool.add(add_replica()),
+                slo=tracker, rows_capacity=3.0,
+                up_cooldown=0.5, down_cooldown=1.0,
+                up_stable_ticks=1, down_stable_ticks=4,
+                decommission_grace=5.0,
+                # The diurnal shape IS one up-then-down cycle; flap
+                # suppression exists for oscillation, not for the
+                # cycle under test.
+                flap_reversals=10,
+            )
+        replica_seconds = [0.0]
+        stop = threading.Event()
+
+        def driver():
+            # The sampler-cadence stand-in: ring collect -> SLO
+            # evaluate -> autoscaler tick, plus the replica-seconds
+            # integral (in-service replicas only).
+            last = time.monotonic()
+            while not stop.is_set():
+                time.sleep(0.1)
+                now = time.monotonic()
+                n = sum(1 for r in pool.replicas()
+                        if r.state != "removed"
+                        and not r.decommissioning)
+                replica_seconds[0] += n * (now - last)
+                last = now
+                ring.collect()
+                tracker.evaluate()
+                if scaler is not None:
+                    scaler.tick()
+
+        drv = threading.Thread(target=driver, daemon=True)
+        drv.start()
+        lats: list[float] = []
+        steady_lats: list[float] = []
+        errors: list[str] = []
+        lock = threading.Lock()
+        arm_t0 = time.monotonic()
+        steady_from = arm_t0 + arm_total_s - arm_steady_s
+
+        def worker(phase_end: float):
+            mine, smine = [], []
+            try:
+                c = GrpcClient(f"127.0.0.1:{rport}", timeout=30.0,
+                               breaker=None)
+                while time.monotonic() < phase_end:
+                    t0 = time.monotonic()
+                    c.process(row)
+                    dt = time.monotonic() - t0
+                    mine.append(dt)
+                    if t0 >= steady_from:
+                        smine.append(dt)
+                c.close()
+            except Exception as e:  # noqa: BLE001 — recorded, not hidden
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+            finally:
+                with lock:
+                    lats.extend(mine)
+                    steady_lats.extend(smine)
+
+        for dur, n_clients in arm_phases:
+            phase_end = time.monotonic() + dur
+            threads = [
+                threading.Thread(target=worker, args=(phase_end,))
+                for _ in range(n_clients)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        # Let the autoscaled arm finish its post-peak scale-down so
+        # the integral includes the capacity it actually released.
+        if scaler is not None:
+            time.sleep(1.5)
+        stop.set()
+        drv.join(timeout=2.0)
+        verdict = tracker.evaluate()
+        wall = time.monotonic() - arm_t0
+        rsrv.stop(0)
+        pool.close()
+        for srv in servers:
+            srv.stop(0)
+        if not lats:
+            raise RuntimeError(f"all diurnal workers failed: {errors[:3]}")
+        lats.sort()
+        steady_lats.sort()
+        obj = verdict["objectives"][0]
+        peak = max_replicas if not autoscaled else max(
+            min_replicas, len(targets)
+        )
+        out = {
+            "rps": round(len(lats) / wall, 1),
+            "requests": len(lats),
+            "p99_ms": round(lats[int(0.99 * (len(lats) - 1))] * 1e3, 1),
+            "steady_p99_ms": round(
+                steady_lats[int(0.99 * (len(steady_lats) - 1))] * 1e3, 1
+            ) if steady_lats else None,
+            "steady_burn_fast": obj["windows"]["fast"]["burn_rate"],
+            "replica_seconds": round(replica_seconds[0], 1),
+            "peak_replicas": peak,
+            "final_replicas": sum(
+                1 for r in pool.replicas() if r.state == "active"
+            ),
+            "_lats": lats,
+        }
+        if errors:
+            out["failed_workers"] = len(errors)
+            out["errors"] = errors[:3]
+        return out
+
+    # Warm-up arm (short shape): grpc one-time init off the A/B.
+    run_arm(False, shape=((1.0, 2),))
+    static = run_arm(False)
+    auto = run_arm(True)
+    # Hedging arm: the static fleet with one deliberate straggler
+    # under a steady moderate load. The hedge delay derives from the
+    # UNHEDGED arm's own measured distribution (a fresh histogram —
+    # the process-global family carries the diurnal arms' peak-phase
+    # queueing, which is not this fleet's tail), exactly the
+    # "p99-derived patience" contract at this regime's scale.
+    from tpu_dist_nn.obs.registry import REGISTRY, Registry
+
+    hedge_shape = ((4.0, 6),)
+    unhedged = run_arm(False, straggler=True, shape=hedge_shape)
+    hreg = Registry()
+    hfam = hreg.histogram(
+        "bench_hedge_seconds", "unhedged-arm latency distribution",
+        labels=("method",),
+    )
+    child = hfam.labels(method="Process")
+    for v in unhedged["_lats"]:
+        child.observe(v)
+    hedged = run_arm(False, straggler=True, shape=hedge_shape,
+                     hedge=HedgePolicy(hedge_ratio,
+                                       min_observations=10,
+                                       latency=hfam))
+
+    def _counter(name):
+        m = REGISTRY.get(name)
+        if m is None:
+            return 0.0
+        return float(sum(child.value for _, child in m.samples()))
+
+    for doc in (static, auto, unhedged, hedged):
+        doc.pop("_lats", None)
+
+    res = {
+        "regime": f"controlled per-launch cost ({per_row_ms}ms/row)",
+        "phases": [list(p) for p in phases],
+        "slo_p99_ms": slo_p99_ms,
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "static": static,
+        "autoscaled": auto,
+        # The GATED figure: the capacity bill of the autoscaled fleet
+        # relative to peak-provisioning, lower is better.
+        "replica_seconds_ratio": round(
+            auto["replica_seconds"] / static["replica_seconds"], 3
+        ),
+        "slo_held": bool(
+            auto["steady_burn_fast"] <= 1.0
+            and auto["p99_ms"] <= slo_p99_ms
+        ),
+        "hedge": {
+            "p99_ratio_of_p99": hedge_ratio,
+            "unhedged_p99_ms": unhedged["p99_ms"],
+            "hedged_p99_ms": hedged["p99_ms"],
+            "p99_ratio": round(
+                hedged["p99_ms"] / max(unhedged["p99_ms"], 1e-9), 3
+            ),
+            "hedges_fired": _counter("tdn_router_hedges_total"),
+            "hedge_wins": _counter("tdn_router_hedge_wins_total"),
+        },
+    }
+    return res
+
+
+def diurnal_main() -> int:
+    """``bench.py --diurnal``: the autoscaled-vs-static diurnal A/B +
+    hedging arm as one JSON line."""
+    ab = diurnal_bench()
+    print(
+        json.dumps(
+            {
+                "metric": "fleet autopilot diurnal A/B (autoscaled vs "
+                          "static peak fleet; replica-seconds at held "
+                          "SLO)",
+                "value": ab["replica_seconds_ratio"],
+                "unit": "replica_seconds_ratio (lower is better)",
                 **ab,
             }
         )
@@ -2161,6 +2446,8 @@ if __name__ == "__main__":
             sys.exit(gen_ab_main())
         if "--router" in sys.argv:
             sys.exit(router_main())
+        if "--diurnal" in sys.argv:
+            sys.exit(diurnal_main())
         sys.exit(main())
     except BaseException as e:  # noqa: BLE001 — JSON error record, not a traceback
         if isinstance(e, SystemExit):

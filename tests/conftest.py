@@ -76,6 +76,19 @@ import pytest  # noqa: E402
 # tests/test_quick_tier.py asserts every module has an entry and every
 # entry resolves, so the list cannot rot silently.
 QUICK_TESTS = {
+    "test_autoscale": [
+        # ISSUE 12 acceptance smokes: the 2->3->2 loopback scale
+        # drill under a faults.py-paced burst (zero dropped), the
+        # one-tick burn->spawn control-loop anchor, hedging's
+        # first-reply-wins contract + the loopback straggler rescue,
+        # the POST /router/scale override, and the bench_gate
+        # skip/fail contract for autoscale_replica_seconds_ratio.
+        "test_autoscale_smoke_fleet_scales_up_and_back_down",
+        "test_synthetic_burn_scales_up_within_one_tick",
+        "test_hedge_fires_once_first_reply_wins_loser_cancelled",
+        "test_hedge_rescues_straggler_over_loopback_wire",
+        "test_manual_scale_override_via_post_route_and_status_route",
+        "test_bench_gate_autoscale_ratio_skip_and_fail"],
     "test_batcher_pipeline": [
         "test_batches_launch_while_prior_fetch_in_flight",
         "test_warm_buckets_ladder_gauge_and_no_misses_after_warm",

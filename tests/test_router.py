@@ -1019,9 +1019,12 @@ def test_router_trace_propagation_names_router_stages():
 def test_admin_routes_drain_undrain_and_cli_client(capsys):
     engines, servers, targets = _replica_fleet(2)
     pool = ReplicaPool(targets, seed=0)
+    from tpu_dist_nn.serving.router import admin_post_routes
+
     msrv = start_http_server(
         0, host="127.0.0.1", health_fn=router_health(pool),
         routes=admin_routes(pool),
+        post_routes=admin_post_routes(pool),
     )
     try:
         from tpu_dist_nn.cli import main

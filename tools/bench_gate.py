@@ -27,6 +27,10 @@ Gated metrics (docs/PERF.md "Regression gate"):
                                                                  lower
     slo_availability                serving.slo.availability.measured
                                                                  higher
+    incident_armed_ratio            serving.incident_overhead.ratio
+                                                                 higher
+    autoscale_replica_seconds_ratio serving.autoscale.replica_seconds_ratio
+                                                                 lower
 
 Rules:
 
@@ -112,6 +116,12 @@ GATED_METRICS = (
     # skip.
     ("incident_armed_ratio",
      ("serving", "incident_overhead", "ratio"), "higher"),
+    # Fleet autopilot (ISSUE 12): autoscaled / static-peak
+    # replica-seconds over the synthetic diurnal load — the capacity
+    # bill of holding the SLO, lower is better. Absent in pre-ISSUE-12
+    # rounds -> per-metric skip.
+    ("autoscale_replica_seconds_ratio",
+     ("serving", "autoscale", "replica_seconds_ratio"), "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

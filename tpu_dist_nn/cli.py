@@ -184,7 +184,8 @@ def _jax_process_count() -> int:
 _live_metrics_servers: list = []
 
 
-def _start_metrics_server(args, health_fn=None, routes=None):
+def _start_metrics_server(args, health_fn=None, routes=None,
+                          post_routes=None):
     """Start the /metrics + /healthz endpoint when --metrics-port was
     passed; prints the bound port as a JSON line (``port=0`` picks an
     ephemeral one — drivers/tests read the line, the reference's
@@ -204,7 +205,8 @@ def _start_metrics_server(args, health_fn=None, routes=None):
     from tpu_dist_nn.obs import start_http_server
 
     try:
-        server = start_http_server(port, health_fn=health_fn, routes=routes)
+        server = start_http_server(port, health_fn=health_fn, routes=routes,
+                                   post_routes=post_routes)
     except OSError as e:
         raise ValueError(f"--metrics-port {port} could not bind: {e}") from e
     _live_metrics_servers.append([server, None])
@@ -426,6 +428,52 @@ def _wire_incident_recorder(args, metrics_server, sampler, ring, tracker,
             ],
         }), flush=True)
     return recorder
+
+
+def _validate_autoscale_flags(args) -> None:
+    """Fail bad autopilot flags BEFORE fleet bring-up (the file's
+    fail-fast convention). Autoscaling rides the runtime sampler, so
+    --metrics-port is required; a spawner exists only with --config
+    (static fleets still get scale-DOWN + the manual override)."""
+    amin = getattr(args, "autoscale_min", None)
+    amax = getattr(args, "autoscale_max", None)
+    if (amin is None) != (amax is None):
+        raise ValueError(
+            "--autoscale-min and --autoscale-max must be passed "
+            "together (the bounds define the policy's envelope)"
+        )
+    if amin is None:
+        return
+    if not 1 <= amin <= amax:
+        raise ValueError(
+            f"need 1 <= --autoscale-min <= --autoscale-max, got "
+            f"{amin}..{amax}"
+        )
+    target = getattr(args, "autoscale_target_occupancy", 0.6)
+    if not 0.0 < target <= 1.5:
+        raise ValueError(
+            f"--autoscale-target-occupancy must be in (0, 1.5], got "
+            f"{target}"
+        )
+    if getattr(args, "metrics_port", None) is None:
+        raise ValueError(
+            "--autoscale-min/--autoscale-max need --metrics-port: the "
+            "control loop runs on the runtime sampler's tick and the "
+            "POST /router/scale override is served there"
+        )
+
+
+def _validate_hedge_flags(args) -> None:
+    ratio = getattr(args, "hedge_after_p99_ratio", None)
+    if ratio is not None and ratio <= 0:
+        raise ValueError(
+            f"--hedge-after-p99-ratio must be > 0, got {ratio}"
+        )
+    if getattr(args, "hedge_generate", False) and ratio is None:
+        raise ValueError(
+            "--hedge-generate needs --hedge-after-p99-ratio (it only "
+            "opts Generate into the hedging the ratio enables)"
+        )
 
 
 def _apply_trace_sample_rate(args) -> None:
@@ -765,8 +813,11 @@ def cmd_router(args) -> int:
         path = f"/router/{verb}"
         if target is not None:
             path += "?replica=" + urllib.parse.quote(target, safe="")
+        # Drain/undrain CHANGE fleet state: POST-only on the server so
+        # a GET sweep cannot actuate; the snapshot stays a GET.
         body = _endpoint_get(
-            _endpoint_base(args.admin), path, args.timeout
+            _endpoint_base(args.admin), path, args.timeout,
+            method="GET" if verb == "replicas" else "POST",
         )
         print(body.decode().strip())
         return 0
@@ -775,6 +826,8 @@ def cmd_router(args) -> int:
     _apply_trace_sample_rate(args)
     _validate_slo_flags(args)
     _validate_incident_flags(args)
+    _validate_autoscale_flags(args)
+    _validate_hedge_flags(args)
     targets = _parse_targets(args.replicas)
     if not targets and not args.spawn:
         raise ValueError(
@@ -805,6 +858,23 @@ def cmd_router(args) -> int:
             f"{len(metrics_targets)} metrics endpoint(s) for "
             f"{len(targets)} replica(s)"
         )
+    weights = []
+    if args.replica_weights:
+        try:
+            weights = [float(w)
+                       for w in _parse_targets(args.replica_weights)]
+        except ValueError as e:
+            raise ValueError(f"--replica-weights must be numbers: {e}") \
+                from e
+        if len(weights) != len(targets):
+            # Same silent-misalignment class as --replica-metrics.
+            raise ValueError(
+                f"--replica-weights must be parallel to --replicas: "
+                f"got {len(weights)} weight(s) for {len(targets)} "
+                f"replica(s)"
+            )
+        if any(w <= 0 for w in weights):
+            raise ValueError("--replica-weights must be > 0")
     from tpu_dist_nn.serving.pool import ReplicaPool
     from tpu_dist_nn.serving.resilience import GracefulDrain
     from tpu_dist_nn.serving.router import (
@@ -814,14 +884,17 @@ def cmd_router(args) -> int:
     )
 
     pool = ReplicaPool(
-        targets, metrics_targets,
+        targets, metrics_targets, weights,
         load_staleness=args.load_staleness,
         scrape_interval=args.scrape_interval,
     )
     drain = GracefulDrain(grace_seconds=args.drain_grace_seconds)
+    from tpu_dist_nn.serving.router import admin_post_routes
+
     metrics_server = _start_metrics_server(
         args, health_fn=drain.wrap_health(router_health(pool)),
         routes=admin_routes(pool),
+        post_routes=admin_post_routes(pool),
     )
     spawned = []
     try:
@@ -852,12 +925,25 @@ def cmd_router(args) -> int:
                         "spawned": True,
                     }), flush=True)
         pool.start()
-        server, bound = serve_router(pool, args.port)
+        hedge = None
+        if args.hedge_after_p99_ratio is not None:
+            from tpu_dist_nn.serving.router import HedgePolicy
+
+            # Process-only unless --hedge-generate opted in: Generate
+            # is not idempotent under sampling (docs/SCALING.md
+            # "Request hedging").
+            hedge = HedgePolicy(
+                args.hedge_after_p99_ratio,
+                methods=(("Process", "Generate") if args.hedge_generate
+                         else ("Process",)),
+            )
+        server, bound = serve_router(pool, args.port, hedge=hedge)
         drain.add_server(server)
         drain.install_signal_handler()
         print(json.dumps({
             "router_port": bound,
             "replicas": pool.targets(),
+            "hedging": sorted(hedge.methods) if hedge else None,
         }), flush=True)
         sampler = None
         if metrics_server is not None:
@@ -876,6 +962,44 @@ def cmd_router(args) -> int:
                     "total_family": "tdn_router_requests_total",
                     "bad_exclude": {"outcome": "ok"},
                 },
+            )
+            # Fleet autopilot (ISSUE 12): the control loop ticks on
+            # the SAME sampler cadence, after the SLO tracker it reads
+            # burn from; scale-up spawns local replicas through the
+            # pool (needs --config), scale-down runs the observed-
+            # drain choreography. POST /router/scale is the manual
+            # override either way.
+            autoscaler = None
+            if args.autoscale_min is not None:
+                from tpu_dist_nn.serving.autoscale import Autoscaler
+
+                spawner = None
+                if args.config:
+                    spawner = lambda: pool.spawn_local(  # noqa: E731
+                        args.config,
+                        extra_args=["--serve-warm-rows",
+                                    str(args.spawn_warm_rows)],
+                    )
+                autoscaler = Autoscaler(
+                    pool,
+                    min_replicas=args.autoscale_min,
+                    max_replicas=args.autoscale_max,
+                    target_occupancy=args.autoscale_target_occupancy,
+                    spawner=spawner, slo=tracker,
+                )
+                sampler.add_autoscaler(autoscaler)
+                print(json.dumps({
+                    "autoscale_min": args.autoscale_min,
+                    "autoscale_max": args.autoscale_max,
+                    "autoscale_target_occupancy":
+                        args.autoscale_target_occupancy,
+                    "autoscale_spawner": bool(spawner),
+                }), flush=True)
+            metrics_server.add_post_routes(
+                admin_post_routes(pool, autoscaler)
+            )
+            metrics_server.add_routes(
+                admin_routes(pool, autoscaler=autoscaler)
             )
             # Flight recorder, fleet flavor: on trigger the router
             # fans /debug/bundle out to every replica within the tick
@@ -900,6 +1024,66 @@ def cmd_router(args) -> int:
         # close() owns spawned-child teardown (SIGTERM -> their own
         # GracefulDrain -> hard kill past the grace budget).
         pool.close(grace=args.drain_grace_seconds + 10.0)
+
+
+def cmd_fleet(args) -> int:
+    """``tdn fleet manifest``: emit docker-compose or k8s specs for a
+    replica fleet + router, sized from ``--replicas-count`` or from a
+    RUNNING router's ``/router/replicas`` snapshot (``--admin``) — so
+    remote fleets inherit the same drain/rejoin automation ``--spawn``
+    fleets get locally (docs/SCALING.md "Fleet manifests")."""
+    from tpu_dist_nn.serving.manifest import (
+        build_spec,
+        compose_manifest,
+        k8s_manifest,
+        spec_from_snapshot,
+    )
+
+    autoscale = None
+    if args.autoscale_min is not None or args.autoscale_max is not None:
+        if args.autoscale_min is None or args.autoscale_max is None:
+            raise ValueError(
+                "--autoscale-min and --autoscale-max must be passed "
+                "together"
+            )
+        autoscale = {
+            "min": args.autoscale_min, "max": args.autoscale_max,
+            "target_occupancy": args.autoscale_target_occupancy,
+        }
+    kwargs = dict(
+        config=args.config, image=args.image,
+        grpc_base_port=args.grpc_base_port,
+        metrics_base_port=args.metrics_base_port,
+        router_port=args.router_port,
+        router_metrics_port=args.router_metrics_port,
+        drain_grace_seconds=args.drain_grace_seconds,
+        warm_rows=args.spawn_warm_rows,
+        autoscale=autoscale,
+        hedge_after_p99_ratio=args.hedge_after_p99_ratio,
+    )
+    if args.admin:
+        body = _endpoint_get(
+            _endpoint_base(args.admin), "/router/replicas", args.timeout
+        )
+        spec = spec_from_snapshot(json.loads(body), **kwargs)
+    else:
+        if args.replicas_count is None:
+            raise ValueError(
+                "tdn fleet manifest needs --replicas-count N, or "
+                "--admin HOST:METRICS_PORT to size the manifest from "
+                "a running router's fleet"
+            )
+        spec = build_spec(args.replicas_count, **kwargs)
+    text = (compose_manifest(spec) if args.format == "compose"
+            else k8s_manifest(spec))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(json.dumps({"wrote": args.out, "format": args.format,
+                          "replicas": spec["replicas"]}))
+    else:
+        print(text, end="")
+    return 0
 
 
 def cmd_train(args) -> int:
@@ -2058,15 +2242,20 @@ def _endpoint_base(target: str) -> str:
     return target.rstrip("/")
 
 
-def _endpoint_get(base: str, path: str, timeout: float) -> bytes:
-    """GET one endpoint route, mapping connection failures to the
-    CLI's user-error convention (ValueError -> clean rc 2)."""
+def _endpoint_get(base: str, path: str, timeout: float,
+                  method: str = "GET") -> bytes:
+    """Fetch one endpoint route (GET by default; ``method="POST"`` for
+    the state-changing admin verbs), mapping connection failures to
+    the CLI's user-error convention (ValueError -> clean rc 2)."""
     import urllib.error
     import urllib.request
 
     url = base + path
     try:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
+        req = urllib.request.Request(
+            url, data=(b"" if method == "POST" else None), method=method
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
         # Non-200 admin/endpoint replies carry a JSON verdict in the
@@ -3045,6 +3234,47 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gauge load older than this many seconds is "
                         "ignored and placement falls back to least-"
                         "outstanding-requests (default 5.0)")
+    p.add_argument("--replica-weights", metavar="W[,W...]",
+                   help="relative capacity weights, parallel to "
+                        "--replicas (e.g. 4,1 for a TPU replica + CPU "
+                        "spillover): the p2c load score divides by the "
+                        "weight so heterogeneous replicas mix without "
+                        "starving the fast one; without it weights "
+                        "derive from each replica's scraped "
+                        "tdn_engine_warm_buckets ladder, else 1")
+    p.add_argument("--autoscale-min", type=int, default=None, metavar="N",
+                   help="arm the fleet autopilot: never shrink below N "
+                        "replicas (pass with --autoscale-max; needs "
+                        "--metrics-port — the control loop runs on the "
+                        "runtime sampler tick and reads the SLO burn "
+                        "rate + scraped occupancy/pending gauges; "
+                        "scale-up spawns local replicas via --config, "
+                        "scale-down drains + removes through the "
+                        "observed-drain choreography; docs/SCALING.md "
+                        "'Autopilot')")
+    p.add_argument("--autoscale-max", type=int, default=None, metavar="N",
+                   help="autopilot upper bound: never grow past N "
+                        "replicas")
+    p.add_argument("--autoscale-target-occupancy", type=float,
+                   default=0.6, metavar="F",
+                   help="utilization the autopilot holds the fleet at "
+                        "(default 0.6); scale-up past F*(1+hysteresis) "
+                        "or on SLO fast burn > 1, scale-down below "
+                        "F*(1-hysteresis)")
+    p.add_argument("--hedge-after-p99-ratio", type=float, default=None,
+                   metavar="R",
+                   help="arm tail-latency request hedging for Process: "
+                        "a forward outstanding longer than R x the "
+                        "router's own measured p99 fires ONE second "
+                        "attempt at another replica; first reply wins, "
+                        "the loser is cancelled (try 2-3; "
+                        "docs/SCALING.md 'Request hedging')")
+    p.add_argument("--hedge-generate", action="store_true",
+                   help="opt Generate into hedging too (OFF by "
+                        "default: sampling is not idempotent — a "
+                        "hedged Generate at temperature > 0 computes "
+                        "different tokens on each replica and burns "
+                        "decode slots on both)")
     p.add_argument("--serve-seconds", type=float, default=None,
                    help="serve for N seconds then drain and exit "
                         "(default: until interrupted)")
@@ -3079,6 +3309,57 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=5.0,
                    help="admin-mode HTTP timeout in seconds (default 5)")
     p.set_defaults(fn=cmd_router)
+
+    p = sub.add_parser(
+        "fleet",
+        help="fleet lifecycle tooling: `tdn fleet manifest` emits "
+             "docker-compose/k8s specs wired for the drain/rejoin "
+             "choreography (healthz probes, drain grace, stable "
+             "replica addresses — docs/SCALING.md)")
+    p.add_argument("action", choices=["manifest"],
+                   help="manifest = emit an orchestrator spec for a "
+                        "replica fleet + router")
+    p.add_argument("--format", choices=["compose", "k8s"],
+                   default="compose",
+                   help="docker-compose (default) or k8s "
+                        "(StatefulSet + headless Service for stable "
+                        "replica DNS)")
+    p.add_argument("--replicas-count", type=int, default=None,
+                   metavar="N", help="fleet size to emit")
+    p.add_argument("--admin", metavar="HOST:PORT",
+                   help="size the manifest from a RUNNING router's "
+                        "fleet instead (/router/replicas on its "
+                        "metrics endpoint)")
+    p.add_argument("--config", default="model.json",
+                   help="model JSON the replicas serve (mounted "
+                        "read-only; default model.json)")
+    p.add_argument("--image", default="tpu-dist-nn:latest",
+                   help="container image for every service "
+                        "(default tpu-dist-nn:latest)")
+    p.add_argument("--grpc-base-port", type=int, default=5101)
+    p.add_argument("--metrics-base-port", type=int, default=9101)
+    p.add_argument("--router-port", type=int, default=5100)
+    p.add_argument("--router-metrics-port", type=int, default=9100)
+    p.add_argument("--drain-grace-seconds", type=float, default=10.0,
+                   help="replica drain window; the manifest's stop "
+                        "grace / terminationGracePeriodSeconds covers "
+                        "it (default 10)")
+    p.add_argument("--spawn-warm-rows", type=int, default=64,
+                   help="replica --serve-warm-rows (default 64)")
+    p.add_argument("--autoscale-min", type=int, default=None,
+                   help="include autopilot flags on the emitted "
+                        "router command (with --autoscale-max)")
+    p.add_argument("--autoscale-max", type=int, default=None)
+    p.add_argument("--autoscale-target-occupancy", type=float,
+                   default=0.6)
+    p.add_argument("--hedge-after-p99-ratio", type=float, default=None,
+                   help="include request hedging on the emitted "
+                        "router command")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the manifest here instead of stdout")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="--admin HTTP timeout in seconds (default 5)")
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser("import-torch",
                        help="torch state dict (.pt) -> model JSON")

@@ -257,27 +257,59 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
                  registry: Registry | None = None, health_fn=None,
-                 tracer=None, routes=None, timeseries=None, slo=None):
+                 tracer=None, routes=None, timeseries=None, slo=None,
+                 post_routes=None):
         reg = registry if registry is not None else REGISTRY
         outer = self
         # Extra GET routes, ``{path: fn(query) -> (status, content_type,
         # body_bytes)}`` — the admin seam (the router mounts its
         # /router/* drain + fleet-introspection paths here). A raising
         # route degrades to a JSON 500, never a handler traceback.
+        # ``post_routes`` is the same shape for state-CHANGING admin
+        # verbs (the router's /router/scale manual override): a scraper
+        # sweeping every GET path must not be able to actuate the fleet.
         self._routes = dict(routes or {})
+        self._post_routes = dict(post_routes or {})
 
         class Handler(BaseHTTPRequestHandler):
+            def _run_route(self, fn, query):
+                try:
+                    return fn(query)
+                except Exception as e:  # noqa: BLE001 — degrade
+                    log.warning("route %s failed: %r", self.path, e)
+                    return (
+                        500, "application/json",
+                        json.dumps({"error": repr(e)}).encode() + b"\n",
+                    )
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                path, _, query = self.path.partition("?")
+                # Any request body is drained (keep-alive hygiene) but
+                # unused: the admin verbs are query-parameter shaped.
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+                if path in outer._post_routes:
+                    status, ctype, body = self._run_route(
+                        outer._post_routes[path], query
+                    )
+                    self._reply(status, ctype, body)
+                elif path in outer._routes:
+                    self._reply(405, "application/json",
+                                b'{"error": "use GET for this path"}\n')
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
             def do_GET(self):  # noqa: N802 — http.server API
                 path, _, query = self.path.partition("?")
+                if path in outer._post_routes and path not in outer._routes:
+                    self._reply(405, "application/json",
+                                b'{"error": "use POST for this path"}\n')
+                    return
                 if path in outer._routes:
-                    try:
-                        status, ctype, body = outer._routes[path](query)
-                    except Exception as e:  # noqa: BLE001 — degrade
-                        log.warning("route %s failed: %r", path, e)
-                        status, ctype, body = (
-                            500, "application/json",
-                            json.dumps({"error": repr(e)}).encode() + b"\n",
-                        )
+                    status, ctype, body = self._run_route(
+                        outer._routes[path], query
+                    )
                     self._reply(status, ctype, body)
                 elif path == "/metrics":
                     body = render(reg).encode()
@@ -376,6 +408,12 @@ class MetricsServer:
         :meth:`attach`. Later mounts win (a router's fleet-capturing
         ``/debug/bundle`` overrides the built-in local one)."""
         self._routes.update(routes)
+
+    def add_post_routes(self, routes: dict) -> None:
+        """Late-mount extra POST routes (same shape as ``post_routes=``):
+        the router's ``/router/scale`` manual-override verb binds here
+        once the autoscaler exists."""
+        self._post_routes.update(routes)
 
     def _trace_body(self, query: str):
         tracer = self._resolve_tracer()
@@ -586,11 +624,13 @@ class MetricsServer:
 def start_http_server(port: int = 0, host: str = "0.0.0.0", *,
                       registry: Registry | None = None,
                       health_fn=None, routes=None, timeseries=None,
-                      slo=None) -> MetricsServer:
+                      slo=None, post_routes=None) -> MetricsServer:
     """Start the /metrics endpoint; returns the server (``.port`` holds
     the bound port when ``port=0`` picked an ephemeral one). ``routes``
-    mounts extra GET paths (see :class:`MetricsServer`);
-    ``timeseries``/``slo`` pre-attach the /timeseries and /slo sources
-    (or late-bind them with :meth:`MetricsServer.attach`)."""
+    mounts extra GET paths and ``post_routes`` extra POST paths (see
+    :class:`MetricsServer`); ``timeseries``/``slo`` pre-attach the
+    /timeseries and /slo sources (or late-bind them with
+    :meth:`MetricsServer.attach`)."""
     return MetricsServer(port, host, registry=registry, health_fn=health_fn,
-                         routes=routes, timeseries=timeseries, slo=slo)
+                         routes=routes, timeseries=timeseries, slo=slo,
+                         post_routes=post_routes)

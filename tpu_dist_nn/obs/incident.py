@@ -459,10 +459,39 @@ class DrainFailoverDetector:
         return "; ".join(reasons) if reasons else None
 
 
+class AutoscaleFlapDetector:
+    """Router-side: fires when the autoscaler muted itself
+    (``tdn_autoscale_flaps_total`` rose since last tick) — scale
+    decisions reversing direction inside the flap window mean the
+    policy's inputs are oscillating (crash-respawn storm, thrashing
+    load, mis-tuned hysteresis), exactly the moment the fleet's state
+    is worth freezing alongside the decision history in the log ring."""
+
+    name = "autoscale.flap"
+
+    def __init__(self):
+        self._flaps: float | None = None
+
+    def check(self, rec, now=None) -> str | None:
+        fam = rec.registry.get("tdn_autoscale_flaps_total")
+        if fam is None:
+            return None
+        cur = sum(child.value for _, child in fam.samples())
+        reason = None
+        if self._flaps is not None and cur > self._flaps:
+            reason = (
+                f"{cur - self._flaps:g} autoscaler flap "
+                f"suppression(s) since last tick (scale decisions "
+                f"reversing; automatic scaling muted)"
+            )
+        self._flaps = cur
+        return reason
+
+
 def default_detectors(*, router: bool = False) -> list:
     """The standard detector set ``--incident-dir`` arms: SLO fast
     burn, error/shed spikes, breaker opens — plus the drain/failover
-    detector on a router."""
+    and autoscaler-flap detectors on a router."""
     dets: list = [
         SLOBurnDetector(),
         BreakerOpenDetector(),
@@ -473,6 +502,7 @@ def default_detectors(*, router: bool = False) -> list:
                           "tdn_router_requests_total",
                           exclude={"outcome": "ok"}),
             DrainFailoverDetector(),
+            AutoscaleFlapDetector(),
         ]
     else:
         dets += [

@@ -155,6 +155,11 @@ class RuntimeSampler:
         # tick: the rings have collected and the SLO trackers have
         # evaluated, so a detector sees this tick's state.
         self._incident_recorders: list = []
+        # Autoscalers (ISSUE 12) tick after the SLO trackers (their
+        # burn-rate signal is the tracker's fresh verdict) and BEFORE
+        # the incident recorders (an autoscale.flap must be visible to
+        # the detector pass of the same tick).
+        self._autoscalers: list = []
 
     # ------------------------------------------------------------ wiring
 
@@ -191,6 +196,14 @@ class RuntimeSampler:
         """Register an :class:`~tpu_dist_nn.obs.slo.SLOTracker` to
         evaluate once per tick (after its ring collected)."""
         self._slo_trackers.append(tracker)
+
+    def add_autoscaler(self, autoscaler) -> None:
+        """Register a :class:`~tpu_dist_nn.serving.autoscale.Autoscaler`
+        whose control loop evaluates once per tick — after the SLO
+        trackers (burn rate is its scale-up signal), before the
+        incident recorders (a flap suppression this tick must be seen
+        by this tick's detector pass)."""
+        self._autoscalers.append(autoscaler)
 
     def add_incident_recorder(self, recorder) -> None:
         """Register a :class:`~tpu_dist_nn.obs.incident.FlightRecorder`
@@ -308,6 +321,13 @@ class RuntimeSampler:
             ring.collect()
         for tracker in self._slo_trackers:
             tracker.evaluate()
+        for autoscaler in self._autoscalers:
+            # Guarded per autoscaler: one broken policy tick must not
+            # starve the incident recorders below of the same tick.
+            try:
+                autoscaler.tick()
+            except Exception:  # noqa: BLE001 — scaling must never kill sampling
+                log.exception("autoscaler tick failed")
         for recorder in self._incident_recorders:
             # check() contains its own per-detector/per-capture guards;
             # anything escaping still only costs this tick (the

@@ -1,7 +1,15 @@
 """Wire-compatible gRPC serving (the reference's LayerService protocol)."""
 
+from tpu_dist_nn.serving.autoscale import (  # noqa: F401
+    Autoscaler,
+)
 from tpu_dist_nn.serving.continuous import (  # noqa: F401
     ContinuousScheduler,
+)
+from tpu_dist_nn.serving.manifest import (  # noqa: F401
+    build_spec,
+    compose_manifest,
+    k8s_manifest,
 )
 from tpu_dist_nn.serving.pool import (  # noqa: F401
     Replica,
@@ -13,7 +21,9 @@ from tpu_dist_nn.serving.resilience import (  # noqa: F401
     RetryPolicy,
 )
 from tpu_dist_nn.serving.router import (  # noqa: F401
+    HedgePolicy,
     Router,
+    admin_post_routes,
     admin_routes,
     router_health,
     serve_router,

@@ -1,0 +1,345 @@
+"""Fleet manifest generator: docker-compose / k8s specs from a pool.
+
+``tdn router --spawn N`` fleets get the full lifecycle automation for
+free — supervised children, SIGTERM → GracefulDrain rolling restarts,
+ready-scrape rejoin — because the pool owns the processes. Remote
+fleets (one ``tdn up`` per host/container) historically had to
+recreate that choreography by hand. This module writes it down ONCE,
+as orchestrator config generated from the same parameters a local
+fleet runs with (docs/SCALING.md "Fleet manifests"):
+
+* **docker-compose** — one service per replica plus the router.
+  ``healthcheck`` polls the replica's ``/healthz`` (the exact probe
+  the pool's scraper speaks), ``stop_grace_period`` covers the
+  replica's ``--drain-grace-seconds`` so ``docker compose restart``
+  IS the zero-downtime rolling restart, and ``restart:
+  unless-stopped`` is the crash-respawn supervisor.
+* **k8s** — a headless Service + StatefulSet for the replicas (stable
+  per-replica DNS names, which the router's ``--replicas`` list and
+  session affinity need) and a Deployment + Service for the router.
+  ``readinessProbe`` hits ``/healthz`` (503 while draining unplaces
+  the pod from the k8s Service AND the pool's scraper view at once)
+  and ``terminationGracePeriodSeconds`` covers the drain window, so a
+  pod delete runs the same SIGTERM choreography a local drain does.
+
+Everything is emitted as plain YAML text by string templating —
+stdlib only, nothing to install, and the output is a starting point
+an operator audits rather than an abstraction they fight.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def build_spec(replicas: int, *, config: str = "model.json",
+               image: str = "tpu-dist-nn:latest",
+               grpc_base_port: int = 5101,
+               metrics_base_port: int = 9101,
+               router_port: int = 5100,
+               router_metrics_port: int = 9100,
+               drain_grace_seconds: float = 10.0,
+               warm_rows: int = 64,
+               autoscale: dict | None = None,
+               hedge_after_p99_ratio: float | None = None,
+               replica_name: str = "tdn-replica",
+               router_name: str = "tdn-router") -> dict:
+    """Normalize one fleet description; both emitters consume this.
+    ``autoscale`` is ``{"min": .., "max": .., "target_occupancy": ..}``
+    or None. Port layout: compose services each get the SAME ports
+    (per-container netns); the k8s StatefulSet uses the base ports on
+    every pod (per-pod DNS)."""
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if autoscale is not None:
+        missing = {"min", "max"} - set(autoscale)
+        if missing:
+            raise ValueError(
+                f"autoscale spec needs min/max, missing {sorted(missing)}"
+            )
+        # The same envelope Autoscaler enforces at construction: an
+        # invalid manifest must fail HERE, not crash-loop the deployed
+        # router container on every start.
+        amin, amax = int(autoscale["min"]), int(autoscale["max"])
+        if not 1 <= amin <= amax:
+            raise ValueError(
+                f"autoscale needs 1 <= min <= max, got {amin}..{amax}"
+            )
+        target = autoscale.get("target_occupancy")
+        if target is not None and not 0.0 < float(target) <= 1.5:
+            raise ValueError(
+                f"autoscale target_occupancy must be in (0, 1.5], got "
+                f"{target}"
+            )
+    return {
+        "replicas": int(replicas),
+        "config": config,
+        "image": image,
+        "grpc_port": int(grpc_base_port),
+        "metrics_port": int(metrics_base_port),
+        "router_port": int(router_port),
+        "router_metrics_port": int(router_metrics_port),
+        "drain_grace_seconds": float(drain_grace_seconds),
+        "warm_rows": int(warm_rows),
+        "autoscale": dict(autoscale) if autoscale else None,
+        "hedge_after_p99_ratio": hedge_after_p99_ratio,
+        "replica_name": replica_name,
+        "router_name": router_name,
+    }
+
+
+def spec_from_snapshot(snapshot: list, **overrides) -> dict:
+    """A spec sized from a RUNNING pool's ``/router/replicas``
+    snapshot (``tdn fleet manifest --admin``): the replica count is
+    the fleet's current non-removed membership, everything else comes
+    from flags/defaults — the generated manifest reproduces the
+    running fleet's shape, not its ephemeral local ports."""
+    n = sum(1 for s in snapshot if s.get("state") != "removed")
+    if n < 1:
+        raise ValueError("running pool reports zero replicas")
+    return build_spec(n, **overrides)
+
+
+def _replica_command(spec: dict) -> list[str]:
+    return [
+        "tdn", "up", "--config", f"/model/{_config_name(spec)}",
+        "--grpc-port", str(spec["grpc_port"]),
+        "--metrics-port", str(spec["metrics_port"]),
+        "--serve-warm-rows", str(spec["warm_rows"]),
+        "--drain-grace-seconds", str(spec["drain_grace_seconds"]),
+    ]
+
+
+def _router_command(spec: dict, replica_hosts: list[str]) -> list[str]:
+    cmd = [
+        "tdn", "router",
+        "--port", str(spec["router_port"]),
+        "--metrics-port", str(spec["router_metrics_port"]),
+        "--replicas",
+        ",".join(f"{h}:{spec['grpc_port']}" for h in replica_hosts),
+        "--replica-metrics",
+        ",".join(f"{h}:{spec['metrics_port']}" for h in replica_hosts),
+        "--drain-grace-seconds", str(spec["drain_grace_seconds"]),
+    ]
+    auto = spec["autoscale"]
+    if auto:
+        # The router's autoscaler actuates through pool.spawn_local —
+        # LOCAL subprocesses. Under an external orchestrator the
+        # replicas are containers/pods the pool cannot create, so the
+        # emitted range is CLAMPED to the emitted fleet size: within
+        # it, scale-down parks and scale-up un-parks (both work on a
+        # static fleet); growth past the membership is the
+        # orchestrator's job (compose --scale / kubectl scale / HPA),
+        # and POST /router/scale?replicas=N remains the manual lever.
+        # An unclamped max would just make the deployed router want
+        # spawns it can never perform.
+        amax = min(int(auto["max"]), spec["replicas"])
+        amin = min(int(auto["min"]), amax)
+        cmd += [
+            "--autoscale-min", str(amin),
+            "--autoscale-max", str(amax),
+        ]
+        if auto.get("target_occupancy") is not None:
+            cmd += ["--autoscale-target-occupancy",
+                    str(auto["target_occupancy"])]
+    if spec["hedge_after_p99_ratio"] is not None:
+        cmd += ["--hedge-after-p99-ratio",
+                str(spec["hedge_after_p99_ratio"])]
+    return cmd
+
+
+def _config_name(spec: dict) -> str:
+    return spec["config"].rstrip("/").rsplit("/", 1)[-1] or "model.json"
+
+
+def _yaml_list(items: list[str]) -> str:
+    """A flow-style YAML string list (json.dumps of each element is a
+    valid YAML double-quoted scalar)."""
+    return "[" + ", ".join(json.dumps(i) for i in items) + "]"
+
+
+# ------------------------------------------------------- docker-compose
+
+
+def compose_manifest(spec: dict) -> str:
+    """One docker-compose document for the whole fleet. ``docker
+    compose up -d`` brings it up; ``docker compose restart
+    tdn-replica-0`` is a zero-downtime rolling restart of that replica
+    (SIGTERM → its GracefulDrain → healthcheck flips → the router
+    unplaces it → restart → ready → rejoin)."""
+    stop_grace = int(spec["drain_grace_seconds"]) + 5
+    hosts = [f"{spec['replica_name']}-{i}"
+             for i in range(spec["replicas"])]
+    out = [
+        "# Generated by `tdn fleet manifest --format compose` "
+        "(docs/SCALING.md).",
+        "# The healthcheck speaks the same /healthz the router's "
+        "scraper does;",
+        "# stop_grace_period covers --drain-grace-seconds so a "
+        "restart drains, never drops.",
+        "services:",
+    ]
+    for host in hosts:
+        out += [
+            f"  {host}:",
+            f"    image: {json.dumps(spec['image'])}",
+            f"    command: {_yaml_list(_replica_command(spec))}",
+            "    volumes:",
+            f"      - ./{_config_name(spec)}:/model/"
+            f"{_config_name(spec)}:ro",
+            "    healthcheck:",
+            "      test: [\"CMD-SHELL\", \"python -c \\\"import "
+            "urllib.request,sys; "
+            "sys.exit(0 if urllib.request.urlopen('http://127.0.0.1:"
+            f"{spec['metrics_port']}/healthz', timeout=2).status==200 "
+            "else 1)\\\"\"]",
+            "      interval: 5s",
+            "      timeout: 3s",
+            "      retries: 3",
+            f"    stop_grace_period: {stop_grace}s",
+            "    restart: unless-stopped",
+        ]
+    out += [
+        f"  {spec['router_name']}:",
+        f"    image: {json.dumps(spec['image'])}",
+        f"    command: {_yaml_list(_router_command(spec, hosts))}",
+        "    ports:",
+        f"      - \"{spec['router_port']}:{spec['router_port']}\"",
+        f"      - \"{spec['router_metrics_port']}:"
+        f"{spec['router_metrics_port']}\"",
+        "    depends_on:",
+    ]
+    for host in hosts:
+        out += [
+            f"      {host}:",
+            "        condition: service_healthy",
+        ]
+    out += [
+        f"    stop_grace_period: {stop_grace}s",
+        "    restart: unless-stopped",
+    ]
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------- k8s
+
+
+def k8s_manifest(spec: dict) -> str:
+    """A k8s multi-document manifest: headless Service + StatefulSet
+    for the replicas (stable DNS so ``--replicas`` lists and session
+    affinity survive pod churn), Deployment + Service for the router.
+    The model JSON is expected in a ConfigMap named ``tdn-model``
+    (``kubectl create configmap tdn-model --from-file=model.json``)."""
+    name = spec["replica_name"]
+    rname = spec["router_name"]
+    grace = int(spec["drain_grace_seconds"]) + 5
+    hosts = [f"{name}-{i}.{name}" for i in range(spec["replicas"])]
+    replica_cmd = _yaml_list(_replica_command(spec))
+    router_cmd = _yaml_list(_router_command(spec, hosts))
+    return f"""# Generated by `tdn fleet manifest --format k8s` (docs/SCALING.md).
+# Replica pods get stable DNS ({name}-0.{name} ...) via the headless
+# Service, so the router's --replicas list and session affinity
+# survive pod churn. readinessProbe speaks the same /healthz the
+# router's scraper does: 503-while-draining unplaces the pod from the
+# k8s Service and the pool view at once, and
+# terminationGracePeriodSeconds covers the GracefulDrain window —
+# `kubectl rollout restart statefulset/{name}` IS the zero-downtime
+# rolling restart.
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}
+spec:
+  clusterIP: None
+  selector:
+    app: {name}
+  ports:
+    - name: grpc
+      port: {spec['grpc_port']}
+    - name: metrics
+      port: {spec['metrics_port']}
+---
+apiVersion: apps/v1
+kind: StatefulSet
+metadata:
+  name: {name}
+spec:
+  serviceName: {name}
+  replicas: {spec['replicas']}
+  selector:
+    matchLabels:
+      app: {name}
+  template:
+    metadata:
+      labels:
+        app: {name}
+    spec:
+      terminationGracePeriodSeconds: {grace}
+      containers:
+        - name: engine
+          image: {json.dumps(spec['image'])}
+          command: {replica_cmd}
+          ports:
+            - containerPort: {spec['grpc_port']}
+              name: grpc
+            - containerPort: {spec['metrics_port']}
+              name: metrics
+          readinessProbe:
+            httpGet:
+              path: /healthz
+              port: {spec['metrics_port']}
+            periodSeconds: 5
+            timeoutSeconds: 3
+          volumeMounts:
+            - name: model
+              mountPath: /model
+              readOnly: true
+      volumes:
+        - name: model
+          configMap:
+            name: tdn-model
+---
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {rname}
+spec:
+  replicas: 1
+  selector:
+    matchLabels:
+      app: {rname}
+  template:
+    metadata:
+      labels:
+        app: {rname}
+    spec:
+      terminationGracePeriodSeconds: {grace}
+      containers:
+        - name: router
+          image: {json.dumps(spec['image'])}
+          command: {router_cmd}
+          ports:
+            - containerPort: {spec['router_port']}
+              name: grpc
+            - containerPort: {spec['router_metrics_port']}
+              name: metrics
+          readinessProbe:
+            httpGet:
+              path: /healthz
+              port: {spec['router_metrics_port']}
+            periodSeconds: 5
+            timeoutSeconds: 3
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: {rname}
+spec:
+  selector:
+    app: {rname}
+  ports:
+    - name: grpc
+      port: {spec['router_port']}
+    - name: metrics
+      port: {spec['router_metrics_port']}
+"""

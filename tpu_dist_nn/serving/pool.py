@@ -102,11 +102,28 @@ class Replica:
     breaker, live load view, and (for pool-spawned replicas) the
     subprocess handle."""
 
-    def __init__(self, target: str, metrics_target: str | None = None):
+    def __init__(self, target: str, metrics_target: str | None = None,
+                 weight: float | None = None):
         self.target = target
         self.metrics_target = metrics_target
         self.state = ACTIVE
         self.breaker = CircuitBreaker.for_target(target)
+        # Explicit capacity weight (--replica-weights): scales the p2c
+        # load score so a replica that can absorb k x the rows of a
+        # baseline one compares as 1/k as loaded at equal backlog —
+        # heterogeneous fleets (TPU replica + CPU spillover) mix
+        # without starving the fast one. None = derive from the
+        # scraped warm-bucket ladder, else 1.0 (homogeneous).
+        self.weight = float(weight) if weight is not None else None
+        # Last scraped tdn_engine_warm_buckets value: the implicit
+        # capacity signal when no explicit weight was configured (a
+        # replica with a deeper precompiled bucket ladder is
+        # provisioned for more concurrent rows).
+        self.warm_buckets: float | None = None
+        # Scale-down in progress (serving/autoscale.py): the replica is
+        # draining toward REMOVAL, so the supervisor must not respawn
+        # its exited child and the ready-scrape must not re-admit it.
+        self.decommissioning = False
         # Requests this router currently has in flight on the replica —
         # the always-available load signal (and the drain barrier).
         self.outstanding = 0
@@ -150,10 +167,7 @@ class Replica:
 
     # ------------------------------------------------------------ wire
 
-    def call(self, method: str, payload: bytes, *, timeout=None,
-             metadata=()):
-        """Forward raw request bytes to this replica (one persistent
-        channel per replica, stubs cached per method)."""
+    def _stub(self, method: str):
         with self._lock:
             if self._channel is None:
                 self._channel = grpc.insecure_channel(
@@ -171,7 +185,23 @@ class Replica:
                     response_deserializer=bytes,
                 )
                 self._stubs[method] = stub
-        return stub(payload, timeout=timeout, metadata=tuple(metadata))
+        return stub
+
+    def call(self, method: str, payload: bytes, *, timeout=None,
+             metadata=()):
+        """Forward raw request bytes to this replica (one persistent
+        channel per replica, stubs cached per method)."""
+        return self._stub(method)(payload, timeout=timeout,
+                                  metadata=tuple(metadata))
+
+    def call_future(self, method: str, payload: bytes, *, timeout=None,
+                    metadata=()):
+        """The non-blocking twin of :meth:`call`: returns the grpc
+        future so the router's hedging path can race two replicas and
+        ``cancel()`` the loser (a blocking call cannot be abandoned
+        without leaking its worker thread for the full timeout)."""
+        return self._stub(method).future(payload, timeout=timeout,
+                                         metadata=tuple(metadata))
 
     def close_channel(self) -> None:
         with self._lock:
@@ -189,17 +219,32 @@ class Replica:
             and self.pending_rows is not None
         )
 
+    @property
+    def capacity_weight(self) -> float:
+        """Relative capacity for weighted p2c: the explicit
+        ``--replica-weights`` value when configured, else the scraped
+        warm-bucket ladder depth (a replica precompiled for more
+        buckets is provisioned for more concurrent rows), else 1.0."""
+        if self.weight is not None:
+            return max(self.weight, 1e-6)
+        if self.warm_buckets is not None and self.warm_buckets >= 1.0:
+            return float(self.warm_buckets)
+        return 1.0
+
     def load_score(self, now: float, staleness: float,
                    occupancy_weight: float) -> float:
         """The p2c comparison key: the router's own outstanding count,
         plus the scraped backlog while it is fresh. ``occupancy_weight``
         converts the slot-occupancy RATIO into row-equivalents (one
-        full continuous-decode ladder ~ a gen_slots-sized backlog)."""
+        full continuous-decode ladder ~ a gen_slots-sized backlog).
+        The blend is divided by :attr:`capacity_weight`, so a 4x
+        replica at backlog 8 ties a 1x replica at backlog 2 instead of
+        losing every comparison the moment it absorbs its fair share."""
         score = float(self.outstanding)
         if self.fresh(now, staleness):
             score += float(self.pending_rows or 0.0)
             score += occupancy_weight * float(self.occupancy or 0.0)
-        return score
+        return score / self.capacity_weight
 
     def snapshot(self) -> dict:
         return {
@@ -212,6 +257,8 @@ class Replica:
             "breaker": self.breaker.state,
             "draining_reported": self.reported_draining,
             "spawned": self.proc is not None,
+            "weight": self.capacity_weight,
+            "decommissioning": self.decommissioning,
         }
 
 
@@ -235,7 +282,7 @@ class ReplicaPool:
     directly.
     """
 
-    def __init__(self, targets=(), metrics_targets=None, *,
+    def __init__(self, targets=(), metrics_targets=None, weights=None, *,
                  load_staleness: float = 5.0,
                  occupancy_weight: float = 32.0,
                  scrape_interval: float = 1.0,
@@ -267,20 +314,25 @@ class ReplicaPool:
         self._scrape_pool: concurrent.futures.ThreadPoolExecutor | None \
             = None
         metrics_targets = list(metrics_targets or ())
+        weights = list(weights or ())
         for i, t in enumerate(targets):
             self.add(t, metrics_targets[i] if i < len(metrics_targets)
-                     else None)
+                     else None,
+                     weight=weights[i] if i < len(weights) else None)
 
     # ------------------------------------------------------ membership
 
-    def add(self, target: str, metrics_target: str | None = None) -> Replica:
+    def add(self, target: str, metrics_target: str | None = None, *,
+            weight: float | None = None) -> Replica:
         with self._lock:
             existing = self._replicas.get(target)
             if existing is not None and existing.state != REMOVED:
                 if metrics_target is not None:
                     existing.metrics_target = metrics_target
+                if weight is not None:
+                    existing.weight = float(weight)
                 return existing
-            rep = Replica(target, metrics_target)
+            rep = Replica(target, metrics_target, weight)
             self._replicas[target] = rep
             REPLICA_HEALTHY.labels(replica=target).set(1.0)
             slog.info("router.replica_added", replica=target,
@@ -353,6 +405,10 @@ class ReplicaPool:
             self.transitions_total += 1
             rep.reported_draining = False
             rep.drain_observed = False
+            # An operator undrain cancels an autoscaler scale-down in
+            # flight: the replica is back in service, not on its way
+            # out (the autoscaler's next tick re-decides from signals).
+            rep.decommissioning = False
             # Reused address: the OLD server's failure history must not
             # greet the new one.
             CircuitBreaker.evict(target)
@@ -361,6 +417,35 @@ class ReplicaPool:
             REPLICA_HEALTHY.labels(replica=target).set(1.0)
         slog.info("router.replica_undrained", replica=target)
         return True
+
+    def decommission(self, target: str) -> bool:
+        """Begin a SCALE-DOWN drain (serving/autoscale.py): like
+        :meth:`drain`, but toward permanent removal — the supervisor
+        will not respawn a pool-spawned child's exit, and the ready
+        scrape will not re-admit the replica. The caller removes it
+        once :meth:`drained_for_removal` says the drain was observed
+        (zero dropped in-flight requests is the whole point of going
+        through the choreography instead of calling remove() cold)."""
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is None or rep.state == REMOVED:
+                return False
+            rep.decommissioning = True
+        return self.drain(target)
+
+    def drained_for_removal(self, target: str) -> bool:
+        """True once a decommissioning replica can be removed with
+        nothing in flight: the router holds zero outstanding forwards
+        on it and — for a pool-spawned child — the process has exited
+        (its own GracefulDrain finished). Unknown target = already
+        gone = removable."""
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is None or rep.state == REMOVED:
+                return True
+            if rep.outstanding > 0:
+                return False
+            return rep.proc is None or rep.proc.poll() is not None
 
     def wait_drained(self, target: str, timeout: float = 30.0) -> bool:
         """Block until the router has zero outstanding requests on a
@@ -488,7 +573,7 @@ class ReplicaPool:
         if "://" not in base:
             base = f"http://{base}"
         base = base.rstrip("/")
-        pending = occupancy = None
+        pending = occupancy = warm = None
         metrics_ok = False
         try:
             with urllib.request.urlopen(
@@ -497,6 +582,7 @@ class ReplicaPool:
                 parsed = parse_prometheus_text(resp.read().decode())
             pending = _sum_series(parsed, "tdn_batcher_pending_rows")
             occupancy = _sum_series(parsed, "tdn_gen_slot_occupancy_ratio")
+            warm = _sum_series(parsed, "tdn_engine_warm_buckets")
             metrics_ok = True
         except (urllib.error.URLError, OSError, ValueError):
             # Stale view ages out; the breaker covers hard-down. NOT a
@@ -550,6 +636,11 @@ class ReplicaPool:
             if metrics_ok:
                 rep.pending_rows = pending
                 rep.occupancy = occupancy
+                if warm is not None:
+                    # Capacity signal for weighted p2c: sticky (not
+                    # aged by staleness) — a ladder already compiled
+                    # does not un-compile when a scrape is missed.
+                    rep.warm_buckets = warm
                 rep.scraped_at = time.monotonic()
             if not reachable:
                 # The health endpoint itself is gone: for a DRAINING
@@ -591,7 +682,11 @@ class ReplicaPool:
                 slog.info("router.replica_draining", replica=rep.target,
                           source="healthz")
         if ready and not draining and rep.state == DRAINING \
-                and rep.drain_observed:
+                and rep.drain_observed and not rep.decommissioning:
+            # (decommissioning replicas never auto-rejoin: the drain is
+            # toward removal, and re-admitting one that still answers
+            # ready — a static replica being scaled down — would undo
+            # the autoscaler's decision one scrape tick later.)
             # The restarted server answers ready on the reused address:
             # rejoin with a fresh breaker. Gated on the drain having
             # been OBSERVED (draining:true scraped, the replica
@@ -611,9 +706,13 @@ class ReplicaPool:
         the rolling restart the flag promises."""
         with self._lock:
             if (rep.state == REMOVED or rep.spawn_argv is None
-                    or rep.respawning
+                    or rep.respawning or rep.decommissioning
                     or time.monotonic() < rep.respawn_backoff_until
                     or rep.proc is None or rep.proc.poll() is None):
+                # decommissioning: the exit IS the scale-down drain
+                # completing — respawning it would undo the autoscaler
+                # (and re-burn an engine compile for a replica that is
+                # being removed on purpose).
                 return
             if rep.state == DRAINING:
                 # The exit IS the drain completing (GracefulDrain ran).

@@ -32,15 +32,19 @@ Metrics (docs/OBSERVABILITY.md): ``tdn_router_requests_total{replica,
 outcome}``, ``tdn_router_placement_seconds``,
 ``tdn_router_failovers_total``, plus the pool's
 ``tdn_router_replica_healthy{replica}``. Admin: :func:`admin_routes`
-serves ``/router/replicas`` / ``/router/drain`` / ``/router/undrain``
-on the metrics endpoint — the ``tdn router --drain-replica`` path for
-zero-downtime rolling restarts (docs/SCALING.md).
+serves the read side (``/router/replicas``, ``/router/autoscale``,
+``/trace/fleet``) and :func:`admin_post_routes` the state-changing
+verbs (``POST /router/drain`` / ``/router/undrain`` /
+``/router/scale``) on the metrics endpoint — the ``tdn router
+--drain-replica`` path for zero-downtime rolling restarts
+(docs/SCALING.md).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import queue
 import time
 import urllib.parse
 
@@ -86,8 +90,104 @@ ROUTER_LATENCY = REGISTRY.histogram(
     "for the fleet's front door)",
     labels=("method",),
 )
+ROUTER_HEDGES = REGISTRY.counter(
+    "tdn_router_hedges_total",
+    "hedge attempts fired: the primary replica sat past the "
+    "p99-derived patience with no reply, so a second attempt raced it "
+    "on another replica",
+    labels=("method",),
+)
+ROUTER_HEDGE_WINS = REGISTRY.counter(
+    "tdn_router_hedge_wins_total",
+    "hedged requests where the HEDGE replied first (the primary was "
+    "cancelled) — the tail the hedge actually cut",
+    labels=("method",),
+)
 
 _CLIENT_DEFAULT = object()
+
+
+class HedgePolicy:
+    """Router-side request hedging (Dean & Barroso, *The Tail at
+    Scale*): after ``p99_ratio`` x the router's own measured p99 for
+    the method with no reply, fire ONE second attempt at a different
+    replica; the first reply wins and the loser is cancelled.
+
+    The delay is derived from ``tdn_router_request_seconds`` — the
+    very histogram the router observes — so patience tracks the
+    fleet's actual tail instead of a hand-tuned constant, and hedging
+    stays off (``delay()`` is None) until ``min_observations``
+    requests have built a trustworthy estimate.
+
+    ``methods`` defaults to ``("Process",)`` only: ``Generate`` is
+    NOT idempotent under sampling (temperature > 0 draws fresh tokens
+    on the hedge replica, and both replicas burn decode slots), so it
+    must be opted in explicitly (``--hedge-generate``) by operators
+    running greedy decoding or accepting the cost.
+    """
+
+    def __init__(self, p99_ratio: float = 2.0, *,
+                 methods=("Process",), min_delay_s: float = 0.002,
+                 max_delay_s: float = 10.0, min_observations: int = 20,
+                 latency=None):
+        if p99_ratio <= 0:
+            raise ValueError(
+                f"hedge p99_ratio must be > 0, got {p99_ratio}"
+            )
+        self.p99_ratio = float(p99_ratio)
+        self.methods = frozenset(methods)
+        self.min_delay_s = float(min_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.min_observations = int(min_observations)
+        # Injectable for tests; the router's own latency family by
+        # default — process-global, so history survives pool churn.
+        self._latency = latency if latency is not None else ROUTER_LATENCY
+
+    def applies(self, method: str) -> bool:
+        return method in self.methods
+
+    def delay(self, method: str) -> float | None:
+        """Seconds to wait on the primary before hedging; None = do
+        not hedge (no/too-little latency history for the method)."""
+        for values, child in self._latency.samples():
+            if values == (method,):
+                if child.value < self.min_observations:
+                    return None
+                q = child.quantile(0.99)
+                if q is None:
+                    return None
+                return min(max(q * self.p99_ratio, self.min_delay_s),
+                           self.max_delay_s)
+        return None
+
+
+class _SyntheticRpcError(grpc.RpcError):
+    """A local verdict shaped like a wire error (cancelled hedge
+    future, wedged in-process fake): carries a real status code so the
+    failover loop's classification works unchanged."""
+
+    def __init__(self, code, message: str):
+        super().__init__()
+        self._code = code
+        self._message = message
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._message
+
+
+def _future_outcome(fut):
+    """(reply, err) from a COMPLETED forward future."""
+    try:
+        return fut.result(timeout=0), None
+    except grpc.RpcError as e:
+        return None, e
+    except Exception as e:  # noqa: BLE001 — cancelled / in-process fakes
+        return None, _SyntheticRpcError(
+            grpc.StatusCode.CANCELLED, repr(e)
+        )
 
 
 class Router:
@@ -95,8 +195,12 @@ class Router:
     server; stateless between requests except through the pool)."""
 
     def __init__(self, pool: ReplicaPool, *, retry=_CLIENT_DEFAULT,
-                 forward_timeout: float | None = 120.0):
+                 forward_timeout: float | None = 120.0,
+                 hedge: HedgePolicy | None = None):
         self.pool = pool
+        # Off by default; a HedgePolicy races a second attempt on the
+        # fleet's tail requests (docs/SCALING.md "Request hedging").
+        self._hedge = hedge
         # max_attempts bounds attempts per REQUEST (across replicas);
         # failover to a fresh replica is immediate, the jittered
         # backoff only paces a second pass over the same replicas.
@@ -194,43 +298,30 @@ class Router:
                 # or the widened pass landing back) is not the fleet
                 # absorbing anything.
                 ROUTER_FAILOVERS.inc()
-            self.pool.begin(rep)
-            err: grpc.RpcError | None = None
-            t_fwd = time.monotonic()
-            try:
-                reply = rep.call(
-                    method, payload,
-                    timeout=(remaining if remaining is not None
-                             else self._forward_timeout),
-                    metadata=metadata,
-                )
-            except grpc.RpcError as e:
-                err = e
-            finally:
-                self.pool.done(rep)
-                _trace.TRACER.record_span(
-                    "router.forward", span.ctx, t_fwd,
-                    time.monotonic() - t_fwd,
-                    attrs={"replica": rep.target, "attempt": attempt,
-                           "ok": err is None},
-                )
+            reply, err, serving, hedged = self._forward(
+                method, payload, rep, remaining, metadata, span,
+                attempt, tried,
+            )
             if err is None:
-                rep.breaker.record_success()
+                serving.breaker.record_success()
                 ROUTER_REQUESTS.labels(
-                    replica=rep.target, outcome="ok"
+                    replica=serving.target, outcome="ok"
                 ).inc()
                 if session is not None:
-                    self.pool.pin(session, rep.target)
-                if attempt > 1:
+                    self.pool.pin(session, serving.target)
+                if attempt > 1 or serving is not rep:
                     span.annotate(
-                        f"served by {rep.target} on attempt {attempt}"
+                        f"served by {serving.target} on attempt "
+                        f"{attempt}" + (" (hedge won)" if hedged
+                                        and serving is not rep else "")
                     )
                 return reply
+            # On failure the error handled below belongs to the last
+            # replica that produced one (the hedge target when a
+            # hedge fired and also failed).
+            rep = serving
             code = _status_of(err)
-            transient = (
-                policy.retryable(code) if policy is not None
-                else _code_name(code) in RETRYABLE_CODES
-            )
+            transient = self._transient(code)
             if transient:
                 rep.breaker.record_failure()
             else:
@@ -299,6 +390,222 @@ class Router:
             if delay:
                 policy.sleep(delay)
 
+    # -------------------------------------------------------- forwards
+
+    def _forward(self, method, payload, rep, remaining, metadata, span,
+                 attempt, tried):
+        """One forward attempt — plain, or hedged when the policy
+        applies and its p99-derived delay leaves room inside the
+        budget. Returns ``(reply, err, serving_replica, hedged)``:
+        ``serving_replica`` is the winner on success, the last errored
+        replica on failure."""
+        timeout = (remaining if remaining is not None
+                   else self._forward_timeout)
+        delay = None
+        if self._hedge is not None and self._hedge.applies(method):
+            delay = self._hedge.delay(method)
+            if (delay is not None and timeout is not None
+                    and delay >= timeout):
+                # No room for a second attempt inside what is left of
+                # the caller's budget: hedging would only add load.
+                delay = None
+        if delay is None:
+            err: grpc.RpcError | None = None
+            reply = None
+            self.pool.begin(rep)
+            t_fwd = time.monotonic()
+            try:
+                reply = rep.call(method, payload, timeout=timeout,
+                                 metadata=metadata)
+            except grpc.RpcError as e:
+                err = e
+            finally:
+                self.pool.done(rep)
+                _trace.TRACER.record_span(
+                    "router.forward", span.ctx, t_fwd,
+                    time.monotonic() - t_fwd,
+                    attrs={"replica": rep.target, "attempt": attempt,
+                           "ok": err is None},
+                )
+            return reply, err, rep, False
+        return self._forward_hedged(method, payload, rep, timeout,
+                                    metadata, span, attempt, tried,
+                                    delay)
+
+    def _forward_hedged(self, method, payload, rep, timeout, metadata,
+                        span, attempt, tried, delay):
+        """Race the primary against one hedge: wait ``delay`` on the
+        primary; if it has not replied, fire the same request at a
+        DIFFERENT replica (session affinity deliberately ignored — the
+        pinned replica is the slow one). First reply wins, the loser
+        is cancelled. At most ONE hedge per attempt: past two
+        in-flight copies the marginal tail win cannot pay for the
+        doubled load (Tail at Scale §hedged-requests)."""
+        q: queue.Queue = queue.Queue()
+        started = time.monotonic()
+        entries: dict[int, tuple] = {}
+
+        def fire(r, tmo):
+            self.pool.begin(r)
+            try:
+                fut = r.call_future(method, payload, timeout=tmo,
+                                    metadata=metadata)
+            except Exception:
+                self.pool.done(r)
+                raise
+            entries[id(fut)] = (fut, r)
+            # done callbacks run once, including on cancel — the
+            # outstanding count stays exact for both copies.
+            fut.add_done_callback(
+                lambda f, _r=r: (self.pool.done(_r), q.put(f))
+            )
+            return fut
+
+        fire(rep, timeout)
+        first = None
+        try:
+            first = q.get(timeout=delay)
+        except queue.Empty:
+            pass
+        hedged = False
+        if first is None:
+            hedge_rep = self.pool.place(
+                exclude=set(tried) | {rep.target}
+            )
+            if hedge_rep is not None:
+                tmo2 = timeout
+                if timeout is not None:
+                    tmo2 = max(0.001,
+                               timeout - (time.monotonic() - started))
+                try:
+                    fire(hedge_rep, tmo2)
+                    hedged = True
+                    ROUTER_HEDGES.labels(method=method).inc()
+                    span.annotate(
+                        f"hedged to {hedge_rep.target} after "
+                        f"{delay * 1e3:.0f}ms"
+                    )
+                except Exception:  # noqa: BLE001 — failed fire = no hedge
+                    log.debug("hedge fire on %s failed",
+                              hedge_rep.target, exc_info=True)
+        last_err: grpc.RpcError | None = None
+        last_rep = rep
+        pending = len(entries)
+        # Slack past the grpc deadline: every future completes on its
+        # own once its deadline fires; the cap only guards a wedged
+        # in-process fake from holding the worker thread forever.
+        wait_cap = None if timeout is None else started + timeout + 5.0
+        while pending:
+            if first is None:
+                try:
+                    first = q.get(timeout=(
+                        None if wait_cap is None
+                        else max(0.01, wait_cap - time.monotonic())
+                    ))
+                except queue.Empty:
+                    # Cancel whatever is still pending before bailing:
+                    # each un-finished future holds a pool.begin() that
+                    # only its done callback releases — leaking it
+                    # biases p2c away from the replica forever and
+                    # wedges any later drain's outstanding==0 barrier.
+                    for ofut, _other in entries.values():
+                        try:
+                            if not ofut.done():
+                                ofut.cancel()
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
+                    break
+            fut, r = entries[id(first)]
+            first = None
+            pending -= 1
+            cancelled = False
+            try:
+                cancelled = bool(fut.cancelled())
+            except Exception:  # noqa: BLE001 — duck-typed fakes
+                pass
+            reply, err = _future_outcome(fut)
+            if err is None and not cancelled:
+                for ofut, _other in entries.values():
+                    if ofut is not fut:
+                        try:
+                            ofut.cancel()
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
+                if r is not rep:
+                    ROUTER_HEDGE_WINS.labels(method=method).inc()
+                _trace.TRACER.record_span(
+                    "router.forward", span.ctx, started,
+                    time.monotonic() - started,
+                    attrs={"replica": r.target, "attempt": attempt,
+                           "ok": True, "hedged": hedged,
+                           "hedge_won": r is not rep},
+                )
+                return reply, None, r, hedged
+            if err is not None and not cancelled:
+                if not self._transient(_status_of(err)):
+                    # A deterministic verdict propagates IMMEDIATELY —
+                    # another replica would say the same thing, so
+                    # waiting out the other in-flight copy (possibly
+                    # the full forward timeout) only adds latency the
+                    # un-hedged path never paid. Cancel it and return.
+                    for ofut, _other in entries.values():
+                        if ofut is not fut:
+                            try:
+                                ofut.cancel()
+                            except Exception:  # noqa: BLE001
+                                pass
+                    _trace.TRACER.record_span(
+                        "router.forward", span.ctx, started,
+                        time.monotonic() - started,
+                        attrs={"replica": r.target, "attempt": attempt,
+                               "ok": False, "hedged": hedged},
+                    )
+                    return None, err, r, hedged
+                # A transient loser: its verdict feeds the breaker,
+                # the per-replica counter, AND the failover exclusion
+                # set now — the next attempt must not be handed
+                # straight back to a replica that failed this very
+                # request (the outer loop only records the FINAL
+                # errored replica).
+                tried.add(r.target)
+                if pending:
+                    self._record_loser(r, err)
+                last_err, last_rep = err, r
+        if last_err is None:
+            # Both copies vanished without a verdict (cancel race on a
+            # fake, wait-cap breach): surface a budget-shaped error so
+            # the failover loop can do its job.
+            last_err = _SyntheticRpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                "hedged forward produced no reply within the budget",
+            )
+        _trace.TRACER.record_span(
+            "router.forward", span.ctx, started,
+            time.monotonic() - started,
+            attrs={"replica": last_rep.target, "attempt": attempt,
+                   "ok": False, "hedged": hedged},
+        )
+        return None, last_err, last_rep, hedged
+
+    def _transient(self, code) -> bool:
+        """One classification for every path (plain failover, hedged
+        losers, loser recording): a divergence here would let the
+        hedged and unhedged paths disagree on which errors trip
+        breakers and fail over."""
+        if self._retry is not None:
+            return self._retry.retryable(code)
+        return _code_name(code) in RETRYABLE_CODES
+
+    def _record_loser(self, rep, err) -> None:
+        code = _status_of(err)
+        if self._transient(code):
+            rep.breaker.record_failure()
+        else:
+            rep.breaker.record_success()
+        ROUTER_REQUESTS.labels(
+            replica=rep.target, outcome=_code_name(code)
+        ).inc()
+
 
 def _status_of(e: grpc.RpcError):
     try:
@@ -333,7 +640,8 @@ def _make_router_handler(router: Router):
 def serve_router(pool: ReplicaPool, port: int, *,
                  host: str = "0.0.0.0", max_workers: int = 32,
                  retry=_CLIENT_DEFAULT, interceptors=(),
-                 forward_timeout: float | None = 120.0):
+                 forward_timeout: float | None = 120.0,
+                 hedge: HedgePolicy | None = None):
     """Start the router on ``host:port``; returns ``(server,
     bound_port)``. ``server.router`` / ``server.pool`` expose the
     internals; ``port=0`` picks an ephemeral port (printed by ``tdn
@@ -341,8 +649,11 @@ def serve_router(pool: ReplicaPool, port: int, *,
     attempt per request — the A/B control arm); ``interceptors`` is
     the fault-injection seam, same as the engine servers;
     ``forward_timeout`` caps each forward for deadline-less callers
-    (a wedged replica must not hold worker threads forever)."""
-    router = Router(pool, retry=retry, forward_timeout=forward_timeout)
+    (a wedged replica must not hold worker threads forever);
+    ``hedge`` arms tail-latency request hedging (off by default —
+    docs/SCALING.md "Request hedging")."""
+    router = Router(pool, retry=retry, forward_timeout=forward_timeout,
+                    hedge=hedge)
     server = _new_grpc_server(max_workers, interceptors)
     server.add_generic_rpc_handlers((_make_router_handler(router),))
     bound = server.add_insecure_port(f"{host}:{port}")
@@ -376,7 +687,8 @@ def router_health(pool: ReplicaPool):
     return health
 
 
-def admin_routes(pool: ReplicaPool, recorder=None) -> dict:
+def admin_routes(pool: ReplicaPool, recorder=None,
+                 autoscaler=None) -> dict:
     """The rolling-restart admin surface, mounted on the router's
     metrics endpoint (:class:`~tpu_dist_nn.obs.exposition.MetricsServer`
     ``routes=``): fleet introspection for ``tdn metrics --aggregate``,
@@ -385,6 +697,11 @@ def admin_routes(pool: ReplicaPool, recorder=None) -> dict:
     router's own spans merged with every replica's ``/trace`` pull,
     one lane per process; ``tdn trace --aggregate`` is the client-side
     twin).
+
+    State-CHANGING admin verbs (drain, undrain, scale) are POST-only
+    (:func:`admin_post_routes`) so a scraper or crawler sweeping every
+    GET path can never actuate the fleet; this function mounts only
+    the read side.
 
     ``recorder`` (a :class:`~tpu_dist_nn.obs.incident.FlightRecorder`
     fronting this pool) additionally mounts the incident surface —
@@ -396,6 +713,45 @@ def admin_routes(pool: ReplicaPool, recorder=None) -> dict:
         return 200, "application/json", (
             json.dumps(pool.snapshot()).encode() + b"\n"
         )
+
+    def autoscale_status(query: str):
+        if autoscaler is None:
+            return 404, "application/json", (
+                b'{"error": "no autoscaler (start tdn router with '
+                b'--autoscale-min/--autoscale-max)"}\n'
+            )
+        return 200, "application/json", json.dumps(
+            autoscaler.status()
+        ).encode() + b"\n"
+
+    from tpu_dist_nn.obs.collect import fleet_trace_route
+
+    routes = {
+        "/router/replicas": replicas,
+        "/router/autoscale": autoscale_status,
+        "/trace/fleet": fleet_trace_route(pool),
+    }
+    if recorder is not None:
+        from tpu_dist_nn.obs.incident import incident_routes
+
+        routes.update(incident_routes(recorder))
+    return routes
+
+
+def admin_post_routes(pool: ReplicaPool | None = None,
+                      autoscaler=None) -> dict:
+    """POST routes for the router's metrics endpoint
+    (:meth:`~tpu_dist_nn.obs.exposition.MetricsServer.add_post_routes`)
+    — every verb that CHANGES fleet state lives here, POST-only, so a
+    GET sweep of the admin surface can never actuate anything:
+
+    * ``POST /router/drain?replica=T`` / ``POST /router/undrain?replica=T``
+      — the rolling-restart choreography (``tdn router --drain-replica``);
+    * ``POST /router/scale?replicas=N`` — park the fleet at N (manual
+      autoscaler override, clamped to min/max, actuated through the
+      same drain/spawn choreography); ``?mode=auto`` hands control
+      back to the policy. Mounted even without an autoscaler so the
+      operator gets a hint instead of a 404."""
 
     def _one_target(query: str) -> str | None:
         q = urllib.parse.parse_qs(query)
@@ -424,16 +780,41 @@ def admin_routes(pool: ReplicaPool, recorder=None) -> dict:
             {"replica": target, "active": ok}
         ).encode() + b"\n"
 
-    from tpu_dist_nn.obs.collect import fleet_trace_route
+    def scale(query: str):
+        if autoscaler is None:
+            return 409, "application/json", (
+                b'{"error": "no autoscaler (start tdn router with '
+                b'--autoscale-min/--autoscale-max)"}\n'
+            )
+        q = urllib.parse.parse_qs(query)
+        mode = (q.get("mode") or [None])[0]
+        replicas = (q.get("replicas") or [None])[0]
+        if mode == "auto":
+            autoscaler.clear_override()
+            return 200, "application/json", json.dumps(
+                autoscaler.status()
+            ).encode() + b"\n"
+        if replicas is None:
+            return 400, "application/json", (
+                b'{"error": "replicas=N (or mode=auto) query '
+                b'parameter required"}\n'
+            )
+        try:
+            n = int(replicas)
+        except ValueError:
+            return 400, "application/json", \
+                b'{"error": "replicas must be an integer"}\n'
+        if n < 1:
+            return 400, "application/json", \
+                b'{"error": "replicas must be >= 1"}\n'
+        granted = autoscaler.set_override(n)
+        doc = autoscaler.status()
+        doc["requested"] = n
+        doc["granted"] = granted
+        return 200, "application/json", json.dumps(doc).encode() + b"\n"
 
-    routes = {
-        "/router/replicas": replicas,
-        "/router/drain": drain,
-        "/router/undrain": undrain,
-        "/trace/fleet": fleet_trace_route(pool),
-    }
-    if recorder is not None:
-        from tpu_dist_nn.obs.incident import incident_routes
-
-        routes.update(incident_routes(recorder))
+    routes = {"/router/scale": scale}
+    if pool is not None:
+        routes["/router/drain"] = drain
+        routes["/router/undrain"] = undrain
     return routes
