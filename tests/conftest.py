@@ -212,6 +212,16 @@ QUICK_TESTS = {
     "test_serving": ["test_codec_round_trip",
                      "test_grpc_round_trip_matches_local",
                      "test_serve_generate_single_chip_and_validation"],
+    # ISSUE 13: the tdn lint gate in both directions — zero
+    # non-baselined findings on the shipped tree, exit 1 on a planted
+    # violation, each rule firing on its fixture with the exact id and
+    # line — plus the bench_gate report-header integration.
+    "test_tdnlint": [
+        "test_rule_fires_on_violating_fixture",
+        "test_rule_silent_on_clean_twin",
+        "test_shipped_tree_is_clean_via_tdn_lint_cli",
+        "test_tdn_lint_exits_nonzero_on_planted_violation",
+        "test_bench_gate_report_only_mentions_lint_status"],
     "test_tensor_parallel": ["test_forward_matches_single_chip[spec1]",
                              "test_shard_roundtrip"],
     "test_tpu_hardware": ["*"],

@@ -1,0 +1,20 @@
+"""admin-actuation fixture (violating twin): a state-changing verb on
+a GET route — the PR 12 bug where a scraper sweeping the admin surface
+could drain the fleet."""
+
+
+def admin_routes(pool):
+    def replicas(query):
+        return 200, "application/json", b"[]\n"
+
+    def drain(query):
+        ok = pool.drain("127.0.0.1:5101")  # <- violation
+        return 200, "application/json", (
+            b'{"ok": true}\n' if ok else b'{"ok": false}\n'
+        )
+
+    return {"/router/replicas": replicas, "/router/drain": drain}
+
+
+def mount(server, pool):
+    server.add_routes(admin_routes(pool))

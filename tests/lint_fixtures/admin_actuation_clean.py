@@ -1,0 +1,24 @@
+"""admin-actuation fixture (clean twin): reads mount on GET, the
+state-changing verb moves to the POST surface."""
+
+
+def admin_routes(pool):
+    def replicas(query):
+        return 200, "application/json", b"[]\n"
+
+    return {"/router/replicas": replicas}
+
+
+def admin_post_routes(pool):
+    def drain(query):
+        ok = pool.drain("127.0.0.1:5101")
+        return 200, "application/json", (
+            b'{"ok": true}\n' if ok else b'{"ok": false}\n'
+        )
+
+    return {"/router/drain": drain}
+
+
+def mount(server, pool):
+    server.add_routes(admin_routes(pool))
+    server.add_post_routes(admin_post_routes(pool))

@@ -1,0 +1,25 @@
+"""jit-purity fixture (violating twin): host-side effects inside a
+jitted function run ONCE at trace time and are baked into the compiled
+program — the classic silent-wrongness class for kernels."""
+
+import random
+import time
+
+import jax
+
+_CALLS = 0
+
+
+@jax.jit
+def noisy_step(x):
+    print("stepping", x)  # <- violation
+    jitter = random.random()  # <- violation
+    t0 = time.time()  # <- violation
+    return x * jitter + t0
+
+
+@jax.jit
+def counting_step(x):
+    global _CALLS  # <- violation
+    _CALLS += 1
+    return x

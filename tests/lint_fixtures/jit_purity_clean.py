@@ -1,0 +1,18 @@
+"""jit-purity fixture (clean twin): randomness through jax.random with
+an explicit key, per-call output through jax.debug.print, timing done
+by the CALLER around the compiled function."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_step(x, key):
+    jitter = jax.random.uniform(key)
+    jax.debug.print("stepping {x}", x=x)
+    return x * jitter
+
+
+@jax.jit
+def counting_step(x, calls):
+    return x, calls + jnp.ones_like(calls)

@@ -1,0 +1,17 @@
+"""lock-discipline fixture (violating twin): a guarded attribute read
+outside its lock — the pool/respawn race class PRs 8/12 hand-caught."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.balance = 0  # guarded-by: _lock
+
+    def deposit(self, amount):
+        with self._lock:
+            self.balance += amount
+
+    def peek(self):
+        return self.balance  # <- violation

@@ -1,0 +1,21 @@
+"""lock-discipline fixture (clean twin): every access under the lock,
+plus the ``# caller-holds:`` escape for helpers whose callers lock."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.balance = 0  # guarded-by: _lock
+
+    def deposit(self, amount):
+        with self._lock:
+            self._apply(amount)
+
+    def _apply(self, amount):  # caller-holds: _lock
+        self.balance += amount
+
+    def peek(self):
+        with self._lock:
+            return self.balance

@@ -1,0 +1,15 @@
+"""metric-series-lifecycle fixture (violating twin): a replica-labeled
+family with no pruning — membership churn grows the label set forever
+and departed replicas keep exposing their stale last value."""
+
+from tpu_dist_nn.obs.registry import REGISTRY
+
+OUTSTANDING = REGISTRY.gauge(  # <- violation
+    "fixture_replica_outstanding",
+    "requests in flight per replica",
+    labels=("replica",),
+)
+
+
+def on_request(target):
+    OUTSTANDING.labels(replica=target).inc()

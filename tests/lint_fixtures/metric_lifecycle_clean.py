@@ -1,0 +1,18 @@
+"""metric-series-lifecycle fixture (clean twin): the defining module
+retires a departed target's series on the membership-churn path."""
+
+from tpu_dist_nn.obs.registry import REGISTRY
+
+OUTSTANDING = REGISTRY.gauge(
+    "fixture_replica_outstanding",
+    "requests in flight per replica",
+    labels=("replica",),
+)
+
+
+def on_request(target):
+    OUTSTANDING.labels(replica=target).inc()
+
+
+def on_replica_removed(target):
+    OUTSTANDING.remove(replica=target)

@@ -1,0 +1,17 @@
+"""tick-purity fixture (violating twin): blocking actuation directly
+on the RuntimeSampler tick — the sampler thread carries the SLO,
+autoscale, and incident planes, so one sleep stalls them all."""
+
+import time
+
+
+class Autopilot:
+    def tick(self):
+        self._actuate()
+
+    def _actuate(self):
+        time.sleep(0.5)  # <- violation
+
+
+def wire(sampler):
+    sampler.add_autoscaler(Autopilot())

@@ -1,0 +1,19 @@
+"""tick-purity fixture (clean twin): the tick only DECIDES; blocking
+actuation runs on its own thread (the Autoscaler._spawn_one pattern)."""
+
+import threading
+import time
+
+
+class Autopilot:
+    def tick(self):
+        threading.Thread(
+            target=self._actuate, name="autopilot-actuate", daemon=True
+        ).start()
+
+    def _actuate(self):
+        time.sleep(0.5)  # off the tick: runs on the actuation thread
+
+
+def wire(sampler):
+    sampler.add_autoscaler(Autopilot())

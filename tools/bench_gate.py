@@ -396,14 +396,45 @@ def attribution_lines(profile: dict | None) -> list[str]:
     return lines
 
 
+def lint_status_line() -> str:
+    """One-line tdnlint verdict for the report header: regression
+    reports and invariant drift surface in one place. Fail-safe — a
+    missing or broken analyzer reports itself, never breaks the gate."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        # tdnlint lives right next to this script: a plain import with
+        # tools/ on the path (sys.modules dedupes against any loader
+        # that registered the package first).
+        if here not in sys.path:
+            sys.path.insert(0, here)
+        import tdnlint
+
+        target = os.path.join(os.path.dirname(here), "tpu_dist_nn")
+        result = tdnlint.run_lint(
+            [target], baseline_path=tdnlint.DEFAULT_BASELINE
+        )
+        new = len(result["new"])
+        if new:
+            return (f"lint: {new} non-baselined finding"
+                    f"{'s' if new != 1 else ''} — run `tdn lint` "
+                    "(docs/STATIC_ANALYSIS.md)")
+        return (f"lint: clean ({len(result['baselined'])} baselined, "
+                f"{result['suppressed_total']} suppressed)")
+    except Exception as e:  # noqa: BLE001 — the gate must keep gating
+        return f"lint: unavailable ({e!r})"
+
+
 def render_report(verdict: dict, cur_path: str, prev_path: str,
                   profile: dict | None = None,
-                  report_only: bool = False) -> str:
+                  report_only: bool = False,
+                  lint_status: str | None = None) -> str:
     lines = [
         f"bench gate: {os.path.basename(prev_path)} -> "
         f"{os.path.basename(cur_path)}"
         + (" [report-only]" if report_only else ""),
     ]
+    if lint_status:
+        lines.append(lint_status)
     if "skipped" in verdict:
         lines.append(f"SKIP: {verdict['skipped']}")
         return "\n".join(lines)
@@ -499,8 +530,13 @@ def main(argv=None) -> int:
     profile = load_profile(args.profile) or (
         (cur.get("serving") or {}).get("profile")
     )
-    print(render_report(verdict, cur_path, prev_path, profile,
-                        report_only=args.report_only))
+    print(render_report(
+        verdict, cur_path, prev_path, profile,
+        report_only=args.report_only,
+        # The lint header rides report-only mode (the PR-report/CI
+        # summary path); enforce mode stays a pure perf verdict.
+        lint_status=lint_status_line() if args.report_only else None,
+    ))
     if args.json:
         print(json.dumps({
             "current": os.path.basename(cur_path),

@@ -1,0 +1,131 @@
+"""tdnlint — machine-checked project invariants for tpu_dist_nn.
+
+A stdlib-only AST analyzer with five project-specific rules (see
+docs/STATIC_ANALYSIS.md for the catalog and workflow):
+
+* ``lock-discipline``         — ``# guarded-by:`` attrs need their lock
+* ``tick-purity``             — no blocking calls on the sampler tick
+* ``metric-series-lifecycle`` — churning-label families must be pruned
+* ``admin-actuation``         — GET routes must not mutate fleet state
+* ``jit-purity``              — jitted code: no time/random/print/global
+
+Run it as ``tdn lint [paths...]``, ``python tools/tdnlint`` from the
+repo root, or programmatically via :func:`run_lint` / :func:`main`.
+Exit codes: 0 clean (baselined findings allowed), 1 non-baselined
+findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import (  # noqa: F401
+    Finding,
+    LintError,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from .rules import RULES  # noqa: F401
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def summary_line(result: dict) -> str:
+    new = len(result["new"])
+    return (
+        f"tdnlint: {new} finding{'s' if new != 1 else ''} "
+        f"({len(result['baselined'])} baselined, "
+        f"{result['suppressed_total']} suppressed) "
+        f"across {result['files']} files"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tdnlint",
+        description="machine-checked tpu_dist_nn invariants "
+                    "(docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/packages to scan (default: the "
+                         "tpu_dist_nn package next to this repo's "
+                         "tools/)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="RULE",
+                    help="run only this rule (repeatable); default all")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of grandfathered findings "
+                         "(default: tools/tdnlint/baseline.json; pass "
+                         "'' to disable)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current finding "
+                         "set (existing justifications are kept; new "
+                         "entries get a TODO)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule ids and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="also print one machine-readable JSON line")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule in RULES:
+            print(rule)
+        return 0
+    if args.rule:
+        unknown = set(args.rule) - set(RULES)
+        if unknown:
+            print(f"error: unknown rule(s) {sorted(unknown)}; have "
+                  f"{sorted(RULES)}", file=sys.stderr)
+            return 2
+    paths = args.paths or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))), "tpu_dist_nn",
+    )]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    baseline_path = args.baseline or None
+    try:
+        result = run_lint(paths, rules=args.rule,
+                          baseline_path=baseline_path)
+    except LintError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.update_baseline:
+        if not baseline_path:
+            print("error: --update-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        old = load_baseline(baseline_path)
+        save_baseline(baseline_path, result["all"], old)
+        print(f"baseline updated: {len(result['all'])} entries -> "
+              f"{baseline_path}")
+        return 0
+    for f in result["new"]:
+        print(f.render())
+    for fp in result["stale_baseline"]:
+        print(f"stale baseline entry (matches nothing): {fp}",
+              file=sys.stderr)
+    print(summary_line(result))
+    if args.json:
+        import json as _json
+
+        print(_json.dumps({
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "symbol": f.symbol, "detail": f.detail,
+                 "fingerprint": f.fingerprint}
+                for f in result["new"]
+            ],
+            "baselined": len(result["baselined"]),
+            "suppressed": result["suppressed_total"],
+            "stale_baseline": result["stale_baseline"],
+            "files": result["files"],
+        }))
+    return 1 if result["new"] else 0

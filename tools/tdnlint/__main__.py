@@ -1,0 +1,21 @@
+import sys
+
+if __package__ in (None, ""):
+    # ``python tools/tdnlint`` (path execution): register the package
+    # by file location so the relative imports inside it resolve.
+    import importlib.util
+    import os
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "tdnlint", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tdnlint"] = mod
+    spec.loader.exec_module(mod)
+    sys.exit(mod.main())
+else:
+    from . import main
+
+    sys.exit(main())

@@ -1,0 +1,956 @@
+"""The five tdnlint rules. Each is ``fn(project) -> [Finding]``.
+
+Every rule encodes one bug class this repo has actually shipped and
+had caught in review (docs/STATIC_ANALYSIS.md names the incidents):
+
+* ``lock-discipline`` — ``# guarded-by: <lock>``-annotated attributes
+  accessed outside ``with self.<lock>:``.
+* ``tick-purity`` — blocking primitives (sleep / socket / urllib /
+  subprocess / requests / http.client) reachable from callbacks the
+  RuntimeSampler tick runs.
+* ``metric-series-lifecycle`` — replica/target-labeled metric families
+  with no ``remove``/``remove_matching`` in the defining module.
+* ``admin-actuation`` — GET-mounted MetricsServer routes calling
+  state-mutating pool/autoscaler verbs.
+* ``jit-purity`` — jitted functions (and kernel helpers they trace)
+  calling ``time.*`` / python ``random`` / ``print`` or declaring
+  ``global``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    ClassInfo,
+    Finding,
+    FuncInfo,
+    Project,
+    attr_root,
+    call_name,
+    iter_body_nodes,
+    local_bindings,
+)
+
+# ----------------------------------------------------------------------
+# lock-discipline
+# ----------------------------------------------------------------------
+
+# Construction happens-before publication: no other thread can hold a
+# reference while these run, so unguarded writes there are fine.
+_CONSTRUCTION_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+def rule_lock_discipline(project: Project):
+    findings = []
+    for mod in project.modules:
+        for ci in mod.classes.values():
+            if not ci.guarded:
+                continue
+            lock_names = set(ci.guarded.values())
+            for mname, fi in ci.methods.items():
+                if mname in _CONSTRUCTION_METHODS:
+                    continue
+                held = set()
+                for ln in (fi.node.lineno, fi.node.lineno - 1):
+                    lock = mod.holds_by_line.get(ln)
+                    if lock:
+                        held.add(lock)
+                _visit_lock_scope(
+                    mod, ci, fi, fi.node, held, lock_names, findings
+                )
+    return findings
+
+
+def _visit_lock_scope(mod, ci, fi, node, held, lock_names, findings,
+                      *, top=True):
+    """Recursive walk tracking which of the class's locks are held."""
+    children = ast.iter_child_nodes(node) if top else [node]
+    for child in children:
+        _visit_lock_node(mod, ci, fi, child, held, lock_names, findings)
+
+
+def _visit_lock_node(mod, ci, fi, node, held, lock_names, findings):
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        newly = set(held)
+        for item in node.items:
+            ce = item.context_expr
+            _visit_lock_node(mod, ci, fi, ce, held, lock_names, findings)
+            if isinstance(ce, ast.Attribute) and isinstance(
+                ce.value, ast.Name
+            ) and ce.value.id in ("self", "cls") \
+                    and ce.attr in lock_names:
+                newly.add(ce.attr)
+        for b in node.body:
+            _visit_lock_node(mod, ci, fi, b, newly, lock_names, findings)
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        # A closure runs later, after the enclosing with exited: only
+        # its OWN caller-holds annotation counts.
+        inner_held = set()
+        if not isinstance(node, ast.Lambda):
+            for ln in (node.lineno, node.lineno - 1):
+                lock = mod.holds_by_line.get(ln)
+                if lock:
+                    inner_held.add(lock)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for b in body:
+            _visit_lock_node(
+                mod, ci, fi, b, inner_held, lock_names, findings
+            )
+        return
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id in ("self", "cls"):
+        lock = ci.guarded.get(node.attr)
+        if lock and lock not in held:
+            findings.append(Finding(
+                "lock-discipline", mod.relpath, node.lineno,
+                fi.qualname, f"{node.attr}",
+                f"{ci.name}.{node.attr} is '# guarded-by: {lock}' but "
+                f"accessed in {fi.qualname} without 'with "
+                f"self.{lock}:' (annotate the method '# caller-holds: "
+                f"{lock}' if every caller already holds it)",
+            ))
+        # fall through: the value is a Name, nothing below to visit
+        return
+    for child in ast.iter_child_nodes(node):
+        _visit_lock_node(mod, ci, fi, child, held, lock_names, findings)
+
+
+# ----------------------------------------------------------------------
+# tick-purity
+# ----------------------------------------------------------------------
+
+# RuntimeSampler registration verb -> the protocol method the tick
+# calls on the registered object (obs/runtime.py sample_once).
+_TICK_PROTOCOL = {
+    "add_timeseries": "collect",
+    "add_slo_tracker": "evaluate",
+    "add_autoscaler": "tick",
+    "add_incident_recorder": "check",
+}
+_BLOCKING_MODULE_ROOTS = {
+    "socket", "subprocess", "urllib", "requests", "http",
+}
+# When a method call's receiver cannot be typed, edges go to every
+# project class defining the method — unless the name is this common.
+_MAX_AMBIGUOUS_TARGETS = 8
+
+
+def _resolve_class_expr(project, mod, func_expr):
+    """A constructor expression's class: ``Autoscaler(...)``'s func."""
+    if isinstance(func_expr, ast.Name):
+        name = func_expr.id
+        if name in mod.classes:
+            return mod.classes[name]
+        ci = project.resolve_imported_class(mod, name)
+        if ci is not None:
+            return ci
+        cands = project.class_index.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+    elif isinstance(func_expr, ast.Attribute):
+        cands = project.class_index.get(func_expr.attr, [])
+        if len(cands) == 1:
+            return cands[0]
+    return None
+
+
+def _attr_types(project, ci: ClassInfo) -> dict:
+    """attr name -> ClassInfo, inferred from ``__init__``:
+    ``self.a = SomeClass(...)`` or ``self.a = <param annotated
+    SomeClass>`` (``X | None`` annotations take the class side)."""
+    out = {}
+    init = ci.methods.get("__init__")
+    if init is None:
+        return out
+    ann = {}
+    args = init.node.args
+    for a in list(args.posonlyargs) + list(args.args) + list(
+        args.kwonlyargs
+    ):
+        t = a.annotation
+        if isinstance(t, ast.BinOp) and isinstance(t.op, ast.BitOr):
+            t = t.left
+        if isinstance(t, ast.Name):
+            ann[a.arg] = t.id
+        elif isinstance(t, ast.Attribute):
+            ann[a.arg] = t.attr
+        elif isinstance(t, ast.Constant) and isinstance(t.value, str):
+            ann[a.arg] = t.value.strip('"').split(".")[-1]
+    mod = ci.module
+    for node in iter_body_nodes(init.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not (isinstance(t, ast.Attribute) and isinstance(
+                t.value, ast.Name
+            ) and t.value.id == "self"):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                c = _resolve_class_expr(project, mod, v.func)
+                if c is None:
+                    c = _factory_result_class(project, v)
+                if c is not None:
+                    out.setdefault(t.attr, c)
+            elif isinstance(v, ast.Name) and v.id in ann:
+                cname = ann[v.id]
+                if cname in mod.classes:
+                    out.setdefault(t.attr, mod.classes[cname])
+                else:
+                    c = project.resolve_imported_class(mod, cname)
+                    if c is None:
+                        cands = project.class_index.get(cname, [])
+                        c = cands[0] if len(cands) == 1 else None
+                    if c is not None:
+                        out.setdefault(t.attr, c)
+    return out
+
+
+def _factory_result_class(project, call: ast.Call):
+    """Type the result of the registry's family factories: ``X =
+    reg.gauge(...)`` / ``REGISTRY.counter(...)`` is a ``Metric`` —
+    the analyzer knows the registry idiom, so metric mutation methods
+    (``remove``, ``set``, ...) resolve exactly instead of
+    over-approximating onto same-named pool methods."""
+    kind = call_name(call)
+    if kind and kind[0] == "attr" and kind[2] in (
+        "gauge", "counter", "histogram"
+    ):
+        cands = project.class_index.get("Metric", [])
+        if len(cands) == 1:
+            return cands[0]
+    return None
+
+
+def _blocking_in_call(mod, node) -> str | None:
+    """The blocking primitive a Call hits directly, or None."""
+    kind = call_name(node)
+    if kind is None:
+        return None
+    if kind[0] == "attr":
+        _, recv, m = kind
+        if m == "sleep":
+            return "sleep()"
+        # Module-rooted only when the root NAME really is that stdlib
+        # module in this file (a local variable named ``requests`` is
+        # not the requests library).
+        root = attr_root(recv)
+        if root in _BLOCKING_MODULE_ROOTS and root in mod.imports \
+                and mod.imports[root][0] == "module" \
+                and mod.imports[root][1].split(".")[0] == root:
+            return f"{root}.{m}"
+        return None
+    _, n = kind
+    entry = mod.imports.get(n)
+    if entry and entry[0] == "symbol":
+        top = entry[1].split(".")[0]
+        if top in _BLOCKING_MODULE_ROOTS or (
+            top == "time" and entry[2] == "sleep"
+        ):
+            return f"{entry[1]}.{entry[2]}"
+    return None
+
+
+def _call_edges(project, fi: FuncInfo, bindings, attr_types):
+    """Outgoing call-graph edges of one function body (nested function
+    bodies excluded — they run later; a nested function gets an edge
+    only when called by name; thread targets never do)."""
+    mod = fi.module
+    edges = []
+    for node in iter_body_nodes(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = call_name(node)
+        if kind is None:
+            continue
+        if kind[0] == "name":
+            n = kind[1]
+            nested = mod.functions.get(f"{fi.qualname}.<locals>.{n}")
+            if nested is not None:
+                edges.append((nested, node.lineno))
+                continue
+            target = mod.functions.get(n)
+            if target is not None and target.class_name is None:
+                edges.append((target, node.lineno))
+                continue
+            imported = project.resolve_imported_function(mod, n)
+            if imported is not None:
+                edges.append((imported, node.lineno))
+                continue
+            ci = mod.classes.get(n) or project.resolve_imported_class(
+                mod, n
+            )
+            if ci is not None and "__init__" in ci.methods:
+                edges.append((ci.methods["__init__"], node.lineno))
+            continue
+        _, recv, m = kind
+        resolved = False
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls") \
+                and fi.class_name:
+            own = mod.classes.get(fi.class_name)
+            if own is not None and m in own.methods:
+                edges.append((own.methods[m], node.lineno))
+                resolved = True
+            elif own is not None:
+                for base in own.bases:
+                    for cand in project.class_index.get(base, []):
+                        if m in cand.methods:
+                            edges.append(
+                                (cand.methods[m], node.lineno)
+                            )
+                            resolved = True
+        elif isinstance(recv, ast.Name):
+            # A bare-name receiver is NEVER over-approximated: either
+            # it resolves (local constructor binding, project import)
+            # or it is a local/param of unknown — usually stdlib —
+            # type, where name-matched edges were the main source of
+            # false chains (``t.start()`` on a threading.Thread must
+            # not become ``ReplicaPool.start``).
+            resolved = True
+            x = recv.id
+            if x in bindings:
+                b = bindings[x]
+                if isinstance(b, ast.Call):
+                    c = _resolve_class_expr(project, mod, b.func) \
+                        or _factory_result_class(project, b)
+                    if c is not None and m in c.methods:
+                        edges.append((c.methods[m], node.lineno))
+            elif x in mod.imports:
+                entry = mod.imports[x]
+                if entry[0] == "module":
+                    tm = project.resolve_module(entry[1])
+                    if tm is not None and m in tm.functions:
+                        edges.append((tm.functions[m], node.lineno))
+                else:
+                    c = project.resolve_imported_class(mod, x)
+                    if c is not None and m in c.methods:
+                        edges.append((c.methods[m], node.lineno))
+        elif isinstance(recv, ast.Attribute) and isinstance(
+            recv.value, ast.Name
+        ) and recv.value.id in ("self", "cls") and fi.class_name:
+            t = attr_types.get(recv.attr)
+            if t is not None:
+                if m in t.methods:
+                    edges.append((t.methods[m], node.lineno))
+                resolved = True
+        elif isinstance(recv, ast.Call):
+            # Constructor-call receiver: resolves to a project class or
+            # it is external (threading.Thread(...).start()) — never
+            # over-approximated.
+            resolved = True
+            c = _resolve_class_expr(project, mod, recv.func) \
+                or _factory_result_class(project, recv)
+            if c is not None and m in c.methods:
+                edges.append((c.methods[m], node.lineno))
+        if not resolved:
+            # Attribute receivers rooted at a LOCAL binding of unknown
+            # type (``rep.proc.poll()``) stay edge-free, same as bare
+            # local names; roots that are params or globals keep the
+            # name-matched over-approximation (detector methods reach
+            # the ring through their ``rec`` parameter).
+            root = attr_root(recv)
+            if root is not None and root not in ("self", "cls") \
+                    and root in bindings:
+                continue
+            cands = project.method_index.get(m, [])
+            if 0 < len(cands) <= _MAX_AMBIGUOUS_TARGETS:
+                for _ci, cfi in cands:
+                    edges.append((cfi, node.lineno))
+    return edges
+
+
+def _tick_entries(project):
+    """(FuncInfo, label) tick entry points: RuntimeSampler's own
+    sampling methods plus the protocol method of every class registered
+    through an ``add_*`` verb (resolved from the registration site)."""
+    entries = []
+    for ci in project.class_index.get("RuntimeSampler", []):
+        for name in ("sample_once", "_safe_sample"):
+            if name in ci.methods:
+                entries.append(
+                    (ci.methods[name], f"RuntimeSampler.{name}")
+                )
+    for mod in project.modules:
+        for fi in list(mod.functions.values()):
+            bindings = None
+            for node in iter_body_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = call_name(node)
+                if kind is None or kind[0] != "attr" \
+                        or kind[2] not in _TICK_PROTOCOL or not node.args:
+                    continue
+                proto = _TICK_PROTOCOL[kind[2]]
+                arg = node.args[0]
+                target_cls = None
+                if isinstance(arg, ast.Call):
+                    target_cls = _resolve_class_expr(
+                        project, mod, arg.func
+                    )
+                elif isinstance(arg, ast.Name):
+                    if bindings is None:
+                        bindings = local_bindings(fi.node)
+                    b = bindings.get(arg.id)
+                    if isinstance(b, ast.Call):
+                        target_cls = _resolve_class_expr(
+                            project, mod, b.func
+                        )
+                if target_cls is not None:
+                    m = target_cls.methods.get(proto)
+                    if m is not None:
+                        entries.append(
+                            (m, f"{target_cls.name}.{proto}")
+                        )
+                    continue
+                # Unresolved registration: over-approximate with every
+                # project class implementing the protocol method.
+                cands = project.method_index.get(proto, [])
+                if 0 < len(cands) <= _MAX_AMBIGUOUS_TARGETS:
+                    for tci, tfi in cands:
+                        entries.append((tfi, f"{tci.name}.{proto}"))
+    return entries
+
+
+def rule_tick_purity(project: Project):
+    findings = []
+    entries = _tick_entries(project)
+    if not entries:
+        return findings
+    attr_type_cache: dict[int, dict] = {}
+    reported = set()
+    for entry, label in entries:
+        # BFS with the caller chain threaded through for the message.
+        queue = [(entry, [label])]
+        visited = {id(entry)}
+        while queue:
+            fi, path = queue.pop(0)
+            mod = fi.module
+            bindings = local_bindings(fi.node)
+            own_class = mod.classes.get(fi.class_name) \
+                if fi.class_name else None
+            if own_class is not None:
+                key = id(own_class)
+                if key not in attr_type_cache:
+                    attr_type_cache[key] = _attr_types(
+                        project, own_class
+                    )
+                attr_types = attr_type_cache[key]
+            else:
+                attr_types = {}
+            for node in iter_body_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                prim = _blocking_in_call(mod, node)
+                if prim is None:
+                    continue
+                key = (mod.relpath, node.lineno, prim)
+                if key in reported:
+                    continue
+                reported.add(key)
+                parts = list(path)
+                if fi.qualname not in parts[-1]:
+                    parts.append(fi.qualname)
+                via = " -> ".join(parts)
+                findings.append(Finding(
+                    "tick-purity", mod.relpath, node.lineno,
+                    fi.qualname, prim,
+                    f"blocking call {prim} is reachable from the "
+                    f"RuntimeSampler tick (via {via}); the tick must "
+                    "stay non-blocking — actuate on a thread",
+                ))
+            for target, _line in _call_edges(
+                project, fi, bindings, attr_types
+            ):
+                if id(target) in visited:
+                    continue
+                visited.add(id(target))
+                nxt = path if fi.qualname in path[-1] \
+                    else path + [fi.qualname]
+                queue.append((target, nxt))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# metric-series-lifecycle
+# ----------------------------------------------------------------------
+
+# Label names whose value space churns with fleet membership; a family
+# keyed on one of these grows unboundedly unless something prunes it.
+_DYNAMIC_LABELS = {"replica", "target"}
+_FAMILY_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def rule_metric_lifecycle(project: Project):
+    findings = []
+    for mod in project.modules:
+        defs = []  # (receiver_key, family, line, labels)
+        removals = set()  # receiver keys with a remove/remove_matching
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                kind = call_name(node.value)
+                if kind and kind[0] == "attr" \
+                        and kind[2] in _FAMILY_FACTORIES:
+                    labels = _const_labels(node.value)
+                    dyn = labels & _DYNAMIC_LABELS
+                    if dyn:
+                        family = _first_str_arg(node.value)
+                        for t in node.targets:
+                            rk = _receiver_key(t)
+                            if rk and family:
+                                defs.append(
+                                    (rk, family, node.lineno,
+                                     sorted(dyn))
+                                )
+            elif isinstance(node, ast.Call):
+                kind = call_name(node)
+                if kind and kind[0] == "attr" and kind[2] in (
+                    "remove", "remove_matching"
+                ):
+                    rk = _receiver_key(kind[1])
+                    if rk:
+                        removals.add(rk)
+        for rk, family, line, dyn in defs:
+            if rk in removals:
+                continue
+            findings.append(Finding(
+                "metric-series-lifecycle", mod.relpath, line,
+                enclosing_symbol_safe(mod, line), family,
+                f"family '{family}' is keyed on churning label(s) "
+                f"{dyn} but this module never calls remove/"
+                "remove_matching on it — departed targets would "
+                "expose stale series forever",
+            ))
+    return findings
+
+
+def enclosing_symbol_safe(mod, line):
+    from .core import enclosing_symbol
+
+    return enclosing_symbol(mod, line)
+
+
+def _const_labels(call: ast.Call) -> set:
+    for kw in call.keywords:
+        if kw.arg in ("labels", "labelnames") and isinstance(
+            kw.value, (ast.Tuple, ast.List)
+        ):
+            out = set()
+            for e in kw.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, str
+                ):
+                    out.add(e.value)
+            return out
+    return set()
+
+
+def _first_str_arg(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _receiver_key(node) -> tuple | None:
+    if isinstance(node, ast.Name):
+        return ("name", node.id)
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id in ("self", "cls"):
+        return ("self", node.attr)
+    return None
+
+
+# ----------------------------------------------------------------------
+# admin-actuation
+# ----------------------------------------------------------------------
+
+# ReplicaPool / Autoscaler methods that CHANGE fleet state; reachable
+# from a GET route means a crawler can actuate the fleet (the PR 12
+# drain/undrain/scale-were-GET bug, made structural).
+_MUTATORS = {
+    "drain", "undrain", "remove", "decommission", "restart_replica",
+    "spawn_local", "set_override", "clear_override",
+}
+_ROUTE_DEPTH = 4
+
+
+def rule_admin_actuation(project: Project):
+    findings = []
+    seen_handlers = set()
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            exprs = []
+            kind = call_name(node)
+            if kind and kind[0] == "attr" and kind[2] == "add_routes" \
+                    and node.args:
+                exprs.append(node.args[0])
+            for kw in node.keywords:
+                if kw.arg == "routes":
+                    exprs.append(kw.value)
+            for expr in exprs:
+                for handler in _route_handlers(
+                    project, mod, expr, node, _ROUTE_DEPTH
+                ):
+                    if id(handler[0]) in seen_handlers:
+                        continue
+                    seen_handlers.add(id(handler[0]))
+                    _scan_get_handler(project, handler, findings)
+    return findings
+
+
+def _route_handlers(project, mod, expr, site, depth):
+    """Resolve a routes-expression to [(handler_ast, FuncInfo|None,
+    Module)] — dict literals, locally built+returned dicts, and
+    factory-call indirection all resolve."""
+    if depth <= 0:
+        return []
+    out = []
+    if isinstance(expr, ast.Dict):
+        for v in expr.values:
+            out.extend(_handler_value(project, mod, v, site, depth))
+    elif isinstance(expr, ast.Call):
+        target = _called_function(project, mod, expr)
+        if target is not None:
+            out.extend(
+                _factory_handlers(project, target, depth - 1)
+            )
+    elif isinstance(expr, ast.Name):
+        # dict built in the enclosing function then mounted by name
+        encl = _enclosing_function(mod, site)
+        if encl is not None:
+            out.extend(_dict_var_handlers(
+                project, mod, encl, expr.id, depth - 1
+            ))
+    return out
+
+
+def _handler_value(project, mod, v, site, depth):
+    if isinstance(v, ast.Lambda):
+        return [(v, None, mod)]
+    if isinstance(v, ast.Name):
+        encl = _enclosing_function(mod, site)
+        if encl is not None:
+            nested = mod.functions.get(
+                f"{encl.qualname}.<locals>.{v.id}"
+            )
+            if nested is not None:
+                return [(nested.node, nested, mod)]
+        target = mod.functions.get(v.id)
+        if target is not None:
+            return [(target.node, target, mod)]
+        imported = project.resolve_imported_function(mod, v.id)
+        if imported is not None:
+            return [(imported.node, imported, imported.module)]
+        return []
+    if isinstance(v, ast.Call):
+        # A factory returning ONE handler closure
+        # (fleet_trace_route(pool)) — its returned nested functions.
+        target = _called_function(project, mod, v)
+        if target is not None:
+            return _returned_closures(project, target, depth - 1)
+    return []
+
+
+def _called_function(project, mod, call: ast.Call):
+    kind = call_name(call)
+    if kind is None:
+        return None
+    if kind[0] == "name":
+        target = mod.functions.get(kind[1])
+        if target is not None and target.class_name is None:
+            return target
+        return project.resolve_imported_function(mod, kind[1])
+    return None
+
+
+def _enclosing_function(mod, node):
+    from .core import enclosing_symbol
+
+    qual = enclosing_symbol(mod, node.lineno)
+    return mod.functions.get(qual)
+
+
+def _factory_handlers(project, fi: FuncInfo, depth):
+    """Handlers of a factory that RETURNS a routes dict."""
+    mod = fi.module
+    out = []
+    for node in iter_body_nodes(fi.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Dict):
+                for v in node.value.values:
+                    out.extend(_handler_value(
+                        project, mod, v, fi.node, depth
+                    ))
+            elif isinstance(node.value, ast.Name):
+                out.extend(_dict_var_handlers(
+                    project, mod, fi, node.value.id, depth
+                ))
+    return out
+
+
+def _dict_var_handlers(project, mod, fi: FuncInfo, varname, depth):
+    """A routes dict built locally: its literal values, plus
+    ``routes[...] = f`` subscript-assigns, plus ``routes.update(F())``
+    factory merges."""
+    if depth <= 0:
+        return []
+    out = []
+    for node in iter_body_nodes(fi.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == varname \
+                        and isinstance(node.value, ast.Dict):
+                    for v in node.value.values:
+                        out.extend(_handler_value(
+                            project, mod, v, fi.node, depth
+                        ))
+                elif isinstance(t, ast.Subscript) and isinstance(
+                    t.value, ast.Name
+                ) and t.value.id == varname:
+                    out.extend(_handler_value(
+                        project, mod, node.value, fi.node, depth
+                    ))
+        elif isinstance(node, ast.Call):
+            kind = call_name(node)
+            if kind and kind[0] == "attr" and kind[2] == "update" \
+                    and isinstance(kind[1], ast.Name) \
+                    and kind[1].id == varname and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Dict):
+                    for v in arg.values:
+                        out.extend(_handler_value(
+                            project, mod, v, fi.node, depth
+                        ))
+                elif isinstance(arg, ast.Call):
+                    target = _called_function(project, mod, arg)
+                    if target is not None:
+                        out.extend(_factory_handlers(
+                            project, target, depth - 1
+                        ))
+    return out
+
+
+def _returned_closures(project, fi: FuncInfo, depth):
+    mod = fi.module
+    out = []
+    for node in iter_body_nodes(fi.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Name):
+                nested = mod.functions.get(
+                    f"{fi.qualname}.<locals>.{node.value.id}"
+                )
+                if nested is not None:
+                    out.append((nested.node, nested, mod))
+            elif isinstance(node.value, ast.Lambda):
+                out.append((node.value, None, mod))
+    return out
+
+
+def _scan_get_handler(project, handler, findings):
+    node, fi, mod = handler
+    qual = fi.qualname if fi is not None else "<lambda>"
+    name = fi.name if fi is not None else "<lambda>"
+    # The handler body plus one level of local helper calls.
+    bodies = [node]
+    if fi is not None:
+        for n in iter_body_nodes(node):
+            if isinstance(n, ast.Call):
+                kind = call_name(n)
+                if kind and kind[0] == "name":
+                    for cand in (
+                        f"{fi.qualname}.<locals>.{kind[1]}",
+                        kind[1],
+                    ):
+                        helper = mod.functions.get(cand)
+                        if helper is not None \
+                                and helper.class_name is None:
+                            bodies.append(helper.node)
+                            break
+                    # also: helpers nested in the same factory
+                    if "<locals>" in fi.qualname:
+                        parent = fi.qualname.rsplit(".<locals>.", 1)[0]
+                        helper = mod.functions.get(
+                            f"{parent}.<locals>.{kind[1]}"
+                        )
+                        if helper is not None:
+                            bodies.append(helper.node)
+    seen = set()
+    for body in bodies:
+        if id(body) in seen:
+            continue
+        seen.add(id(body))
+        walker = ast.walk(body) if isinstance(body, ast.Lambda) \
+            else iter_body_nodes(body, skip_nested=False)
+        for n in walker:
+            if not isinstance(n, ast.Call):
+                continue
+            kind = call_name(n)
+            if kind and kind[0] == "attr" and kind[2] in _MUTATORS:
+                findings.append(Finding(
+                    "admin-actuation", mod.relpath, n.lineno,
+                    qual, f"{name}:{kind[2]}",
+                    f"GET-mounted route handler '{name}' calls "
+                    f"state-mutating '{kind[2]}()' — fleet actuation "
+                    "belongs on post_routes=/add_post_routes (a GET "
+                    "sweep must never actuate)",
+                ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# jit-purity
+# ----------------------------------------------------------------------
+
+_KERNEL_DIR_MARKERS = ("/kernels/", "/models/")
+
+
+def _is_jit_expr(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "jit"
+    ) or (isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _jit_target_names(call: ast.Call):
+    """Function names a ``jax.jit(...)`` call compiles: the bare
+    argument, or the first argument of a partial(...) wrapper."""
+    if not call.args:
+        return []
+    arg = call.args[0]
+    if isinstance(arg, ast.Name):
+        return [arg.id]
+    if isinstance(arg, ast.Call):
+        f = arg.func
+        is_partial = (
+            isinstance(f, ast.Name) and f.id == "partial"
+        ) or (isinstance(f, ast.Attribute) and f.attr == "partial")
+        if is_partial and arg.args and isinstance(
+            arg.args[0], ast.Name
+        ):
+            return [arg.args[0].id]
+    return []
+
+
+def rule_jit_purity(project: Project):
+    findings = []
+    for mod in project.modules:
+        jitted: dict[str, FuncInfo] = {}
+
+        def mark(name, near_line):
+            # nearest definition: nested defs first (jax.jit(step)
+            # inside a factory refers to the local step), then module
+            # level.
+            best = None
+            for qual, fi in mod.functions.items():
+                if fi.name != name:
+                    continue
+                if best is None or abs(
+                    fi.node.lineno - near_line
+                ) < abs(best.node.lineno - near_line):
+                    best = fi
+            if best is not None:
+                jitted.setdefault(best.qualname, best)
+
+        for qual, fi in mod.functions.items():
+            for dec in getattr(fi.node, "decorator_list", ()):
+                if _is_jit_expr(dec):
+                    jitted.setdefault(qual, fi)
+                elif isinstance(dec, ast.Call):
+                    f = dec.func
+                    is_partial = (
+                        isinstance(f, ast.Name) and f.id == "partial"
+                    ) or (
+                        isinstance(f, ast.Attribute)
+                        and f.attr == "partial"
+                    )
+                    if (is_partial and dec.args
+                            and _is_jit_expr(dec.args[0])) \
+                            or _is_jit_expr(f):
+                        jitted.setdefault(qual, fi)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                for name in _jit_target_names(node):
+                    mark(name, node.lineno)
+        # Kernel modules: helpers a jitted function traces into are
+        # under the same purity contract.
+        if any(m in "/" + mod.relpath for m in _KERNEL_DIR_MARKERS):
+            work = list(jitted.values())
+            while work:
+                fi = work.pop()
+                for node in iter_body_nodes(fi.node,
+                                            skip_nested=False):
+                    if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name
+                    ):
+                        helper = mod.functions.get(node.func.id)
+                        if helper is not None \
+                                and helper.qualname not in jitted:
+                            jitted[helper.qualname] = helper
+                            work.append(helper)
+        for qual, fi in sorted(jitted.items()):
+            findings.extend(_jit_violations(mod, fi))
+    return findings
+
+
+def _jit_violations(mod, fi: FuncInfo):
+    out = []
+    py_random = any(
+        entry == ("module", "random") for entry in mod.imports.values()
+    )
+    for node in iter_body_nodes(fi.node, skip_nested=False):
+        if isinstance(node, ast.Global):
+            out.append(Finding(
+                "jit-purity", mod.relpath, node.lineno, fi.qualname,
+                "global",
+                f"jitted function {fi.qualname} declares 'global' — "
+                "mutating module state under trace runs once at "
+                "compile time, not per call",
+            ))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "print":
+            out.append(Finding(
+                "jit-purity", mod.relpath, node.lineno, fi.qualname,
+                "print",
+                f"print() inside jitted function {fi.qualname} fires "
+                "at trace time only — use jax.debug.print for "
+                "per-call output",
+            ))
+            continue
+        root = attr_root(f) if isinstance(f, ast.Attribute) else None
+        if root == "time":
+            out.append(Finding(
+                "jit-purity", mod.relpath, node.lineno, fi.qualname,
+                f"time.{f.attr}",
+                f"time.{f.attr}() inside jitted function "
+                f"{fi.qualname} is evaluated once at trace time and "
+                "baked into the compiled program",
+            ))
+        elif root == "random" and py_random:
+            out.append(Finding(
+                "jit-purity", mod.relpath, node.lineno, fi.qualname,
+                f"random.{f.attr}",
+                f"python random.{f.attr}() inside jitted function "
+                f"{fi.qualname} draws once at trace time — use "
+                "jax.random with an explicit key",
+            ))
+    return out
+
+
+RULES = {
+    "lock-discipline": rule_lock_discipline,
+    "tick-purity": rule_tick_purity,
+    "metric-series-lifecycle": rule_metric_lifecycle,
+    "admin-actuation": rule_admin_actuation,
+    "jit-purity": rule_jit_purity,
+}

@@ -34,6 +34,10 @@
                  GET /debug/bundle and save the zip; against a router
                  the capture spans the whole fleet with the traces
                  stitched
+``tdn lint``   — machine-checked project invariants (tools/tdnlint):
+                 lock discipline, tick purity, metric-series
+                 lifecycle, admin actuation, jit purity — exit 1 on
+                 any non-baselined finding (docs/STATIC_ANALYSIS.md)
 """
 
 from __future__ import annotations
@@ -2932,6 +2936,47 @@ def cmd_import_keras(args) -> int:
     return 0
 
 
+def _load_tdnlint():
+    """Load tools/tdnlint by path: the analyzer lives next to the
+    package in a repo checkout (it is a development gate, not a
+    runtime dependency, so it is not shipped inside tpu_dist_nn)."""
+    if "tdnlint" in sys.modules:
+        return sys.modules["tdnlint"]
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "tools", "tdnlint", "__init__.py")
+    if not os.path.exists(pkg):
+        raise FileNotFoundError(
+            "tools/tdnlint not found next to the tpu_dist_nn package — "
+            "`tdn lint` runs from a repository checkout"
+        )
+    spec = importlib.util.spec_from_file_location(
+        "tdnlint", pkg,
+        submodule_search_locations=[os.path.dirname(pkg)],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tdnlint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cmd_lint(args) -> int:
+    tdnlint = _load_tdnlint()
+    argv = list(args.paths or ())
+    for rule in args.rule or ():
+        argv += ["--rule", rule]
+    if args.baseline is not None:
+        argv += ["--baseline", args.baseline]
+    if args.update_baseline:
+        argv.append("--update-baseline")
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.lint_json:
+        argv.append("--json")
+    return tdnlint.main(argv)
+
+
 def cmd_doctor(args) -> int:
     """Environment self-check: what a support request needs up front —
     backend, devices, native library, kernel lowering, oracle parity.
@@ -3850,6 +3895,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="HTTP timeout in seconds (default 10; the "
                         "request itself gets +30s for the capture)")
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser(
+        "lint",
+        help="machine-checked project invariants (tools/tdnlint): "
+             "lock discipline, tick purity, metric-series lifecycle, "
+             "admin actuation, jit purity — exit 1 on any "
+             "non-baselined finding (docs/STATIC_ANALYSIS.md)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/packages to scan (default: the "
+                        "tpu_dist_nn package)")
+    p.add_argument("--rule", action="append", metavar="RULE",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default tools/tdnlint/"
+                        "baseline.json; pass '' to disable)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the current finding "
+                        "set (keeps existing justifications)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule ids and exit")
+    p.add_argument("--json", dest="lint_json", action="store_true",
+                   help="also print one machine-readable JSON line")
+    p.set_defaults(fn=cmd_lint)
 
     return parser
 

@@ -99,6 +99,7 @@ class _TokenBucket:
         self._burst = float(burst)
         self._lock = threading.Lock()
         # key -> [tokens, last_refill, suppressed_since_last_emit]
+        # guarded-by: _lock
         self._state: dict = {}
 
     def allow(self, key, now: float | None = None) -> tuple[bool, int]:
@@ -138,11 +139,12 @@ class LogRing:
         import collections
 
         self.capacity = int(capacity)
+        # guarded-by: _lock
         self._buf: "collections.deque" = collections.deque(
             maxlen=self.capacity
         )
         self._lock = threading.Lock()
-        self.dropped_total = 0
+        self.dropped_total = 0  # guarded-by: _lock
 
     def append(self, record: dict) -> None:
         with self._lock:

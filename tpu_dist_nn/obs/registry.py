@@ -83,10 +83,11 @@ class _Child:
 
     def __init__(self, kind, buckets, lock):
         self.kind = kind
-        self.value = 0.0
-        self.sum = 0.0
+        self.value = 0.0  # guarded-by: _lock
+        self.sum = 0.0  # guarded-by: _lock
         self._buckets = buckets
         self._lock = lock
+        # guarded-by: _lock
         self.counts = [0] * (len(buckets) + 1) if buckets is not None else None
 
     def _expect(self, *kinds) -> None:
@@ -154,7 +155,7 @@ class Metric:
                 f"{self.buckets}"
             )
         self._lock = threading.Lock()
-        self._children: dict[tuple, _Child] = {}
+        self._children: dict[tuple, _Child] = {}  # guarded-by: _lock
         if not self.labelnames:
             # Unlabeled families materialize at 0 immediately: an
             # error-class counter born at its first increment is
@@ -171,7 +172,10 @@ class Metric:
                 f"{tuple(labels)}"
             )
         key = tuple(str(labels[ln]) for ln in self.labelnames)
-        child = self._children.get(key)
+        # Lock-free fast path for the repeat-update case (benign race:
+        # a miss falls through to the locked setdefault, which
+        # arbitrates; dict reads are atomic under the GIL).
+        child = self._children.get(key)  # tdnlint: disable=lock-discipline
         if child is None:
             with self._lock:
                 child = self._children.setdefault(
@@ -266,7 +270,7 @@ class Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, Metric] = {}
+        self._metrics: dict[str, Metric] = {}  # guarded-by: _lock
 
     def _get_or_create(self, name, help, kind, labelnames, buckets=None):
         with self._lock:

@@ -188,6 +188,7 @@ class SLOTracker:
             labels=("slo",),
         )
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._last: dict = {"objectives": [], "evaluated_at": None}
 
     # ------------------------------------------------------- evaluation

@@ -107,8 +107,8 @@ class TimeSeriesRing:
         # series key -> deque[(bucket_ts, value)], plus the base family
         # each key belongs to (a histogram's _bucket series resolve
         # back to their family for filtered reads).
-        self._data: dict[str, collections.deque] = {}
-        self._family_of: dict[str, str] = {}
+        self._data: dict[str, collections.deque] = {}  # guarded-by: _lock
+        self._family_of: dict[str, str] = {}  # guarded-by: _lock
         # Bucket of the previous collect() pass: a cumulative series
         # first seen on a LATER pass was born since then, and gets a
         # zero baseline at this bucket — without it, an error counter
@@ -116,7 +116,7 @@ class TimeSeriesRing:
         # no computable delta, and an invisible burn (the labeled-
         # children-are-lazy corollary of the registry's unlabeled-
         # counter rule).
-        self._last_collect_bucket: float | None = None
+        self._last_collect_bucket: float | None = None  # guarded-by: _lock
 
     # ------------------------------------------------------------ write
 

@@ -298,15 +298,16 @@ class Tracer:
         self.configure(sample_rate=sample_rate)
         self._capacity = int(capacity)
         self._lock = threading.Lock()
-        self._buf: list[Span] = []  # ring: index _head is the oldest
-        self._head = 0
+        # ring: index _head is the oldest entry
+        self._buf: list[Span] = []  # guarded-by: _lock
+        self._head = 0  # guarded-by: _lock
         self._exemplar_slots = int(exemplar_slots)
         # [(dur, trace_id, [spans of the whole trace])] — the slowest
         # locally-rooted traces ever seen, immune to ring eviction; at
         # most one slot per trace id (a loopback client root and its
         # wire-joined handler must not burn two slots on one trace).
-        self._exemplars: list[tuple[float, str, list[Span]]] = []
-        self.dropped_total = 0
+        self._exemplars: list[tuple[float, str, list[Span]]] = []  # guarded-by: _lock
+        self.dropped_total = 0  # guarded-by: _lock
         # Monotonic completion counter: every finished span gets the
         # next value, and /trace?since=N returns only spans with
         # seq > N — an incremental poller re-downloads nothing. Never
@@ -413,7 +414,7 @@ class Tracer:
         if buf_copy is not None:
             self._keep_exemplar(span, buf_copy)
 
-    def _qualifies_locked(self, dur: float) -> bool:
+    def _qualifies_locked(self, dur: float) -> bool:  # caller-holds: _lock
         return (
             len(self._exemplars) < self._exemplar_slots
             or dur > min(d for d, _, _ in self._exemplars)

@@ -180,25 +180,28 @@ class Autoscaler:
         self.decommission_grace = float(decommission_grace)
         self._clock = clock
         self._lock = threading.RLock()
-        self._above = 0
-        self._below = 0
+        self._above = 0  # guarded-by: _lock
+        self._below = 0  # guarded-by: _lock
+        # guarded-by: _lock
         self._last_up = self._last_down = None  # type: float | None
-        self._history: collections.deque = collections.deque(maxlen=32)
-        self._suppressed_until = 0.0
-        self._override: int | None = None
-        self._spawning = 0
+        self._history: collections.deque = (  # guarded-by: _lock
+            collections.deque(maxlen=32)
+        )
+        self._suppressed_until = 0.0  # guarded-by: _lock
+        self._override: int | None = None  # guarded-by: _lock
+        self._spawning = 0  # guarded-by: _lock
         # target -> removal deadline for POOL-SPAWNED replicas we are
         # draining out (the exit frees their resources, so membership
         # removal is the right end state).
-        self._decommissions: dict[str, float] = {}
+        self._decommissions: dict[str, float] = {}  # guarded-by: _lock
         # Replicas the autoscaler PARKED instead of removed: a
         # non-spawned (static / orchestrator-managed) replica's process
         # is not ours to reclaim, and removing its membership would
         # ratchet the fleet down forever (nothing could ever re-add
         # the address). Parked replicas stay in the pool, drained and
         # rejoin-exempt; scale-up un-parks before it spawns.
-        self._parked: set[str] = set()
-        self._last_signals: dict = {}
+        self._parked: set[str] = set()  # guarded-by: _lock
+        self._last_signals: dict = {}  # guarded-by: _lock
         self.ticks_total = 0
 
     # --------------------------------------------------------- signals
@@ -275,45 +278,52 @@ class Autoscaler:
         self._prune_stale_parks()
         util, burn = self.signals(t)
         AUTOSCALE_UTIL.set(util if util is not None else 0.0)
+        n = self.current_size()
+        # The decision state (stability counters, last signals) shares
+        # the lock with _admit/set_override/status: the tick thread is
+        # normally the only writer, but an operator override landing
+        # mid-decision must not interleave with a half-updated streak.
         with self._lock:
             suppressed = t < self._suppressed_until
             override = self._override
+            desired = n
+            if override is not None:
+                # The stability counters restart when control returns
+                # to auto: a breach tick frozen from BEFORE the
+                # override must not let one noisy scrape afterward
+                # complete the streak.
+                self._above = self._below = 0
+                desired = override
+            else:
+                high = self.target_occupancy * (1.0 + self.hysteresis)
+                low = self.target_occupancy * (1.0 - self.hysteresis)
+                over = (
+                    burn is not None and burn > self.burn_threshold
+                ) or (util is not None and util > high)
+                # Never shrink while the SLO burns: low occupancy with
+                # a burning budget means the fleet is slow, not idle.
+                under = (
+                    util is not None and util < low
+                    and (burn is None or burn <= self.burn_threshold)
+                )
+                self._above = self._above + 1 if over else 0
+                self._below = self._below + 1 if under else 0
+                if self._above >= self.up_stable_ticks:
+                    desired = n + 1
+                elif self._below >= self.down_stable_ticks:
+                    desired = n - 1
+            desired = max(self.min_replicas,
+                          min(self.max_replicas, desired))
+            self._last_signals = {
+                "utilization": round(util, 4) if util is not None
+                else None,
+                "burn_fast": round(burn, 4) if burn is not None
+                else None,
+                "current": n,
+                "desired": desired,
+            }
         AUTOSCALE_SUPPRESSED.set(1.0 if suppressed else 0.0)
-        n = self.current_size()
-        desired = n
-        if override is not None:
-            # The stability counters restart when control returns to
-            # auto: a breach tick frozen from BEFORE the override must
-            # not let one noisy scrape afterward complete the streak.
-            self._above = self._below = 0
-            desired = override
-        else:
-            high = self.target_occupancy * (1.0 + self.hysteresis)
-            low = self.target_occupancy * (1.0 - self.hysteresis)
-            over = (burn is not None and burn > self.burn_threshold) or (
-                util is not None and util > high
-            )
-            # Never shrink while the SLO burns: low occupancy with a
-            # burning budget means the fleet is slow, not idle.
-            under = (
-                util is not None and util < low
-                and (burn is None or burn <= self.burn_threshold)
-            )
-            self._above = self._above + 1 if over else 0
-            self._below = self._below + 1 if under else 0
-            if self._above >= self.up_stable_ticks:
-                desired = n + 1
-            elif self._below >= self.down_stable_ticks:
-                desired = n - 1
-        desired = max(self.min_replicas,
-                      min(self.max_replicas, desired))
         AUTOSCALE_DESIRED.set(desired)
-        self._last_signals = {
-            "utilization": round(util, 4) if util is not None else None,
-            "burn_fast": round(burn, 4) if burn is not None else None,
-            "current": n,
-            "desired": desired,
-        }
         if desired > n:
             self._scale_up(t, n, desired, util, burn,
                            manual=override is not None)

@@ -393,9 +393,9 @@ class ContinuousScheduler:
         self.prefill_hook = None
         # Pending queue + admission ledger (same shape as _Batcher).
         self._cond = threading.Condition()
-        self._pending: collections.deque[dict] = collections.deque()
-        self.pending_rows = 0
-        self._closed = False
+        self._pending: collections.deque[dict] = collections.deque()  # guarded-by: _cond
+        self.pending_rows = 0  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         # _Batcher-compatible counters (runtime sampler contract).
         self.requests_total = 0    # submit() calls admitted to the queue
         self.rows_total = 0        # rows that entered a slot
@@ -693,7 +693,7 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------ loop
 
-    def _pop_admittable(self):
+    def _pop_admittable(self):  # caller-holds: _cond
         """Under ``_cond``: the next (item, row_index) to admit, or
         None. Drops abandoned/failed items from the queue, returning
         their rows to the ledger."""

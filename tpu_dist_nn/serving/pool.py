@@ -161,8 +161,11 @@ class Replica:
         # can turn the scrape loop into a hot spawn loop (each cycle
         # burns an engine compile/warmup).
         self.respawn_backoff_until = 0.0
-        self._channel = None
-        self._stubs: dict[str, object] = {}
+        # (The mutable fields above are guarded by the POOL's lock —
+        # cross-object guarding the lock-discipline rule cannot
+        # express; only the channel state below is this object's own.)
+        self._channel = None  # guarded-by: _lock
+        self._stubs: dict[str, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ wire
@@ -290,7 +293,8 @@ class ReplicaPool:
                  session_capacity: int = 8192,
                  seed: int | None = None):
         self._lock = threading.RLock()
-        self._replicas: dict[str, Replica] = {}
+        self._replicas: dict[str, Replica] = {}  # guarded-by: _lock
+        # guarded-by: _lock
         self._sessions: collections.OrderedDict[str, str] = (
             collections.OrderedDict()
         )
@@ -307,7 +311,7 @@ class ReplicaPool:
         # recorder's drain/failover detector fires on the DELTA, so the
         # choreography itself is an incident trigger without the
         # detector having to diff per-replica states.
-        self.transitions_total = 0
+        self.transitions_total = 0  # guarded-by: _lock
         # Lazy: created at the first multi-replica scrape, shut down in
         # close(). Persistent so a sub-second scrape interval is not a
         # per-tick thread create/teardown churn.

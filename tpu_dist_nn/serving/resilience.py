@@ -161,7 +161,7 @@ class CircuitBreaker:
     # Shared per-target instances: every GrpcClient to the same target
     # in this process sees the same breaker (the point — N clients must
     # not each pay the full failure run before backing off).
-    _registry: dict[str, "CircuitBreaker"] = {}
+    _registry: dict[str, "CircuitBreaker"] = {}  # guarded-by: _registry_lock
     _registry_lock = threading.Lock()
 
     def __init__(self, target: str = "", *, failure_threshold: int = 10,
@@ -175,11 +175,11 @@ class CircuitBreaker:
         self.cooldown_seconds = float(cooldown_seconds)
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = self.CLOSED
-        self._consecutive = 0
-        self._opened_at = 0.0
-        self._probing = False
-        self._probe_started = 0.0
+        self._state = self.CLOSED  # guarded-by: _lock
+        self._consecutive = 0  # guarded-by: _lock
+        self._opened_at = 0.0  # guarded-by: _lock
+        self._probing = False  # guarded-by: _lock
+        self._probe_started = 0.0  # guarded-by: _lock
         self._gauge = BREAKER_STATE.labels(target=target)
         self._gauge.set(0.0)
 
@@ -225,7 +225,7 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
-    def _set_state(self, state: str) -> None:
+    def _set_state(self, state: str) -> None:  # caller-holds: _lock
         self._state = state
         self._gauge.set(self._STATE_VALUE[state])
 
@@ -309,14 +309,14 @@ class GracefulDrain:
         self.grace_seconds = float(grace_seconds)
         self.draining = threading.Event()
         self.drained = threading.Event()
-        self._servers: list = []
+        self._servers: list = []  # guarded-by: _lock
         # RLock: the SIGTERM handler runs ON the main thread — if the
         # signal lands while that thread is already inside begin()'s
         # critical section, a plain Lock would self-deadlock the whole
         # drain. Reentrancy + the _begun latch make the interrupted
         # case collapse to a no-op instead.
         self._lock = threading.RLock()
-        self._begun = False
+        self._begun = False  # guarded-by: _lock
 
     def add_server(self, server) -> None:
         with self._lock:
@@ -372,7 +372,9 @@ class GracefulDrain:
         """Start (or join) the drain; returns the ``drained`` event.
         Idempotent and signal-safe: the teardown path and the SIGTERM
         handler may both call it (even nested on one thread)."""
-        if self._begun:  # fast path, no lock: signal-handler friendly
+        # fast path, no lock: signal-handler friendly (benign race —
+        # the locked re-check below arbitrates)
+        if self._begun:  # tdnlint: disable=lock-discipline
             return self.drained
         with self._lock:
             if self._begun:

@@ -153,12 +153,12 @@ class _Batcher:
         self._cond = threading.Condition()
         # deque: the dispatch stage pops from the head per item — O(1)
         # under backlog where list.pop(0) was O(n) per pop.
-        self._pending: collections.deque[dict] = collections.deque()
+        self._pending: collections.deque[dict] = collections.deque()  # guarded-by: _cond
         # Rows currently queued (NOT yet popped by dispatch): the
         # admission-control ledger and the sampler's
         # tdn_batcher_pending_rows gauge. Updated only under _cond.
-        self.pending_rows = 0
-        self._closed = False
+        self.pending_rows = 0  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         self._serial = pipeline_depth <= 1
         # Launched-but-not-drained hand-off. The SEMAPHORE is the
         # launch-ahead bound — dispatch takes a slot BEFORE staging or
