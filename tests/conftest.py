@@ -141,6 +141,23 @@ QUICK_TESTS = {
     "test_generate": ["test_greedy_generation_matches_teacher_forced_oracle",
                       "test_pipeline_generate_matches_single_chip",
                       "test_tp_generate_greedy_matches_single_chip"],
+    # ISSUE 14: goodput conservation on the loopback wire (odd rows
+    # forced into pow2 buckets, useful+pad==total exactly, /goodput
+    # shares sum to 1), iteration-level continuous accounting + prefix
+    # savings, the timeseries families across a counter reset, the tdn
+    # top MFU/pad column in both modes + the --iterations CI path, the
+    # bench_gate serving_mfu/serving_pad_ratio contract, and the
+    # armed-vs-disarmed accounting overhead A/B.
+    "test_goodput": [
+        "test_loopback_serving_pad_accounting_exact",
+        "test_continuous_scheduler_conservation_and_prefix_savings",
+        "test_static_generate_accounting_eos_frozen_exact",
+        "test_timeseries_goodput_families_and_counter_reset",
+        "test_top_renders_mfu_pad_columns_fleet_and_single",
+        "test_cli_top_iterations_reads_goodput_from_live_endpoint",
+        "test_bench_gate_serving_mfu_and_pad_ratio_skip_and_fail",
+        "test_goodput_overhead_smoke_accounting_within_noise",
+        "test_peak_calibration_is_shared_with_bench"],
     "test_graft_entry": ["test_entry_is_jittable",
                          "test_dryrun_multichip_odd_device_count"],
     "test_hetero_pipeline": ["test_forward_matches_single_program"],

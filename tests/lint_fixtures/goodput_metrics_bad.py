@@ -1,0 +1,17 @@
+"""metric-series-lifecycle fixture (violating twin, goodput flavor):
+a goodput exporter keyed per REPLICA with no series retirement — fleet
+churn would grow the label set forever. (The real goodput families key
+on ``kind``/``path`` — closed label spaces — exactly so they carry no
+lifecycle obligation; the clean twin shows both shapes.)"""
+
+
+class FleetGoodputExporter:
+    def __init__(self, reg):
+        self._mfu = reg.gauge(  # <- violation
+            "tdn_mfu_ratio_per_replica",
+            "per-replica MFU scraped from the fleet",
+            labels=("replica",),
+        )
+
+    def publish(self, target, value):
+        self._mfu.labels(replica=target).set(value)

@@ -1,0 +1,30 @@
+"""metric-series-lifecycle fixture (clean twin, goodput flavor): the
+shipped goodput families key on CLOSED label spaces (``kind`` in
+{useful, pad}, ``path`` in {batcher, gen, engine}) — no churn, no
+lifecycle obligation; a per-replica fleet exporter retires departed
+replicas' series."""
+
+
+class FleetGoodputExporter:
+    def __init__(self, reg):
+        # Closed label spaces: no remove needed, and none demanded.
+        self._flops = reg.counter(
+            "tdn_goodput_flops_total", "useful vs pad model FLOPs",
+            labels=("kind",),
+        )
+        self._pad = reg.gauge(
+            "tdn_pad_ratio", "pad share per accounting path",
+            labels=("path",),
+        )
+        # Churning label space: retired on membership changes.
+        self._mfu = reg.gauge(
+            "tdn_mfu_ratio_per_replica",
+            "per-replica MFU scraped from the fleet",
+            labels=("replica",),
+        )
+
+    def publish(self, target, value):
+        self._mfu.labels(replica=target).set(value)
+
+    def retire(self, target):
+        self._mfu.remove(replica=target)

@@ -1,0 +1,20 @@
+"""tick-purity fixture (violating twin, goodput flavor): the goodput
+tracker's tick is a RuntimeSampler callback (``add_goodput``) — peak
+calibration is a real matmul-and-wait and must never ride it. This
+twin proves the add_goodput registration verb is in the analyzer's
+tick protocol, so an accounting hook can never regress the PR-13
+gate silently."""
+
+import time
+
+
+class GoodputPlane:
+    def tick(self):
+        self._recalibrate_peak()
+
+    def _recalibrate_peak(self):
+        time.sleep(0.2)  # <- violation
+
+
+def wire(sampler):
+    sampler.add_goodput(GoodputPlane())

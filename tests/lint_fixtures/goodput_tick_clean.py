@@ -1,0 +1,22 @@
+"""tick-purity fixture (clean twin, goodput flavor): the real
+GoodputTracker shape — calibration happens ONCE at configure time
+(engine/scheduler construction), the tick only does ledger math and
+gauge sets."""
+
+import time
+
+
+class GoodputPlane:
+    def ensure_peak(self):
+        # Configure-time calibration: a real measurement, but never
+        # reachable from the sampler tick.
+        time.sleep(0.2)
+
+    def tick(self):
+        self._mfu = 0.0
+
+
+def wire(sampler):
+    plane = GoodputPlane()
+    plane.ensure_peak()
+    sampler.add_goodput(plane)

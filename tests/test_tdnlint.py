@@ -41,6 +41,12 @@ RULE_FIXTURES = [
     ("metric-series-lifecycle", "metric_lifecycle"),
     ("admin-actuation", "admin_actuation"),
     ("jit-purity", "jit_purity"),
+    # ISSUE 14 twins: the goodput tick callback rides the sampler via
+    # the NEW add_goodput verb (tick-purity must cover it), and its
+    # closed-label-space families carry no lifecycle obligation while
+    # a per-replica fleet exporter does.
+    ("tick-purity", "goodput_tick"),
+    ("metric-series-lifecycle", "goodput_metrics"),
 ]
 
 

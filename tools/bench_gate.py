@@ -31,6 +31,8 @@ Gated metrics (docs/PERF.md "Regression gate"):
                                                                  higher
     autoscale_replica_seconds_ratio serving.autoscale.replica_seconds_ratio
                                                                  lower
+    serving_mfu                     serving.goodput.mfu          higher
+    serving_pad_ratio               serving.goodput.pad_ratio    lower
 
 Rules:
 
@@ -122,6 +124,13 @@ GATED_METRICS = (
     # rounds -> per-metric skip.
     ("autoscale_replica_seconds_ratio",
      ("serving", "autoscale", "replica_seconds_ratio"), "lower"),
+    # Goodput plane (ISSUE 14): the serving window's measured MFU
+    # (analytic useful FLOPs over resolved peak — higher is better)
+    # and its structural-pad FLOP share (bucket pad rows, idle slots —
+    # lower is better). Absent in pre-ISSUE-14 rounds -> per-metric
+    # skip.
+    ("serving_mfu", ("serving", "goodput", "mfu"), "higher"),
+    ("serving_pad_ratio", ("serving", "goodput", "pad_ratio"), "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

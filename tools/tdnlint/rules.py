@@ -130,6 +130,7 @@ _TICK_PROTOCOL = {
     "add_slo_tracker": "evaluate",
     "add_autoscaler": "tick",
     "add_incident_recorder": "check",
+    "add_goodput": "tick",
 }
 _BLOCKING_MODULE_ROOTS = {
     "socket", "subprocess", "urllib", "requests", "http",

@@ -57,6 +57,7 @@ from tpu_dist_nn.parallel.pipeline import (
     pipeline_spec_summary,
 )
 from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.goodput import GOODPUT, fcnn_flops_per_row
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import REGISTRY
 from tpu_dist_nn.train.metrics import classification_metrics
@@ -269,6 +270,21 @@ class Engine:
         # Static activation names: passed explicitly on the hot path so
         # infer() never reads act ids back from the device.
         self._act_names = tuple(l.activation for l in model.layers)
+        # Goodput accounting (obs/goodput.py): the analytic per-row
+        # FLOP cost of this engine's dense chain, recorded per launch
+        # at the infer_async boundary. None for non-dense models (no
+        # FLOP model -> no accounting). Peak resolution happens here,
+        # at configure time — the host-anchor measurement must never
+        # ride a sampler tick.
+        self._flops_per_row = (
+            fcnn_flops_per_row(self.model.layer_sizes)
+            if model.is_dense else None
+        )
+        if self._flops_per_row:
+            # The peak must match the ledger's footprint: launches are
+            # recorded whole, so a sharded placement's denominator is
+            # per-device peak x mesh size.
+            GOODPUT.ensure_peak(device_count=mesh_spec.num_devices)
         if quantize is not None:
             if self.pipelined:
                 from tpu_dist_nn.kernels.quantized import (
@@ -439,7 +455,7 @@ class Engine:
         """
         return self.fetch(self.infer_async(x))
 
-    def infer_async(self, x) -> PendingInference:
+    def infer_async(self, x, *, useful_rows=None) -> PendingInference:
         """Validate, stage, and LAUNCH a batch without waiting for it.
 
         Returns a :class:`PendingInference` whose device result is
@@ -449,6 +465,14 @@ class Engine:
         double-buffered fast path. Validation errors raise HERE (at
         dispatch), so a bad request fails before it occupies the
         pipeline.
+
+        ``useful_rows`` is the goodput declaration (obs/goodput.py):
+        how many of this batch's rows carry request data. The batcher
+        passes its pre-padding row count so bucket pad is accounted as
+        pad FLOPs under ``path="batcher"``; direct callers omit it and
+        the launch counts as all-useful under ``path="engine"``
+        (data-shard padding on direct calls rides as useful — a named
+        model simplification, single-chip launches have none).
         """
         t0 = time.monotonic()
         try:
@@ -461,6 +485,18 @@ class Engine:
         except Exception:
             _INFER_ERRORS.inc()
             raise
+        # Goodput accounting at the launch boundary: one integer record
+        # per device launch (never per row). getattr: hand-constructed
+        # engines (Engine.__new__ in tests) may predate the slot.
+        fpr = getattr(self, "_flops_per_row", None)
+        if fpr:
+            total_rows = int(shape[0])
+            if useful_rows is None:
+                GOODPUT.record_rows(fpr, total_rows, total_rows,
+                                    path="engine")
+            else:
+                GOODPUT.record_rows(fpr, total_rows, int(useful_rows),
+                                    path="batcher")
         # Trace annotations attach to whatever request span is active
         # on this thread (the batcher's launch span, a handler span, or
         # nothing) — the active() guard keeps the f-strings off the

@@ -297,11 +297,13 @@ def _validate_slo_flags(args, needs: str | None = None) -> None:
 def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
                     latency_match=None, availability_kwargs=None):
     """Attach the fleet-observability plane to one serving command:
-    a time-series ring sampled every tick (GET /timeseries), plus —
-    when SLO flags were passed — the burn-rate tracker (GET /slo,
-    tdn_slo_* gauges, slo.burn events). Returns (ring, tracker)."""
+    a time-series ring sampled every tick (GET /timeseries), the
+    goodput tracker's MFU/pad gauge tick + GET /goodput, plus — when
+    SLO flags were passed — the burn-rate tracker (GET /slo, tdn_slo_*
+    gauges, slo.burn events). Returns (ring, tracker)."""
     if metrics_server is None or sampler is None:
         return None, None
+    from tpu_dist_nn.obs.goodput import GOODPUT
     from tpu_dist_nn.obs.slo import (
         SLOTracker,
         availability_objective,
@@ -310,6 +312,9 @@ def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
     from tpu_dist_nn.obs.timeseries import TimeSeriesRing
 
     ring = TimeSeriesRing()
+    # Goodput ticks BEFORE the ring collects (runtime.py ordering), so
+    # /timeseries records this tick's tdn_mfu_ratio.
+    sampler.add_goodput(GOODPUT)
     sampler.add_timeseries(ring)
     objectives = []
     lat = getattr(args, "slo_latency_p99_ms", None)
@@ -327,7 +332,7 @@ def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
     if objectives:
         tracker = SLOTracker(ring, objectives)
         sampler.add_slo_tracker(tracker)
-    metrics_server.attach(timeseries=ring, slo=tracker)
+    metrics_server.attach(timeseries=ring, slo=tracker, goodput=GOODPUT)
     return ring, tracker
 
 
@@ -2420,6 +2425,32 @@ def cmd_metrics(args) -> int:
                       f"slow_burn={slow.get('burn_rate', 0):g} "
                       f"budget_left={obj['error_budget_remaining']:g}"
                       + (" BURNING" if obj.get("burning") else ""))
+        # Fleet goodput verdict (ISSUE 14): /goodput fanned out and
+        # merged — FLOP totals summed, fleet MFU recomputed over the
+        # aggregate peak. Silent skip when no process has a tracker
+        # attached (pre-goodput replicas).
+        try:
+            from tpu_dist_nn.obs.collect import collect_fleet_goodput
+
+            gp = collect_fleet_goodput(base, timeout=args.timeout)
+        except ValueError:
+            gp = None
+        if gp and gp["flops"]["total"] > 0:
+            mfu = gp.get("mfu")
+            mfu_s = f"{mfu:.4f}" if mfu is not None else "n/a"
+            print(f"fleet goodput: mfu={mfu_s} "
+                  f"pad_ratio={gp['pad_ratio']:.4f} "
+                  f"useful_gflops={gp['flops']['useful'] / 1e9:.3f} "
+                  f"pad_gflops={gp['flops']['pad'] / 1e9:.3f} "
+                  f"prefix_saved_gflops="
+                  f"{gp['flops']['prefix_saved'] / 1e9:.3f}")
+            for source in sorted(gp.get("sources", {})):
+                doc = gp["sources"][source]
+                smfu = doc.get("mfu")
+                print(f"[goodput] {source}: mfu="
+                      + (f"{smfu:.4f}" if smfu is not None else "n/a")
+                      + f" pad_ratio={doc.get('pad_ratio') or 0:.4f}"
+                      + f" peak={doc.get('peak_source')}")
         if getattr(args, "timeseries", None):
             from tpu_dist_nn.obs.collect import collect_fleet_timeseries
 

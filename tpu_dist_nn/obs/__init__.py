@@ -47,6 +47,15 @@ metrics layer the serving/training hot paths publish into:
   - :mod:`tpu_dist_nn.obs.top` — the ``tdn top`` live ANSI dashboard
     over a router fleet or single server (rps, percentiles, slots,
     breaker state, SLO budget, sparklines).
+  - :mod:`tpu_dist_nn.obs.goodput` — the goodput & MFU accounting
+    plane: analytic per-launch FLOP models (FCNN rows, LM
+    prefill/decode at their static kernel shapes) fed at the
+    launch/fetch boundaries, every launch split exactly into
+    ``useful + pad`` FLOPs with a pad taxonomy (bucket rows,
+    idle/frozen slots, masked attention tails), one shared peak
+    calibration with bench.py, ``tdn_mfu_ratio`` /
+    ``tdn_pad_ratio{path}`` / ``tdn_goodput_flops_total{kind}`` /
+    ``tdn_prefix_flops_saved_total``, and ``GET /goodput``.
   - :mod:`tpu_dist_nn.obs.incident` — the flight recorder: detectors
     on the sampler tick (SLO fast burn, error/shed spikes, breaker
     opens, drain/failover) plus crash hooks, each trigger freezing a
@@ -101,6 +110,12 @@ from tpu_dist_nn.obs.log import (  # noqa: F401
     get_logger,
     setup_json_logging,
 )
+from tpu_dist_nn.obs.goodput import (  # noqa: F401
+    GOODPUT,
+    GoodputTracker,
+    LMFlopModel,
+    fcnn_flops_per_row,
+)
 from tpu_dist_nn.obs.incident import (  # noqa: F401
     FlightRecorder,
     IncidentStore,
@@ -137,6 +152,10 @@ __all__ = [
     "JsonFormatter",
     "LogRing",
     "LOG_RING",
+    "GOODPUT",
+    "GoodputTracker",
+    "LMFlopModel",
+    "fcnn_flops_per_row",
     "FlightRecorder",
     "IncidentStore",
     "capture_bundle",

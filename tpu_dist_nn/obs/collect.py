@@ -504,6 +504,92 @@ def collect_fleet_slo(router_target: str, *,
     return merged
 
 
+# ------------------------------------------------------ goodput merging
+
+
+def merge_goodput(docs_by_source: dict[str, dict]) -> dict:
+    """Fold per-process ``/goodput`` documents into one fleet verdict.
+
+    Useful/pad/saved FLOP totals are cumulative event counts, so they
+    SUM exactly and the fleet pad ratio recomputes from the summed
+    split (the merge_slo rule — a busy replica must outweigh an idle
+    one). Fleet MFU recomputes as the sum of per-source useful-FLOP
+    rates over the sum of per-source peaks: each source's ``mfu`` is
+    ``useful_rate / peak``, so ``sum(mfu_i * peak_i) / sum(peak_i)``
+    is the fleet's achieved fraction of its aggregate hardware —
+    sources without a resolved peak are excluded from the MFU
+    denominator (named in ``merged_estimates``). Per-source MFU stays
+    visible (the which-replica-is-cold question a fleet view exists
+    to answer)."""
+    useful = pad = saved = 0
+    launches = 0
+    mfu_num = mfu_den = 0.0
+    per_source: dict[str, dict] = {}
+    stages: dict[str, dict] = {}
+    reasons: dict[str, int] = {}
+    for source, doc in docs_by_source.items():
+        if not isinstance(doc, dict) or "flops" not in doc:
+            continue
+        flops = doc.get("flops") or {}
+        useful += int(flops.get("useful") or 0)
+        pad += int(flops.get("pad") or 0)
+        saved += int(flops.get("prefix_saved") or 0)
+        launches += int(doc.get("launches") or 0)
+        peak = doc.get("peak_flops")
+        mfu = doc.get("mfu")
+        if peak and mfu is not None:
+            mfu_num += float(mfu) * float(peak)
+            mfu_den += float(peak)
+        per_source[source] = {
+            "mfu": mfu,
+            "pad_ratio": doc.get("pad_ratio"),
+            "peak_flops": peak,
+            "peak_source": doc.get("peak_source"),
+            "useful": int(flops.get("useful") or 0),
+            "pad": int(flops.get("pad") or 0),
+        }
+        for name, st in (doc.get("stages") or {}).items():
+            agg = stages.setdefault(name, {"useful": 0, "pad": 0,
+                                           "launches": 0})
+            agg["useful"] += int(st.get("useful") or 0)
+            agg["pad"] += int(st.get("pad") or 0)
+            agg["launches"] += int(st.get("launches") or 0)
+        for reason, v in (doc.get("pad_reasons") or {}).items():
+            reasons[reason] = reasons.get(reason, 0) + int(v)
+    total = useful + pad
+    for st in stages.values():
+        st["total"] = st["useful"] + st["pad"]
+        st["share"] = st["total"] / total if total else 0.0
+    return {
+        "fleet": True,
+        "mfu": mfu_num / mfu_den if mfu_den else None,
+        "pad_ratio": pad / total if total else 0.0,
+        "flops": {"useful": useful, "pad": pad, "total": total,
+                  "prefix_saved": saved},
+        "launches": launches,
+        "stages": stages,
+        "pad_reasons": reasons,
+        "sources": per_source,
+        "merged_estimates": {
+            "mfu": "sum(useful rates) / sum(peaks) over sources with "
+                   "a resolved peak",
+            "pad_ratio": "recomputed from summed useful/pad FLOPs",
+        },
+    }
+
+
+def collect_fleet_goodput(router_target: str, *,
+                          timeout: float = 5.0) -> dict:
+    """Fan ``GET /goodput`` out over router + replicas and merge (the
+    ``tdn metrics --aggregate`` goodput core). A source without a
+    tracker attached (404) lands in ``unreachable`` — the router
+    itself usually has no engine, so only replicas contribute FLOPs."""
+    docs, unreachable = _collect_sources(router_target, "/goodput", timeout)
+    merged = merge_goodput(docs)
+    merged["unreachable"] = unreachable
+    return merged
+
+
 # --------------------------------------------------- timeseries merging
 
 

@@ -215,7 +215,12 @@ def parsed_histogram_quantile(parsed: dict, family: str, q: float,
 
 class MetricsServer:
     """The /metrics + /healthz + /trace + /profile + /timeseries +
-    /slo + /logs + /debug/bundle endpoint on a daemon thread.
+    /slo + /goodput + /logs + /debug/bundle endpoint on a daemon
+    thread.
+
+    ``GET /goodput`` serves the attached
+    :class:`~tpu_dist_nn.obs.goodput.GoodputTracker`'s per-stage
+    useful/pad FLOP breakdown (404 with a hint until attached).
 
     ``GET /logs?window=S&level=L&limit=N`` serves the process log ring
     (:data:`tpu_dist_nn.obs.log.LOG_RING`); ``GET /debug/bundle``
@@ -258,7 +263,7 @@ class MetricsServer:
     def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
                  registry: Registry | None = None, health_fn=None,
                  tracer=None, routes=None, timeseries=None, slo=None,
-                 post_routes=None):
+                 goodput=None, post_routes=None):
         reg = registry if registry is not None else REGISTRY
         outer = self
         # Extra GET routes, ``{path: fn(query) -> (status, content_type,
@@ -335,6 +340,9 @@ class MetricsServer:
                 elif path == "/slo":
                     status, body = outer._slo_body(query)
                     self._reply(status, "application/json", body)
+                elif path == "/goodput":
+                    status, body = outer._goodput_body(query)
+                    self._reply(status, "application/json", body)
                 elif path == "/debug/profile":
                     status, ctype, body = outer._debug_profile_body(query)
                     self._reply(status, ctype, body)
@@ -356,6 +364,7 @@ class MetricsServer:
         self._tracer = tracer
         self._timeseries = timeseries
         self._slo = slo
+        self._goodput = goodput
         # One device capture at a time: jax.profiler.trace is a
         # process-global session — a second concurrent start raises
         # deep inside the profiler instead of returning a clean 409.
@@ -390,15 +399,18 @@ class MetricsServer:
 
         return TRACER
 
-    def attach(self, *, timeseries=None, slo=None) -> None:
-        """Late-bind the /timeseries ring and /slo tracker: the serving
-        bring-up binds this endpoint BEFORE the sampler (and the ring
-        it feeds) exists, so the routes 404 until attachment instead of
-        holding the port hostage to construction order."""
+    def attach(self, *, timeseries=None, slo=None, goodput=None) -> None:
+        """Late-bind the /timeseries ring, /slo tracker, and /goodput
+        tracker: the serving bring-up binds this endpoint BEFORE the
+        sampler (and the ring it feeds) exists, so the routes 404 until
+        attachment instead of holding the port hostage to construction
+        order."""
         if timeseries is not None:
             self._timeseries = timeseries
         if slo is not None:
             self._slo = slo
+        if goodput is not None:
+            self._goodput = goodput
 
     def add_routes(self, routes: dict) -> None:
         """Late-mount extra GET routes (same shape as ``routes=``):
@@ -533,6 +545,14 @@ class MetricsServer:
                          b'on the serving command)"}\n')
         return 200, json.dumps(tracker.status()).encode() + b"\n"
 
+    def _goodput_body(self, query: str):
+        tracker = self._goodput
+        if tracker is None:
+            return 404, (b'{"error": "no goodput tracker attached '
+                         b'(start a serving command with '
+                         b'--metrics-port)"}\n')
+        return 200, json.dumps(tracker.snapshot()).encode() + b"\n"
+
     def _profile_body(self, query: str):
         from tpu_dist_nn.obs.profile import profile_snapshot
 
@@ -624,13 +644,14 @@ class MetricsServer:
 def start_http_server(port: int = 0, host: str = "0.0.0.0", *,
                       registry: Registry | None = None,
                       health_fn=None, routes=None, timeseries=None,
-                      slo=None, post_routes=None) -> MetricsServer:
+                      slo=None, goodput=None,
+                      post_routes=None) -> MetricsServer:
     """Start the /metrics endpoint; returns the server (``.port`` holds
     the bound port when ``port=0`` picked an ephemeral one). ``routes``
     mounts extra GET paths and ``post_routes`` extra POST paths (see
-    :class:`MetricsServer`); ``timeseries``/``slo`` pre-attach the
-    /timeseries and /slo sources (or late-bind them with
-    :meth:`MetricsServer.attach`)."""
+    :class:`MetricsServer`); ``timeseries``/``slo``/``goodput``
+    pre-attach the /timeseries, /slo, and /goodput sources (or
+    late-bind them with :meth:`MetricsServer.attach`)."""
     return MetricsServer(port, host, registry=registry, health_fn=health_fn,
                          routes=routes, timeseries=timeseries, slo=slo,
-                         post_routes=post_routes)
+                         goodput=goodput, post_routes=post_routes)

@@ -145,6 +145,10 @@ class RuntimeSampler:
         # tick by DELTA at sample time, so the drop path itself stays a
         # plain int increment with no registry work.
         self._trace_dropped_seen: list[float] = []
+        # Goodput trackers (ISSUE 14) tick BEFORE the time-series rings
+        # collect, so a ring tick records this tick's tdn_mfu_ratio /
+        # tdn_pad_ratio values, not last tick's.
+        self._goodput: list = []
         # Fleet observability plane (ISSUE 9): time-series rings sample
         # AFTER the gauges above are refreshed (so a ring tick sees
         # this tick's state, not last tick's), and SLO trackers
@@ -186,6 +190,15 @@ class RuntimeSampler:
     def add_tracer(self, tracer) -> None:
         self._tracers.append(tracer)
         self._trace_dropped_seen.append(float(tracer.dropped_total))
+
+    def add_goodput(self, tracker) -> None:
+        """Register a :class:`~tpu_dist_nn.obs.goodput.GoodputTracker`
+        whose :meth:`~tpu_dist_nn.obs.goodput.GoodputTracker.tick`
+        refreshes the MFU/pad gauges once per tick — before the
+        time-series rings collect, so the ring records this tick's
+        utilization. The tick is pure ledger math (tick-purity gated by
+        tdnlint); peak calibration happened at configure time."""
+        self._goodput.append(tracker)
 
     def add_timeseries(self, ring) -> None:
         """Register a :class:`~tpu_dist_nn.obs.timeseries.TimeSeriesRing`
@@ -317,6 +330,8 @@ class RuntimeSampler:
         if rss is not None:
             self._g_rss.set(rss)
         self._sample_devices()
+        for tracker in self._goodput:
+            tracker.tick()
         for ring in self._timeseries:
             ring.collect()
         for tracker in self._slo_trackers:

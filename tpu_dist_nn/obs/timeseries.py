@@ -61,6 +61,10 @@ DEFAULT_FAMILIES = (
     "tdn_gen_slot_occupancy_ratio",
     "tdn_prefix_cache_hits_total",
     "tdn_prefix_cache_misses_total",
+    "tdn_goodput_flops_total",
+    "tdn_mfu_ratio",
+    "tdn_pad_ratio",
+    "tdn_prefix_flops_saved_total",
     "tdn_router_requests_total",
     "tdn_router_request_seconds",
     "tdn_router_failovers_total",

@@ -5,8 +5,9 @@ The fleet's health story is spread over four HTTP surfaces — /metrics
 (budget), /timeseries (history). ``tdn top`` polls them on an interval
 and renders the operator's one-screen view: per-replica rps, p50/p99,
 decode-slot occupancy, pending rows, breaker/health state, prefix-
-cache hit ratio, SLO budget remaining, and a sparkline of recent
-request rate per lane.
+cache hit ratio, MFU / pad-FLOP share (the goodput plane,
+docs/OBSERVABILITY.md "Goodput & MFU"), SLO budget remaining, and
+sparklines of recent request rate and MFU per lane.
 
 Pointed at a ROUTER metrics endpoint it discovers the fleet via
 ``/router/replicas`` and shows router + every replica; pointed at a
@@ -86,6 +87,18 @@ def _sum_family(parsed: dict, family: str, **match) -> float:
             continue
         total += float(value)
     return total
+
+
+def _family_present(parsed: dict, family: str) -> bool:
+    """Whether ANY series of ``family`` exists in the scrape — a sum of
+    0.0 over an absent family must render as '-', not as a real 0."""
+    for series in parsed:
+        s = str(series)
+        if s.startswith("__type__:"):
+            continue
+        if split_series(s)[0] == family:
+            return True
+    return False
 
 
 def _delta_parsed(prev: dict | None, cur: dict) -> dict:
@@ -174,11 +187,31 @@ class FleetPoller:
         hits = _sum_family(parsed, "tdn_prefix_cache_hits_total")
         misses = _sum_family(parsed, "tdn_prefix_cache_misses_total")
         row["prefix_hit"] = hits / (hits + misses) if hits + misses else None
-        try:
-            ts = json.loads(_get(
-                base, f"/timeseries?family={req_family}&window=600",
-                self.timeout,
-            ))
+        # Goodput view (ISSUE 14): the server's own windowed
+        # tdn_mfu_ratio gauge verbatim; pad ratio from the between-poll
+        # FLOP-counter deltas (the live view — falls back to cumulative
+        # on the first frame).
+        row["mfu"] = (
+            _sum_family(parsed, "tdn_mfu_ratio")
+            if _family_present(parsed, "tdn_mfu_ratio") else None
+        )
+        gp_src = delta if dt else parsed
+        gp_useful = _sum_family(gp_src, "tdn_goodput_flops_total",
+                                kind="useful")
+        gp_pad = _sum_family(gp_src, "tdn_goodput_flops_total", kind="pad")
+        row["pad_ratio"] = (
+            gp_pad / (gp_useful + gp_pad) if gp_useful + gp_pad > 0 else None
+        )
+        ts = self._fetch_timeseries(base, "tdn_mfu_ratio")
+        if ts is not None:
+            pts: list = []
+            for key, series_pts in (ts.get("series") or {}).items():
+                pts = [v for _t, v in series_pts]  # one unlabeled gauge
+            row["mfu_spark"] = pts or None
+        else:
+            row["mfu_spark"] = None
+        ts = self._fetch_timeseries(base, req_family)
+        if ts is not None:
             by_t: dict[float, float] = {}
             for key, pts in (ts.get("series") or {}).items():
                 if "_bucket" in key or "_sum" in key:
@@ -190,9 +223,21 @@ class FleetPoller:
             row["spark"] = [
                 max(b - a, 0.0) / res for a, b in zip(seq, seq[1:])
             ]
-        except (urllib.error.URLError, OSError, ValueError):
+        else:
             row["spark"] = None
         return row
+
+    def _fetch_timeseries(self, base: str, family: str) -> dict | None:
+        """One /timeseries family pull (the rps- and mfu-sparkline
+        fetches share it), degrading to None on any transport/parse
+        failure — a sparkline is garnish, never an error row."""
+        try:
+            return json.loads(_get(
+                base, f"/timeseries?family={family}&window=600",
+                self.timeout,
+            ))
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
 
     def poll(self) -> dict:
         now = time.monotonic()
@@ -280,7 +325,8 @@ def render_frame(state: dict, color: bool = True) -> str:
     header = (
         f"{'source':<28} {'state':<9} {'rps':>8} {'p50ms':>8} "
         f"{'p99ms':>8} {'pend':>6} {'slots':>6} {'occ':>5} "
-        f"{'pfx%':>5}  {'rps trend':<24}"
+        f"{'pfx%':>5} {'mfu%':>6} {'pad%':>5}  {'rps trend':<24} "
+        f"{'mfu trend':<12}"
     )
     lines.append(c(DIM, header))
     for row in state.get("rows", ()):
@@ -295,6 +341,14 @@ def render_frame(state: dict, color: bool = True) -> str:
             st = f"{st}/{breaker}"
         st_col = GREEN if st in ("up", "active") else YELLOW
         spark = sparkline(row["spark"]) if row.get("spark") else " " * 24
+        mfu_spark = (
+            sparkline(row["mfu_spark"], width=12)
+            if row.get("mfu_spark") else " " * 12
+        )
+        mfu = row.get("mfu")
+        mfu_pct = None if mfu is None else mfu * 100
+        pad = row.get("pad_ratio")
+        pad_pct = None if pad is None else pad * 100
         lines.append(
             f"{row['source']:<28} " + c(st_col, f"{st:<9}")
             + f" {_fmt(row.get('rps')):>8}"
@@ -304,7 +358,9 @@ def render_frame(state: dict, color: bool = True) -> str:
             + f" {_fmt(row.get('slots'), '{:.0f}'):>6}"
             + f" {_fmt(row.get('occupancy'), '{:.2f}'):>5}"
             + f" {_fmt(row.get('prefix_hit') and row['prefix_hit'] * 100, '{:.0f}'):>5}"
-            + f"  {spark}"
+            + f" {_fmt(mfu_pct, '{:.2f}'):>6}"
+            + f" {_fmt(pad_pct, '{:.0f}'):>5}"
+            + f"  {spark} {mfu_spark}"
         )
     slo = state.get("slo")
     if slo and slo.get("objectives"):

@@ -80,6 +80,7 @@ import time
 import numpy as np
 
 from tpu_dist_nn.obs import trace as _trace
+from tpu_dist_nn.obs.goodput import GOODPUT, LMFlopModel
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
 
@@ -357,6 +358,9 @@ class ContinuousScheduler:
             self._make_cache = None
             self._key = None
             self._temperature = float(temperature)
+            # Injected fake kernels carry no architecture: the goodput
+            # plane has no FLOP model to apply, so accounting is off.
+            self._gp_model = None
         else:
             if copy_fn is not None:
                 raise ValueError(
@@ -439,6 +443,13 @@ class ContinuousScheduler:
         M = self._T + self._N - 1 if self._N > 1 else self._T
         self._make_cache = lambda: init_slot_cache(cfg, self._S + self._P, M)
         self._cache = self._make_cache()
+        # Goodput FLOP model at the kernels' static shapes: the decode
+        # step runs the REQUEST region only (pool blocks are sliced out
+        # — decode_step_slots sees S slots, extent M), so the model's
+        # extent is M regardless of prefix_cache_blocks. Peak resolves
+        # here, at configure time, never on a sampler tick.
+        self._gp_model = LMFlopModel.from_config(cfg, M)
+        GOODPUT.ensure_peak(device_count=1)  # slot cache is single-chip
         top_k = None if top_k is None else int(top_k)
         top_p = None if top_p is None else float(top_p)
 
@@ -834,6 +845,13 @@ class ContinuousScheduler:
             return
         occ["fill"] = length
         occ["block"] = block
+        if self._gp_model is not None:
+            # The hit's savings: the chunk launches that will never run
+            # for positions [0, length) (counted as savings, never as
+            # useful work — the work was NOT done).
+            GOODPUT.record_prefix_saved(
+                self._gp_model.prefill_chunks_flops(0, length, self._chunk)
+            )
         slog.info(
             "gen.prefix_hit", slot=slot, block=block, prefix_len=length,
             suffix_len=self._T - length,
@@ -920,6 +938,11 @@ class ContinuousScheduler:
             return
         occ["fill"] = start + size
         self.prefill_chunks_total += 1
+        if self._gp_model is not None:
+            GOODPUT.record_prefill_chunk(
+                self._gp_model, start, size,
+                final=occ["fill"] >= self._T,
+            )
         now = time.monotonic()
         if item["ctx"] is not None:
             _trace.TRACER.record_span(
@@ -1011,6 +1034,24 @@ class ContinuousScheduler:
         active = int(self._active.sum())
         self.slot_steps_total += active
         self._m_rows.observe(active)
+        if self._gp_model is not None:
+            # Goodput split of this launch at slot granularity (Orca's
+            # waste taxonomy): active lanes are useful up to their live
+            # attention frontier (launch-time pos — read BEFORE the
+            # retire loop advances it), occupied-but-chunking lanes are
+            # mid_prefill pad, empty lanes idle pad.
+            active_pos = []
+            idle = mid = 0
+            for s in range(self._S):
+                if self._active[s]:
+                    active_pos.append(int(self._pos[s]))
+                elif self._occupant[s] is None:
+                    idle += 1
+                else:
+                    mid += 1
+            GOODPUT.record_decode_step(
+                self._gp_model, active_pos, idle, mid,
+            )
         dur = time.monotonic() - t0
         for occ in traced:
             if occ["item"]["err"] is not None:

@@ -127,7 +127,7 @@ class _Batcher:
     def __init__(self, engine, max_batch_rows: int = 65536,
                  submit_timeout: float | None = 120.0, run_fn=None,
                  method: str = "Process", pipeline_depth: int = 2,
-                 max_pending_rows: int | None = None):
+                 max_pending_rows: int | None = None, account_fn=None):
         self._engine = engine
         # The device launch the batcher owns, split into the dispatch
         # half (launch, ideally non-blocking) and the fetch half (the
@@ -136,12 +136,31 @@ class _Batcher:
         # generation endpoint passes its decode runner — returning a
         # device array from it buys the same overlap) — coalescing,
         # bucketing, abandonment, and error fan-out are identical.
+        # An engine whose infer_async takes ``useful_rows`` gets the
+        # pre-padding row count declared per launch, so the goodput
+        # plane (obs/goodput.py) books bucket pad exactly; fakes with a
+        # plain one-arg infer_async keep working (signature-probed).
+        self._useful_aware = False
         if run_fn is not None:
             self._dispatch_fn, self._fetch_fn = run_fn, np.asarray
         elif hasattr(engine, "infer_async") and hasattr(engine, "fetch"):
             self._dispatch_fn, self._fetch_fn = engine.infer_async, engine.fetch
+            try:
+                import inspect
+
+                self._useful_aware = "useful_rows" in inspect.signature(
+                    engine.infer_async
+                ).parameters
+            except (TypeError, ValueError):
+                pass
         else:
             self._dispatch_fn, self._fetch_fn = engine.infer, np.asarray
+        # Post-fetch accounting seam: called with (materialized output,
+        # useful_rows, launched_rows) after each successful drain — the
+        # static Generate path's goodput hook (EOS positions are only
+        # visible in the materialized sequences). Must never fail a
+        # request; exceptions are swallowed to a log line.
+        self._account_fn = account_fn
         self._max_rows = int(max_batch_rows)
         self._submit_timeout = submit_timeout
         # Admission watermark: submits that would push the queued row
@@ -373,6 +392,15 @@ class _Batcher:
                 k = len(it["x"])
                 it["out"] = out[ofs:ofs + k]
                 ofs += k
+            if self._account_fn is not None:
+                # Post-fetch goodput accounting (static Generate path:
+                # EOS-frozen positions only exist in the materialized
+                # sequences). Best-effort — accounting must never fail
+                # a request that already has its result.
+                try:
+                    self._account_fn(out, ofs, launched_rows)
+                except Exception:  # noqa: BLE001 — accounting only
+                    log.exception("goodput accounting failed")
         except Exception as e:  # noqa: BLE001 — per request
             err = e
             for it in group:
@@ -454,6 +482,18 @@ class _Batcher:
                 self._slots.acquire()
                 key = buf = None
                 traced = [it for it in group if it["ctx"] is not None]
+                group_rows = sum(len(it["x"]) for it in group)
+
+                def _launch(xs):
+                    # Goodput declaration: the engine books this
+                    # launch's bucket-pad rows (bucket - useful) as pad
+                    # FLOPs under path="batcher" (obs/goodput.py).
+                    if self._useful_aware:
+                        return self._dispatch_fn(
+                            xs, useful_rows=group_rows
+                        )
+                    return self._dispatch_fn(xs)
+
                 try:
                     t_stage = time.monotonic()
                     xs, key, buf = self._stage(group)
@@ -464,9 +504,9 @@ class _Batcher:
                         # the launch runs; they attach to every member
                         # request's launch span below.
                         with _trace.annotation_sink() as notes:
-                            handle = self._dispatch_fn(xs)
+                            handle = _launch(xs)
                     else:
-                        handle = self._dispatch_fn(xs)
+                        handle = _launch(xs)
                     t_launched = time.monotonic()
                     for it in traced:
                         _trace.TRACER.record_span(
@@ -498,7 +538,7 @@ class _Batcher:
                 # tdn_batch_rows keeps the pre-padding count — the
                 # useful-rows view; inflight_rows below reports what
                 # the device is actually running.
-                self._m_rows.observe(sum(len(it["x"]) for it in group))
+                self._m_rows.observe(group_rows)
                 with self._stats_lock:
                     if self.inflight_batches:
                         # A prior batch is still materializing while
@@ -1117,10 +1157,28 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
             return jnp.concatenate([jnp.asarray(rows, out.dtype), out], axis=1)
 
     server = _new_grpc_server(max_workers, interceptors)
+    # Goodput accounting for the run-to-completion decode: one record
+    # per coalesced launch AT DRAIN (EOS-frozen positions only exist in
+    # the materialized sequences). The coalesce=False lock path is the
+    # legacy A/B control arm and stays unaccounted; the num_stages>1
+    # grid pad beyond the bucket is invisible here (named model
+    # simplification — docs/OBSERVABILITY.md "Goodput & MFU").
+    from tpu_dist_nn.obs.goodput import GOODPUT, LMFlopModel
+
+    gp_model = LMFlopModel.from_config(cfg, T + N - 1 if N > 1 else T)
+    # The pipelined placement decodes over num_stages devices; the
+    # single-chip path over one — the peak must match the footprint.
+    GOODPUT.ensure_peak(device_count=max(int(num_stages), 1))
+
+    def account(out, useful_rows, launched_rows):
+        GOODPUT.record_static_generate(
+            gp_model, out, useful_rows, launched_rows, T, eos_id,
+        )
+
     batcher = (
         _Batcher(None, 65536, submit_timeout, run_fn=run, method="Generate",
                  pipeline_depth=pipeline_depth,
-                 max_pending_rows=max_pending_rows)
+                 max_pending_rows=max_pending_rows, account_fn=account)
         if coalesce else None
     )
     lock = threading.Lock()
