@@ -219,6 +219,20 @@ QUICK_TESTS = {
         "test_chaos_smoke_quick_tier_recovers_via_retries",
         "test_breaker_cycle_closed_open_half_open_closed",
         "test_shed_at_watermark_surfaces_resource_exhausted"],
+    # ISSUE 15 acceptance smokes: the 2x-overload degradation drill
+    # (critical completes, best_effort absorbs the sheds), the
+    # real-model preemption bit-parity anchor, class-watermark sheds
+    # + deadline expiry on the shared core, the retry-after floor over
+    # a real loopback shed, the router class hop, and the bench_gate
+    # slo_class_critical_p99_ms skip/fail contract.
+    "test_sched_core": [
+        "test_overload_drill_critical_holds_best_effort_absorbs",
+        "test_preempted_greedy_generate_bit_matches_unpreempted",
+        "test_class_watermark_sheds_best_effort_first",
+        "test_expired_entry_fails_deadline_exceeded_at_pop_without_launch",
+        "test_shed_reply_carries_retry_after_and_client_honors_floor",
+        "test_router_forwards_class_and_server_labels_it",
+        "test_bench_gate_slo_class_critical_p99_skip_and_fail"],
     "test_real_data": ["test_real_digits_load_shapes_and_content",
                        "test_realtext_corpus_supports_valid_heldout_at_scale",
                        "test_cli_train_digits_end_to_end"],

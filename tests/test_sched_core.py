@@ -1,0 +1,819 @@
+"""Degradation ladder (ISSUE 15): the shared scheduling core both
+schedulers rebase on — class-priority admission, per-class shed
+watermarks, burn-rate tightening, deadline-aware expiry, retry-after
+backoff hints — plus decode-slot preemption in the continuous
+scheduler (bit-identical resume) and the 2x-overload chaos drill.
+
+Conventions follow test_resilience.py: no sleeps over ~0.05s on unit
+paths, deterministic fake kernels for scheduling-policy tests, the
+real toy transformer only where bit-parity is the claim.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dist_nn.obs.registry import REGISTRY, Registry
+from tpu_dist_nn.serving import (
+    GrpcClient,
+    RetryPolicy,
+    serve_engine,
+)
+from tpu_dist_nn.serving.sched_core import (
+    DEFAULT_CLASS_WATERMARKS,
+    SLO_CLASSES,
+    AdmissionGovernor,
+    SchedCore,
+    normalize_class,
+    validate_class_watermarks,
+)
+from tpu_dist_nn.utils.errors import (
+    DeadlineExceededError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+from tests.test_batcher_pipeline import AsyncFakeEngine
+
+
+def _counter(name, **labels):
+    m = REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return m.labels(**labels).value
+
+
+def _item(rows=1, cls="standard", width=4):
+    return {
+        "x": np.zeros((rows, width)), "done": threading.Event(),
+        "out": None, "err": None, "abandoned": False,
+        "t_submit": time.monotonic(), "slo_class": cls,
+        "ctx": None,
+    }
+
+
+# --------------------------------------------------------------- classes
+
+
+def test_normalize_class_degrades_unknown_to_standard():
+    assert normalize_class("critical") == "critical"
+    assert normalize_class(" Best_Effort ") == "best_effort"
+    assert normalize_class(None) == "standard"
+    assert normalize_class("platinum") == "standard"
+    assert normalize_class(7) == "standard"
+
+
+def test_validate_class_watermarks_contract():
+    full = validate_class_watermarks({"best_effort": 0.25})
+    assert full["best_effort"] == 0.25
+    assert full["critical"] == DEFAULT_CLASS_WATERMARKS["critical"]
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        validate_class_watermarks({"platinum": 0.5})
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        validate_class_watermarks({"standard": 1.5})
+
+
+def test_pop_order_is_class_priority_fifo_within_class():
+    core = SchedCore("Process")
+    order = ["best_effort", "standard", "critical", "best_effort",
+             "critical", "standard"]
+    items = [_item(cls=c) for c in order]
+    for it in items:
+        core.admit(it, timeout=None)
+    with core.cond:
+        batch, rows = core.pop_group(max_rows=100)
+    assert rows == 6
+    got = [it["slo_class"] for it in batch]
+    assert got == ["critical", "critical", "standard", "standard",
+                   "best_effort", "best_effort"]
+    # FIFO within class: the earlier critical pops first.
+    assert batch[0] is items[2] and batch[1] is items[4]
+
+
+def test_class_watermark_sheds_best_effort_first():
+    core = SchedCore("Process", max_pending_rows=8,
+                     class_watermarks={"best_effort": 0.5})
+    core.admit(_item(rows=4, cls="standard"), None)
+    # 4 pending: best_effort's watermark is 4 -> 4 + 1 > 4 sheds...
+    with pytest.raises(ResourceExhaustedError, match="best_effort"):
+        core.admit(_item(rows=1, cls="best_effort"), None)
+    # ...while standard/critical still fit under the full watermark.
+    core.admit(_item(rows=1, cls="standard"), None)
+    core.admit(_item(rows=1, cls="critical"), None)
+    assert core.shed_total == 1
+    assert core.pending_rows == 6
+    by_cls = core.pending_by_class()
+    assert by_cls["standard"] == 5 and by_cls["critical"] == 1
+
+
+def test_oversized_admitted_when_queue_empty_per_class():
+    core = SchedCore("Process", max_pending_rows=4,
+                     class_watermarks={"best_effort": 0.5})
+    # The watermark bounds backlog, not request size — even for the
+    # class that sheds first.
+    core.admit(_item(rows=16, cls="best_effort"), None)
+    assert core.pending_rows == 16
+
+
+def test_shed_error_carries_retry_after_from_drain_rate():
+    core = SchedCore("Generate", max_pending_rows=4)
+    core.admit(_item(rows=4), None)
+    # No drain observed yet: the hint pins the cap (backlog not moving).
+    with pytest.raises(ResourceExhaustedError) as e:
+        core.admit(_item(rows=1), None)
+    assert e.value.retry_after_ms == 5000
+    # 4 rows pending at ~100 rows/s drains in ~40ms.
+    for _ in range(10):
+        core.note_drained(10)
+    hint = core.retry_after_ms()
+    assert 40 <= hint <= 1000  # span is clamped to >= 0.25s
+    with pytest.raises(ResourceExhaustedError) as e:
+        core.admit(_item(rows=1), None)
+    assert e.value.retry_after_ms == hint != 5000
+
+
+def test_pressure_tightens_one_class_at_a_time():
+    core = SchedCore("Process")  # NO max_pending_rows: unbounded queue
+    core.admit(_item(cls="standard"), None)
+    core.admit(_item(cls="best_effort"), None)  # level 0: admitted
+    core.pressure = 1
+    with pytest.raises(ResourceExhaustedError):
+        core.admit(_item(cls="best_effort"), None)
+    core.admit(_item(cls="standard"), None)  # level 1 spares standard
+    core.pressure = 2
+    with pytest.raises(ResourceExhaustedError):
+        core.admit(_item(cls="standard"), None)
+    core.admit(_item(cls="critical"), None)  # critical never tightens
+    assert core.shed_total == 2
+
+
+def test_pressure_sheds_even_against_an_empty_queue():
+    # The empty-queue exemption belongs to the ROW watermark only: a
+    # tightened class sheds unconditionally, else the dispatch loop
+    # draining the whole queue per pop would re-admit most best_effort
+    # traffic between launches while the SLO burns.
+    core = SchedCore("Process", max_pending_rows=8)
+    core.pressure = 1
+    assert not core.has_pending()
+    with pytest.raises(ResourceExhaustedError):
+        core.admit(_item(cls="best_effort"), None)
+    core.admit(_item(cls="standard"), None)  # the watermark path keeps
+    #                                          its empty-queue edge
+
+
+def test_governor_hysteresis_raises_and_lowers_one_class_at_a_time():
+    class FakeTracker:
+        def __init__(self):
+            self.burning = False
+
+        def status(self):
+            return {"objectives": [{"burning": self.burning}]}
+
+    tracker = FakeTracker()
+    core = SchedCore("Process")
+    gov = AdmissionGovernor(tracker, [core], raise_after=2, lower_after=3)
+    assert gov.tick() == 0
+    tracker.burning = True
+    assert gov.tick() == 0       # one breaching tick is not a trend
+    assert gov.tick() == 1       # raise_after=2 -> tighten best_effort
+    assert core.pressure == 1
+    assert gov.tick() == 0 or True  # streak reset; keep ticking
+    gov.tick()
+    assert gov.level == 2        # two more breaching ticks -> standard
+    gov.tick()
+    assert gov.level == 2        # max_level caps at 2 (critical never)
+    tracker.burning = False
+    for _ in range(3):
+        gov.tick()
+    assert gov.level == 1        # lower_after=3 calm ticks -> one step
+    for _ in range(3):
+        gov.tick()
+    assert gov.level == 0 and core.pressure == 0
+
+
+def test_sampler_ticks_governor_and_class_pending_gauge():
+    from tpu_dist_nn.obs import RuntimeSampler
+
+    class FakeTracker:
+        def status(self):
+            return {"objectives": [{"burning": True}]}
+
+    core = SchedCore("Process")
+    gov = AdmissionGovernor(FakeTracker(), [core], raise_after=1)
+    reg = Registry()
+    sampler = RuntimeSampler(interval=30.0, registry=reg)
+
+    class FakeBatcher:
+        _pending = []
+        pending_rows = 0
+        inflight_rows = 0
+        requests_total = 0
+        batches_total = 0
+
+        def pending_by_class(self):
+            return {"critical": 2, "standard": 0, "best_effort": 5}
+
+    sampler.add_batcher(FakeBatcher(), method="Process")
+    sampler.add_admission_governor(gov)
+    sampler.sample_once()
+    assert core.pressure == 1
+    g = reg.get("tdn_sched_class_pending_rows")
+    assert g.labels(method="Process", slo_class="best_effort").value == 5.0
+    assert g.labels(method="Process", slo_class="critical").value == 2.0
+
+
+# ---------------------------------------------------------------- expiry
+
+
+def test_expired_entry_fails_deadline_exceeded_at_pop_without_launch():
+    core = SchedCore("Process", submit_timeout=30.0)
+    live = _item(cls="standard")
+    dead = _item(cls="best_effort")
+    core.admit(live, timeout=30.0)
+    core.admit(dead, timeout=0.01)  # caller budget ~gone already
+    before = _counter("tdn_batcher_expired_total", method="Process",
+                      slo_class="best_effort")
+    time.sleep(0.03)
+    with core.cond:
+        batch, rows = core.pop_group(max_rows=100)
+    core.drain_deferred()
+    # The expired entry never joins a launch; its waiter gets the
+    # deadline verdict immediately.
+    assert batch == [live] and rows == 1
+    assert dead["done"].is_set()
+    assert isinstance(dead["err"], DeadlineExceededError)
+    assert "not launched" in str(dead["err"])
+    assert core.expired_total == 1
+    assert core.pending_rows == 0
+    assert _counter("tdn_batcher_expired_total", method="Process",
+                    slo_class="best_effort") == before + 1
+
+
+def test_expired_row_fails_at_bind_time_row_granular():
+    core = SchedCore("Generate")
+    dead = _item(rows=2, cls="standard")
+    dead["next_row"] = 0
+    core.admit(dead, timeout=0.01)
+    time.sleep(0.03)
+    with core.cond:
+        assert core.pop_row() is None
+    assert isinstance(dead["err"], DeadlineExceededError)
+    assert core.pending_rows == 0
+
+
+def test_close_sweep_fails_leftovers_unavailable_once():
+    core = SchedCore("Process")
+    items = [_item(cls=c) for c in ("critical", "best_effort")]
+    for it in items:
+        core.admit(it, None)
+    core.close_begin()
+    with pytest.raises(UnavailableError):
+        core.admit(_item(), None)
+    core.sweep_leftovers()
+    for it in items:
+        assert it["done"].is_set()
+        assert isinstance(it["err"], UnavailableError)
+    assert core.pending_rows == 0
+    core.sweep_leftovers()  # idempotent on an empty queue
+
+
+# ----------------------------------------------------- retry-after wire
+
+
+def test_retry_policy_backoff_floor_spreads_above_hint():
+    p = RetryPolicy(base_delay=0.001, max_delay=0.01, seed=3)
+    draws = [p.backoff(1, floor=0.2) for _ in range(50)]
+    assert all(0.2 <= d <= 0.25 for d in draws), draws[:5]
+    assert len(set(draws)) > 1, "floor must keep jitter, not pin it"
+    # No floor: the plain capped-jitter draw.
+    assert 0.0 <= p.backoff(1) <= 0.001
+
+
+def test_shed_reply_carries_retry_after_and_client_honors_floor():
+    import grpc
+
+    eng = AsyncFakeEngine(dim=8)
+    eng.gate.clear()  # wedge the fetch so the queue holds
+    server, port = serve_engine(
+        eng, 0, host="127.0.0.1", coalesce=True, max_pending_rows=4,
+        submit_timeout=10.0, pipeline_depth=1,
+    )
+    clients, threads = [], []
+    try:
+        def call(value):
+            c = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                           retry=None, breaker=None)
+            clients.append(c)
+            return c.process(np.full((2, 8), value))
+
+        def _bg(fn):
+            out = {}
+
+            def run():
+                try:
+                    out["val"] = fn()
+                except Exception as e:  # noqa: BLE001 — inspected
+                    out["err"] = e
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            return t, out
+
+        t1, o1 = _bg(lambda: call(1.0))
+        assert eng.fetch_entered.wait(5.0)
+        t2, o2 = _bg(lambda: call(2.0))
+        t3, o3 = _bg(lambda: call(3.0))
+        deadline = time.monotonic() + 5.0
+        while (server.batcher.pending_rows < 4
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        threads.extend([t1, t2, t3])
+
+        # A no-retry client sees the shed WITH the backoff hint in
+        # trailing metadata (parsed onto the error).
+        c4 = GrpcClient(f"127.0.0.1:{port}", timeout=10.0,
+                        retry=None, breaker=None)
+        clients.append(c4)
+        with pytest.raises(grpc.RpcError) as e:
+            c4.process(np.full((2, 8), 4.0))
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert e.value.retry_after_ms is not None
+        assert e.value.retry_after_ms >= 50
+
+        # A retrying client treats the shed as retryable and floors
+        # its backoff at the hint: with the queue still wedged, both
+        # retries shed too and the elapsed time proves the floor held
+        # (hint is 5000ms cap here — no drain observed — so bound the
+        # test by budget instead: the retry must NOT fire hot).
+        sleeps = []
+        policy = RetryPolicy(max_attempts=2, base_delay=0.001,
+                             max_delay=0.002, seed=0,
+                             sleep=lambda s: sleeps.append(s))
+        c5 = GrpcClient(f"127.0.0.1:{port}", timeout=30.0,
+                        retry=policy, breaker=None)
+        clients.append(c5)
+        with pytest.raises(grpc.RpcError) as e:
+            c5.process(np.full((2, 8), 5.0))
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert len(sleeps) == 1, "shed must be retried (once)"
+        assert sleeps[0] >= 5.0, (
+            "backoff must be floored at the server hint, not the "
+            f"client's 2ms cap (slept {sleeps[0]})"
+        )
+    finally:
+        eng.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        server.stop(0)
+        for c in clients:
+            c.close()
+
+
+# ------------------------------------------------- schedulers share it
+
+
+def test_both_schedulers_ride_one_core_implementation():
+    from tpu_dist_nn.serving.continuous import ContinuousScheduler
+    from tpu_dist_nn.serving.server import _Batcher
+
+    b = _Batcher(AsyncFakeEngine(dim=4), max_pending_rows=8)
+    s = ContinuousScheduler(
+        None, None, slots=1, prompt_len=4, max_new_tokens=2,
+        prefill_fn=lambda *a: (np.int32(1), a[1]),
+        step_fn=lambda p, c, pos, act, tok, k: (np.asarray(tok) + 1, c),
+        max_pending_rows=8,
+    )
+    try:
+        assert type(b._core) is SchedCore
+        assert type(s._sched_core) is SchedCore
+        # The delegated legacy surface reads through to ONE ledger.
+        for sched in (b, s):
+            assert sched.pending_rows == 0
+            assert sched.shed_total == 0
+            assert sched.requests_total == 0
+            assert sched._pending == []
+    finally:
+        b.close()
+        s.close()
+
+
+# ------------------------------------------------------------ preemption
+
+
+def _fake_sched(step_cost=0.0, **kw):
+    from tpu_dist_nn.serving.continuous import ContinuousScheduler
+
+    def fake_prefill(params, cache, slot, tokens, start, key):
+        if step_cost:
+            time.sleep(step_cost)
+        return np.int32(1), cache
+
+    def fake_step(params, cache, pos, active, tok, key):
+        if step_cost:
+            time.sleep(step_cost)
+        return np.asarray(tok) + 1, cache
+
+    kw.setdefault("slots", 1)
+    kw.setdefault("prompt_len", 4)
+    kw.setdefault("max_new_tokens", 8)
+    return ContinuousScheduler(
+        None, None, prefill_fn=fake_prefill, step_fn=fake_step, **kw
+    )
+
+
+def test_critical_preempts_lowest_class_resident_and_rebinds():
+    sched = _fake_sched(step_cost=0.01, slots=1)
+    outs = {}
+
+    def submit(name, cls):
+        outs[name] = sched.submit(
+            np.zeros((1, 4), np.int32), slo_class=cls, timeout=30.0
+        )
+
+    try:
+        t_victim = threading.Thread(
+            target=submit, args=("victim", "best_effort")
+        )
+        t_victim.start()
+        deadline = time.monotonic() + 5.0
+        # Wait until the victim is mid-decode (>= 2 tokens generated).
+        while time.monotonic() < deadline:
+            occ = sched._occupant[0]
+            if occ is not None and len(occ["tokens"]) >= 2:
+                break
+            time.sleep(0.001)
+        t_crit = threading.Thread(target=submit, args=("crit", "critical"))
+        t_crit.start()
+        # The critical must evict the best_effort resident and own the
+        # slot while the victim waits in the resume queue.
+        deadline = time.monotonic() + 5.0
+        seen_crit_resident = False
+        while time.monotonic() < deadline:
+            occ = sched._occupant[0]
+            if (occ is not None
+                    and occ["item"].get("slo_class") == "critical"):
+                seen_crit_resident = True
+                break
+            time.sleep(0.001)
+        assert seen_crit_resident, "critical never took the slot"
+        assert sched.preempted_total == 1
+        t_crit.join(30)
+        t_victim.join(30)
+        # Fake kernels are deterministic (prefill samples 1, each step
+        # +1): an unpreempted run yields exactly 1..8 — the preempted
+        # and replayed victim must bit-match it.
+        expected = np.concatenate(
+            [np.zeros(4, np.int64), np.arange(1, 9)]
+        )
+        np.testing.assert_array_equal(outs["victim"][0], expected)
+        np.testing.assert_array_equal(outs["crit"][0], expected)
+        assert _counter("tdn_gen_preemptions_total",
+                        slo_class="best_effort") >= 1
+    finally:
+        sched.close()
+
+
+def test_preempted_greedy_generate_bit_matches_unpreempted():
+    """The acceptance anchor: preempt a real-model greedy decode
+    mid-stream, resume it (prompt re-prefill + forced-token replay),
+    and the final sequence is BIT-identical to the run that was never
+    preempted."""
+    import jax
+
+    from tpu_dist_nn.models.generate import generate
+    from tpu_dist_nn.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from tpu_dist_nn.serving.continuous import ContinuousScheduler
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=3, d_ff=64,
+        max_seq_len=24,
+    )
+    params = init_transformer(jax.random.key(11), cfg)
+    T, N = 8, 10
+    rng = np.random.default_rng(5)
+    victim_prompt = rng.integers(0, cfg.vocab_size, (1, T))
+    crit_prompt = rng.integers(0, cfg.vocab_size, (1, T))
+    oracle = np.asarray(
+        generate(params, cfg, victim_prompt.astype(np.int32), N)
+    )
+
+    sched = ContinuousScheduler(
+        params, cfg, slots=1, prompt_len=T, max_new_tokens=N,
+    )
+    outs = {}
+
+    def submit(name, prompt, cls):
+        outs[name] = sched.submit(prompt, slo_class=cls, timeout=60.0)
+
+    try:
+        tv = threading.Thread(
+            target=submit, args=("victim", victim_prompt, "best_effort")
+        )
+        tv.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            occ = sched._occupant[0]
+            if occ is not None and 2 <= len(occ["tokens"]) < N:
+                break
+            time.sleep(0.0005)
+        tc = threading.Thread(
+            target=submit, args=("crit", crit_prompt, "critical")
+        )
+        tc.start()
+        tc.join(60)
+        tv.join(60)
+        assert sched.preempted_total >= 1, "preemption never fired"
+        np.testing.assert_array_equal(
+            outs["victim"][0, T:], oracle[0],
+            err_msg="preempted-and-resumed greedy decode must "
+                    "bit-match the unpreempted run",
+        )
+    finally:
+        sched.close()
+
+
+def test_preemption_never_evicts_critical_for_critical():
+    sched = _fake_sched(step_cost=0.01, slots=1)
+    outs = []
+
+    def submit(cls):
+        outs.append(
+            sched.submit(np.zeros((1, 4), np.int32), slo_class=cls,
+                         timeout=30.0)
+        )
+
+    try:
+        t1 = threading.Thread(target=submit, args=("critical",))
+        t1.start()
+        deadline = time.monotonic() + 5.0
+        while sched._occupant[0] is None and time.monotonic() < deadline:
+            time.sleep(0.001)
+        t2 = threading.Thread(target=submit, args=("critical",))
+        t2.start()
+        t1.join(30)
+        t2.join(30)
+        assert sched.preempted_total == 0
+        assert len(outs) == 2
+    finally:
+        sched.close()
+
+
+# ------------------------------------------------------- overload drill
+
+
+def test_overload_drill_critical_holds_best_effort_absorbs():
+    """The satellite chaos test: 2x sustained admission on the paced
+    fake engine — critical completes 100%, best_effort absorbs every
+    shed, and critical's p99 stays within the degradation target of
+    its uncontended baseline."""
+    import bench
+
+    r = bench.slo_class_bench(seconds=0.8)
+    over = r["overloaded"]
+    # Every critical arrival completed (none shed, none errored).
+    assert "critical" not in over["sheds"]
+    assert not over["errors"]
+    assert over["per_class"]["critical"]["completed"] > 0
+    # best_effort absorbed >= 90% of the sheds (the acceptance bar).
+    assert r["shed_total"] > 0
+    assert r["best_effort_shed_share"] >= 0.9
+    # Preemption actually fired under the overload.
+    assert r["preempted"] > 0
+    # p99 target with a noise allowance above the 1.3x acceptance bar
+    # (the bench records the honest number; bench_gate holds the
+    # cross-round line on slo_class_critical_p99_ms).
+    assert r["critical_p99_ratio"] is not None
+    assert r["critical_p99_ratio"] <= 1.35, r
+
+
+# ----------------------------------------------------- router class hop
+
+
+def test_router_forwards_class_and_server_labels_it():
+    from tpu_dist_nn.obs.registry import REGISTRY as _REG
+    from tpu_dist_nn.serving.pool import ReplicaPool
+    from tpu_dist_nn.serving.router import serve_router
+
+    eng = AsyncFakeEngine(dim=8)
+    server, port = serve_engine(eng, 0, host="127.0.0.1", coalesce=True)
+    pool = ReplicaPool([f"127.0.0.1:{port}"], scrape_interval=30.0)
+    rsrv, rport = serve_router(pool, 0, host="127.0.0.1")
+    wait = _REG.get("tdn_sched_class_wait_seconds")
+    before = wait.labels(method="Process", slo_class="critical").value
+    try:
+        c = GrpcClient(f"127.0.0.1:{rport}", timeout=10.0,
+                       retry=None, breaker=None, slo_class="critical")
+        out = c.process(np.ones((2, 8)))
+        np.testing.assert_array_equal(out, np.full((2, 8), 2.0))
+        c.close()
+        # The class label landed SERVER-side: x-tdn-class crossed the
+        # router hop intact.
+        after = wait.labels(method="Process", slo_class="critical").value
+        assert after == before + 1
+    finally:
+        rsrv.stop(0)
+        pool.close()
+        server.stop(0)
+
+
+def test_shed_retry_after_hint_crosses_the_router_hop():
+    import grpc
+
+    from tpu_dist_nn.serving.pool import ReplicaPool
+    from tpu_dist_nn.serving.router import serve_router
+
+    eng = AsyncFakeEngine(dim=8)
+    eng.gate.clear()  # wedge the fetch so the replica's queue holds
+    server, port = serve_engine(
+        eng, 0, host="127.0.0.1", coalesce=True, max_pending_rows=4,
+        submit_timeout=10.0, pipeline_depth=1,
+    )
+    pool = ReplicaPool([f"127.0.0.1:{port}"], scrape_interval=30.0)
+    rsrv, rport = serve_router(pool, 0, host="127.0.0.1")
+    clients, threads = [], []
+    try:
+        def call(value):
+            c = GrpcClient(f"127.0.0.1:{rport}", timeout=10.0,
+                           retry=None, breaker=None)
+            clients.append(c)
+            return c.process(np.full((2, 8), value))
+
+        def start(value):
+            t = threading.Thread(target=lambda: call(value), daemon=True)
+            t.start()
+            threads.append(t)
+
+        start(1.0)
+        assert eng.fetch_entered.wait(5.0)
+        start(2.0)
+        start(3.0)
+        deadline = time.monotonic() + 5.0
+        while (server.batcher.pending_rows < 4
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        c4 = GrpcClient(f"127.0.0.1:{rport}", timeout=10.0,
+                        retry=None, breaker=None)
+        clients.append(c4)
+        with pytest.raises(grpc.RpcError) as e:
+            c4.process(np.full((2, 8), 4.0))
+        # The replica's shed verdict AND its drain-rate hint both
+        # crossed the router hop.
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert e.value.retry_after_ms is not None
+        assert e.value.retry_after_ms >= 50
+    finally:
+        eng.gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        rsrv.stop(0)
+        pool.close()
+        server.stop(0)
+        for c in clients:
+            c.close()
+
+
+def test_hedge_skipped_for_best_effort_class():
+    from tpu_dist_nn.serving.router import HedgePolicy, Router
+
+    calls = []
+
+    class FakeLatency:
+        def samples(self):
+            class Child:
+                value = 1000
+
+                def quantile(self, q):
+                    return 0.05
+
+            return [(("Process",), Child())]
+
+    hedge = HedgePolicy(p99_ratio=2.0, latency=FakeLatency())
+
+    class FakeBreaker:
+        state = "closed"
+
+        def record_success(self):
+            pass
+
+        def record_failure(self):
+            pass
+
+    class FakeRep:
+        target = "fake:1"
+        breaker = FakeBreaker()
+
+        def call(self, method, payload, timeout=None, metadata=None):
+            calls.append(("plain", metadata))
+            return b"ok"
+
+        def call_future(self, *a, **k):
+            raise AssertionError("hedged path must not fire")
+
+    class FakePool:
+        def place(self, session_key=None, exclude=None):
+            return FakeRep()
+
+        def begin(self, rep):
+            pass
+
+        def done(self, rep):
+            pass
+
+        def replicas(self):
+            return []
+
+        def pin(self, *a):
+            pass
+
+    router = Router(FakePool(), hedge=hedge)
+
+    class Ctx:
+        trace_id = "t"
+        sampled = False
+
+        @staticmethod
+        def header():
+            return "h"
+
+    class Span:
+        ctx = Ctx()
+
+        @staticmethod
+        def annotate(msg):
+            pass
+
+    # best_effort: the plain forward runs even though hedging applies
+    # to the method and has latency history.
+    reply, err, rep, hedged = router._forward(
+        "Process", b"x", FakeRep(), None, [], Span(), 1, set(),
+        slo_class="best_effort",
+    )
+    assert reply == b"ok" and not hedged
+    assert calls and calls[0][0] == "plain"
+
+
+# -------------------------------------------------------- goodput pads
+
+
+def test_goodput_replay_and_dead_waiter_pads_conserve():
+    from tpu_dist_nn.obs.goodput import GoodputTracker, LMFlopModel
+
+    reg = Registry()
+    gp = GoodputTracker(registry=reg)
+    model = LMFlopModel(2, 16, 32, 64, 12)
+    # Decode step with a replaying lane: useful + pads == slots * step.
+    gp.record_decode_step(model, [4, 5], 1, 1, replay_slots=1)
+    snap = gp.snapshot()
+    sf = model.step_flops()
+    assert snap["pad_reasons"]["preempt_replay"] == sf
+    assert snap["flops"]["total"] == 5 * sf
+    assert (snap["flops"]["useful"] + snap["flops"]["pad"]
+            == snap["flops"]["total"])
+    # Static generate with a dead waiter: its full ride is pad.
+    reg2 = Registry()
+    gp2 = GoodputTracker(registry=reg2)
+    out = np.zeros((4, 12), np.int64)
+    gp2.record_static_generate(model, out, 3, 4, 8, None, dead_rows=1)
+    snap2 = gp2.snapshot()
+    per_row = model.chunk_flops(8) + 3 * sf  # prefill + (12-8-1) steps
+    assert snap2["pad_reasons"]["dead_waiter"] == per_row
+    assert snap2["flops"]["total"] == 4 * per_row
+    assert (snap2["flops"]["useful"] + snap2["flops"]["pad"]
+            == snap2["flops"]["total"])
+
+
+# ------------------------------------------------------------ gate rule
+
+
+def test_bench_gate_slo_class_critical_p99_skip_and_fail(tmp_path):
+    import json
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+
+    def round_doc(p99=None):
+        doc = {"backend": "cpu", "value": 100.0}
+        if p99 is not None:
+            doc["serving"] = {"slo_classes": {"critical_p99_ms": p99}}
+        return doc
+
+    # Absent in the older round -> per-metric skip, not a failure.
+    verdict = bench_gate.compare(round_doc(), round_doc(60.0))
+    rows = {r["metric"]: r for r in verdict["metrics"]}
+    assert "skipped" in rows["slo_class_critical_p99_ms"], \
+        "rounds predating ISSUE 15 must skip, not fail"
+    assert "slo_class_critical_p99_ms" not in verdict["regressions"]
+    # Lower is better: a 50% p99 blowup is a regression...
+    verdict = bench_gate.compare(round_doc(60.0), round_doc(90.0))
+    assert "slo_class_critical_p99_ms" in verdict["regressions"]
+    # ...and an improvement passes.
+    verdict = bench_gate.compare(round_doc(60.0), round_doc(40.0))
+    assert "slo_class_critical_p99_ms" not in verdict["regressions"]

@@ -33,6 +33,8 @@ Gated metrics (docs/PERF.md "Regression gate"):
                                                                  lower
     serving_mfu                     serving.goodput.mfu          higher
     serving_pad_ratio               serving.goodput.pad_ratio    lower
+    slo_class_critical_p99_ms       serving.slo_classes.critical_p99_ms
+                                                                 lower
 
 Rules:
 
@@ -131,6 +133,13 @@ GATED_METRICS = (
     # skip.
     ("serving_mfu", ("serving", "goodput", "mfu"), "higher"),
     ("serving_pad_ratio", ("serving", "goodput", "pad_ratio"), "lower"),
+    # Degradation ladder (ISSUE 15): the critical class's p99 under
+    # the 2x mixed-class overload A/B — the latency the SLO pages on
+    # when the fleet is saturated, lower is better (the ROADMAP
+    # target: holds ~flat while best_effort absorbs the sheds).
+    # Absent in pre-ISSUE-15 rounds -> per-metric skip.
+    ("slo_class_critical_p99_ms",
+     ("serving", "slo_classes", "critical_p99_ms"), "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -131,6 +131,7 @@ _TICK_PROTOCOL = {
     "add_autoscaler": "tick",
     "add_incident_recorder": "check",
     "add_goodput": "tick",
+    "add_admission_governor": "tick",
 }
 _BLOCKING_MODULE_ROOTS = {
     "socket", "subprocess", "urllib", "requests", "http",

@@ -295,12 +295,18 @@ def _validate_slo_flags(args, needs: str | None = None) -> None:
 
 
 def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
-                    latency_match=None, availability_kwargs=None):
+                    latency_match=None, availability_kwargs=None,
+                    scheduler=None):
     """Attach the fleet-observability plane to one serving command:
     a time-series ring sampled every tick (GET /timeseries), the
     goodput tracker's MFU/pad gauge tick + GET /goodput, plus — when
     SLO flags were passed — the burn-rate tracker (GET /slo, tdn_slo_*
-    gauges, slo.burn events). Returns (ring, tracker)."""
+    gauges, slo.burn events). ``scheduler`` (the server's batcher /
+    continuous scheduler) additionally closes the degradation-ladder
+    loop: an AdmissionGovernor maps the tracker's fast-burn verdict to
+    admission pressure, one SLO class at a time
+    (docs/ROBUSTNESS.md "Degradation ladder"). Returns (ring,
+    tracker)."""
     if metrics_server is None or sampler is None:
         return None, None
     from tpu_dist_nn.obs.goodput import GOODPUT
@@ -332,6 +338,18 @@ def _wire_fleet_obs(args, metrics_server, sampler, *, latency_family,
     if objectives:
         tracker = SLOTracker(ring, objectives)
         sampler.add_slo_tracker(tracker)
+        core = getattr(scheduler, "_core", None) or getattr(
+            scheduler, "_sched_core", None
+        )
+        if core is not None:
+            from tpu_dist_nn.serving.sched_core import AdmissionGovernor
+
+            # Burn-rate tightening: sustained fast burn > 1 sheds
+            # best_effort admission first, then standard; sustained
+            # calm releases one class at a time.
+            sampler.add_admission_governor(
+                AdmissionGovernor(tracker, [core])
+            )
     metrics_server.attach(timeseries=ring, slo=tracker, goodput=GOODPUT)
     return ring, tracker
 
@@ -613,6 +631,9 @@ def cmd_up(args) -> int:
         server, bound = serve_engine(
             engine, args.grpc_port, warm_rows=args.serve_warm_rows,
             max_pending_rows=args.max_pending_rows,
+            class_watermarks=_parse_class_watermarks(
+                getattr(args, "class_watermarks", None)
+            ),
         )
         # SIGTERM → drain: healthz NOT_SERVING, stop accepting, finish
         # in-flight within --drain-grace-seconds, then exit the loop.
@@ -637,6 +658,7 @@ def cmd_up(args) -> int:
                     "total_family": "tdn_rpc_requests_total",
                     "bad_family": "tdn_rpc_errors_total",
                 },
+                scheduler=server.batcher,
             )
             # Flight recorder (ISSUE 11): detectors on the sampler
             # tick, bundles into --incident-dir, /debug/bundle +
@@ -756,6 +778,10 @@ def _infer_over_grpc(args) -> int:
         # Rides as x-tdn-session: the router pins this client's
         # requests to one replica (an engine server ignores it).
         kwargs["session_key"] = args.session_key
+    if getattr(args, "slo_class", None):
+        # Rides as x-tdn-class: admission priority + shed watermark
+        # (docs/ROBUSTNESS.md "Degradation ladder").
+        kwargs["slo_class"] = args.slo_class
     client = GrpcClient(args.target, timeout=args.timeout or 30.0, **kwargs)
     try:
         if args.input_index is not None:
@@ -795,6 +821,32 @@ def _parse_targets(text):
     if not text:
         return []
     return [t for t in text.replace(",", " ").split() if t]
+
+
+def _parse_class_watermarks(text):
+    """``--class-watermarks 'critical=1.0,best_effort=0.5'`` -> the
+    validated full per-class fraction table (None = defaults). Fails
+    fast on unknown classes or fractions outside [0, 1]."""
+    if not text:
+        return None
+    from tpu_dist_nn.serving.sched_core import validate_class_watermarks
+
+    table = {}
+    for part in text.replace(",", " ").split():
+        cls, sep, frac = part.partition("=")
+        if not sep:
+            raise ValueError(
+                f"--class-watermarks entries are class=fraction, got "
+                f"{part!r}"
+            )
+        try:
+            table[cls.strip()] = float(frac)
+        except ValueError:
+            raise ValueError(
+                f"--class-watermarks fraction for {cls.strip()!r} must "
+                f"be a number, got {frac!r}"
+            ) from None
+    return validate_class_watermarks(table)
 
 
 def cmd_router(args) -> int:
@@ -2165,6 +2217,9 @@ def cmd_lm(args) -> int:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.seed,
             max_pending_rows=args.max_pending_rows,
+            class_watermarks=_parse_class_watermarks(
+                getattr(args, "class_watermarks", None)
+            ),
             scheduler=args.scheduler, gen_slots=args.gen_slots,
             eos_id=args.eos_id,
             prefix_cache_blocks=args.prefix_cache_blocks,
@@ -2216,6 +2271,7 @@ def cmd_lm(args) -> int:
                     "total_family": "tdn_rpc_requests_total",
                     "bad_family": "tdn_rpc_errors_total",
                 },
+                scheduler=server.batcher,
             )
             # Flight recorder over the generation endpoint: a burn,
             # shed storm, or crash mid-decode leaves its bundle.
@@ -3225,6 +3281,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "with RESOURCE_EXHAUSTED instead of backlogging "
                         "unboundedly (default: unbounded; "
                         "docs/ROBUSTNESS.md)")
+    p.add_argument("--class-watermarks", default=None, metavar="SPEC",
+                   help="per-SLO-class shed fractions of "
+                        "--max-pending-rows, e.g. "
+                        "'critical=1.0,standard=1.0,best_effort=0.5' "
+                        "(the default): best_effort sheds first, the "
+                        "headroom above its fraction stays reserved "
+                        "for the paging classes (docs/ROBUSTNESS.md "
+                        "'Degradation ladder')")
     p.add_argument("--drain-grace-seconds", type=float, default=5.0,
                    help="graceful-drain window on SIGTERM: /healthz "
                         "flips NOT_SERVING, new RPCs are refused, and "
@@ -3271,6 +3335,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "every RPC so a multi-replica router (tdn "
                         "router) pins the session to one replica; a "
                         "single server ignores it (docs/SCALING.md)")
+    p.add_argument("--slo-class", default=None,
+                   choices=["critical", "standard", "best_effort"],
+                   help="with --target: send this x-tdn-class SLO "
+                        "class on every RPC — queue priority and shed "
+                        "watermark at the server, hedging exemption "
+                        "for best_effort at the router (default: no "
+                        "header = standard; docs/ROBUSTNESS.md "
+                        "'Degradation ladder')")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler device trace here")
     p.set_defaults(fn=cmd_infer)
@@ -3704,6 +3776,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests that would queue past this many pending "
                         "rows are shed with RESOURCE_EXHAUSTED (default: "
                         "unbounded; docs/ROBUSTNESS.md)")
+    p.add_argument("--class-watermarks", default=None, metavar="SPEC",
+                   help="per-SLO-class shed fractions of "
+                        "--max-pending-rows for --serve-generate, e.g. "
+                        "'critical=1.0,standard=1.0,best_effort=0.5' "
+                        "(the default; docs/ROBUSTNESS.md "
+                        "'Degradation ladder')")
     p.add_argument("--drain-grace-seconds", type=float, default=5.0,
                    help="graceful-drain window on SIGTERM while serving "
                         "(--serve-generate): finish in-flight decodes "

@@ -375,12 +375,15 @@ class GoodputTracker:
 
     def record_decode_step(self, model: LMFlopModel, active_pos,
                            idle_slots: int, mid_prefill_slots: int, *,
+                           replay_slots: int = 0,
                            path: str = "gen") -> None:
         """One ``decode_step_slots`` launch: ``active_pos`` is the
         launch-time position of every ACTIVE slot; inactive lanes split
         into empty (``idle_slot``) and occupied-but-still-prefilling
         (``mid_prefill_slot``); active lanes' dead key extent is
-        ``attn_tail``."""
+        ``attn_tail``. ``replay_slots`` are active lanes re-running
+        tokens a preemption threw away (``preempt_replay`` — work
+        re-done, never useful twice)."""
         if not self.enabled:
             return
         sf = model.step_flops()
@@ -390,6 +393,8 @@ class GoodputTracker:
             pads["idle_slot"] = int(idle_slots) * sf
         if mid_prefill_slots > 0:
             pads["mid_prefill_slot"] = int(mid_prefill_slots) * sf
+        if replay_slots > 0:
+            pads["preempt_replay"] = int(replay_slots) * sf
         tail = len(list(active_pos)) * sf - useful
         if tail > 0:
             pads["attn_tail"] = tail
@@ -412,6 +417,7 @@ class GoodputTracker:
                                useful_rows: int, total_rows: int,
                                prompt_len: int,
                                eos_id: int | None, *,
+                               dead_rows: int = 0,
                                path: str = "gen") -> None:
         """One run-to-completion Generate launch (the static scheduler
         behind ``_Batcher``): ``outputs (total_rows, T + N)`` are the
@@ -419,7 +425,10 @@ class GoodputTracker:
         prefill+decode; real rows split per token — positions after a
         row's first EOS are ``eos_frozen`` pad (the done-mask keeps
         decoding them), masked attention tails are ``attn_tail``, the
-        prefill's non-final logits/tail ``chunk_tail``."""
+        prefill's non-final logits/tail ``chunk_tail``. ``dead_rows``
+        of the useful rows had waiters that abandoned after dispatch
+        (the one window deadline expiry cannot close): their full ride
+        is ``dead_waiter`` pad, never useful."""
         if not self.enabled or total_rows <= 0:
             return
         import numpy as np
@@ -431,6 +440,8 @@ class GoodputTracker:
         n_gen = width - T  # tokens per row (first one from the prefill)
         useful_rows = max(0, min(int(useful_rows), int(total_rows)))
         pad_rows = int(total_rows) - useful_rows
+        dead_rows = max(0, min(int(dead_rows), useful_rows))
+        useful_rows -= dead_rows
         prefill_total = model.chunk_flops(T)
         prefill_useful = model.chunk_useful_flops(0, T, final=True)
         sf = model.step_flops()
@@ -453,6 +464,12 @@ class GoodputTracker:
             pre_pads["pad_rows"] = pad_rows * prefill_total
             if steps:
                 dec_pads["pad_rows"] = pad_rows * steps * sf
+        if dead_rows:
+            # Full static ride at pad cost: the launch happened, nobody
+            # was waiting for these rows' results.
+            pre_pads["dead_waiter"] = dead_rows * prefill_total
+            if steps:
+                dec_pads["dead_waiter"] = dead_rows * steps * sf
         pre_tail = useful_rows * (prefill_total - prefill_useful)
         if pre_tail > 0:
             pre_pads["chunk_tail"] = pre_tail

@@ -75,6 +75,13 @@ class RuntimeSampler:
             "pipeline is actually overlapping)",
             labels=("method",),
         )
+        self._g_class_pending = reg.gauge(
+            "tdn_sched_class_pending_rows",
+            "rows waiting in the scheduler queue per SLO class (the "
+            "degradation ladder's per-class backlog view; sheds start "
+            "at each class's watermark fraction)",
+            labels=("method", "slo_class"),
+        )
         self._g_rss = reg.gauge(
             "tdn_host_rss_bytes", "resident set size of this process",
         )
@@ -164,6 +171,11 @@ class RuntimeSampler:
         # the incident recorders (an autoscale.flap must be visible to
         # the detector pass of the same tick).
         self._autoscalers: list = []
+        # Admission governors (ISSUE 15) tick right after the SLO
+        # trackers too: the burn verdict they map to admission
+        # pressure is this tick's, and a tightening this tick must be
+        # visible to the detector pass.
+        self._admission_governors: list = []
 
     # ------------------------------------------------------------ wiring
 
@@ -218,6 +230,15 @@ class RuntimeSampler:
         by this tick's detector pass)."""
         self._autoscalers.append(autoscaler)
 
+    def add_admission_governor(self, governor) -> None:
+        """Register an :class:`~tpu_dist_nn.serving.sched_core
+        .AdmissionGovernor` to tick once per sample, after the SLO
+        trackers evaluate (its input is the tracker's fresh fast-burn
+        verdict) and before the autoscalers/incident recorders see
+        the tick. The tick is pure — it reads the tracker's cached
+        status and flips an int on each scheduling core."""
+        self._admission_governors.append(governor)
+
     def add_incident_recorder(self, recorder) -> None:
         """Register a :class:`~tpu_dist_nn.obs.incident.FlightRecorder`
         whose detectors run once per tick, after the rings collected
@@ -257,7 +278,14 @@ class RuntimeSampler:
     def sample_once(self) -> None:
         """One synchronous sample of every source (also used by tests)."""
         for method, b in self._batchers:
-            self._g_queue.labels(method=method).set(len(b._pending))
+            # queue_depth() is the schedulers' lock-free O(1) read;
+            # len(_pending) (a full queue copy under the admission
+            # lock on the rebased schedulers) stays as the fallback
+            # for fakes predating the shared core.
+            depth_fn = getattr(b, "queue_depth", None)
+            self._g_queue.labels(method=method).set(
+                depth_fn() if callable(depth_fn) else len(b._pending)
+            )
             self._g_pending_rows.labels(method=method).set(
                 getattr(b, "pending_rows", 0)
             )
@@ -271,6 +299,12 @@ class RuntimeSampler:
             self._g_overlap.labels(method=method).set(
                 getattr(b, "overlapped_total", 0) / launches
             )
+            by_class = getattr(b, "pending_by_class", None)
+            if by_class is not None:
+                for cls, rows in by_class().items():
+                    self._g_class_pending.labels(
+                        method=method, slo_class=cls
+                    ).set(rows)
         if self._gen_scheds:
             self._g_gen_slots.set(
                 sum(int(s.slots_active) for s in self._gen_scheds)
@@ -336,6 +370,13 @@ class RuntimeSampler:
             ring.collect()
         for tracker in self._slo_trackers:
             tracker.evaluate()
+        for governor in self._admission_governors:
+            # Guarded per governor: one broken policy tick must not
+            # starve the autoscalers/detectors below of the same tick.
+            try:
+                governor.tick()
+            except Exception:  # noqa: BLE001 — admission must never kill sampling
+                log.exception("admission governor tick failed")
         for autoscaler in self._autoscalers:
             # Guarded per autoscaler: one broken policy tick must not
             # starve the incident recorders below of the same tick.

@@ -55,6 +55,14 @@ DEFAULT_FAMILIES = (
     "tdn_batch_wait_seconds",
     "tdn_batcher_pending_rows",
     "tdn_batcher_shed_total",
+    # Degradation ladder (ISSUE 15): per-class sheds/backlog, expiry,
+    # and the governor's tightening level — the /timeseries evidence
+    # of an overload handled selectively.
+    "tdn_sched_class_shed_total",
+    "tdn_sched_class_pending_rows",
+    "tdn_batcher_expired_total",
+    "tdn_sched_pressure",
+    "tdn_gen_preemptions_total",
     "tdn_gen_ttft_seconds",
     "tdn_gen_tokens_total",
     "tdn_gen_slots_active",

@@ -28,14 +28,24 @@ from tpu_dist_nn.serving.router import (  # noqa: F401
     router_health,
     serve_router,
 )
+from tpu_dist_nn.serving.sched_core import (  # noqa: F401
+    DEFAULT_CLASS_WATERMARKS,
+    SLO_CLASSES,
+    AdmissionGovernor,
+    SchedCore,
+    normalize_class,
+    validate_class_watermarks,
+)
 from tpu_dist_nn.serving.server import (  # noqa: F401
     GrpcClient,
     serve_engine,
     serve_lm_generate,
 )
 from tpu_dist_nn.serving.wire import (  # noqa: F401
+    CLASS_HEADER,
     GENERATE_METHOD,
     PROCESS_METHOD,
+    RETRY_AFTER_HEADER,
     SESSION_HEADER,
     WireMatrix,
     decode_matrix,

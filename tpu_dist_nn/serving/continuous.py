@@ -55,8 +55,11 @@ at DECODE-STEP granularity instead:
   the same scheduler iteration while the remaining slots keep
   decoding. Finished rows stream back to their waiters immediately.
 
-Resilience contract (docs/ROBUSTNESS.md): ``max_pending_rows``
-admission shedding (``tdn_batcher_shed_total``), ``close(timeout)``
+Resilience contract (docs/ROBUSTNESS.md): the admission/shed/close/
+drain machinery is the SHARED scheduling core
+(:mod:`~tpu_dist_nn.serving.sched_core` — one implementation with the
+Process batcher): class-priority admission with per-class shed
+watermarks, deadline-aware expiry at bind time, ``close(timeout)``
 letting resident rows — INCLUDING half-prefilled slots — finish before
 failing still-pending waiters over as UNAVAILABLE (the ``_Batcher``
 drain contract, so ``GracefulDrain`` works unchanged), and first-class
@@ -66,6 +69,16 @@ before every prefill-chunk dispatch (a mid-prefill fault fails that
 request over, frees its slot, and releases its prefix-block ref).
 Assign a ``testing/faults.py`` plan's ``fire`` directly (the
 ``inject_engine_faults`` helper covers only engine hooks).
+
+**Decode-slot preemption** (docs/ROBUSTNESS.md "Degradation ladder"):
+a ``critical``-class request that cannot bind evicts the best victim
+(dead-waiters first, then lowest class, then fewest generated tokens)
+and binds into the freed slot the same iteration; the victim
+re-queues with its generated prefix and resumes via prompt re-prefill
+(prefix-cache hits make it cheap) + forced-token REPLAY through the
+shared step kernel — the exact original computation, so greedy output
+is bit-identical to an unpreempted run and sampled runs keep their
+original stream.
 """
 
 from __future__ import annotations
@@ -83,6 +96,7 @@ from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.goodput import GOODPUT, LMFlopModel
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
+from tpu_dist_nn.serving.sched_core import CLASS_RANK, SchedCore
 
 log = logging.getLogger(__name__)  # plain channel (kept for debug use)
 slog = get_logger(__name__)
@@ -103,16 +117,14 @@ _RETIRED = REGISTRY.counter(
     "request rows retired from a decode slot, by reason",
     labels=("reason",),
 )
-_SHED = REGISTRY.counter(
-    "tdn_batcher_shed_total",
-    "submits fast-failed RESOURCE_EXHAUSTED at the pending-rows "
-    "watermark (admission control)",
-    labels=("method",),
-)
-_WAIT = REGISTRY.histogram(
-    "tdn_batch_wait_seconds",
-    "time a request spent in the batcher (submit to result)",
-    labels=("method",),
+# tdn_batcher_shed_total / tdn_batch_wait_seconds moved to
+# serving/sched_core.py — the shared admission/shed/close contract.
+_PREEMPTED = REGISTRY.counter(
+    "tdn_gen_preemptions_total",
+    "decode-slot preemptions: a resident row evicted mid-stream so a "
+    "critical-class request could bind, re-queued with its generated "
+    "prefix for replay (by the VICTIM's class)",
+    labels=("slo_class",),
 )
 # Same family (and meaning — rows per device launch) as the static
 # batcher's, so dashboards read the Generate series unchanged across
@@ -298,6 +310,8 @@ class ContinuousScheduler:
                  max_pending_rows: int | None = None,
                  prefix_cache_blocks: int = 0,
                  prefill_chunk: int | None = None,
+                 class_watermarks: dict | None = None,
+                 preemption: bool = True,
                  prefill_fn=None, step_fn=None, copy_fn=None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -305,10 +319,8 @@ class ContinuousScheduler:
         self._T = int(prompt_len)
         self._N = int(max_new_tokens)
         self._eos = None if eos_id is None else int(eos_id)
-        self._submit_timeout = submit_timeout
-        self._max_pending_rows = (
-            int(max_pending_rows) if max_pending_rows is not None else None
-        )
+        # submit_timeout / max_pending_rows / class_watermarks live in
+        # the shared scheduling core constructed below.
         self._counter = itertools.count()
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
@@ -395,17 +407,29 @@ class ContinuousScheduler:
         self.launch_hook = None
         self.fetch_hook = None
         self.prefill_hook = None
-        # Pending queue + admission ledger (same shape as _Batcher).
-        self._cond = threading.Condition()
-        self._pending: collections.deque[dict] = collections.deque()  # guarded-by: _cond
-        self.pending_rows = 0  # guarded-by: _cond
-        self._closed = False  # guarded-by: _cond
-        # _Batcher-compatible counters (runtime sampler contract).
-        self.requests_total = 0    # submit() calls admitted to the queue
+        # Pending queue + admission ledger: the shared scheduling core
+        # (serving/sched_core.py) — class-priority queue, watermark
+        # sheds, deadline expiry, close-failover sweep. The loop holds
+        # core.cond exactly where it held its own condition before.
+        self._sched_core = SchedCore(
+            self.method, max_pending_rows=max_pending_rows,
+            submit_timeout=submit_timeout,
+            class_watermarks=class_watermarks,
+        )
+        self._cond = self._sched_core.cond
+        # Preempted rows awaiting re-bind: class-annotated resume
+        # entries carrying the generated prefix for replay. Mutated
+        # under _cond (the loop pops there already; appends and the
+        # close sweep take it too, so a wedged-loop close can never
+        # race a pop and strand an entry's waiter).
+        self._resume: collections.deque[dict] = collections.deque()  # guarded-by: _cond
+        self._preemption = bool(preemption)
+        # _Batcher-compatible counters (runtime sampler contract;
+        # requests/shed/pending ride the core via properties below).
         self.rows_total = 0        # rows that entered a slot
         self.batches_total = 0     # step-kernel launches (steps_total
         #                            is a read alias — one source of truth)
-        self.shed_total = 0
+        self.preempted_total = 0   # rows evicted for a critical bind
         self.overlapped_total = 0  # N/A here; kept for sampler parity
         # Generation-specific stats.
         self.slot_steps_total = 0  # active slots summed over steps
@@ -414,8 +438,6 @@ class ContinuousScheduler:
         self.ttft_recent: collections.deque[float] = collections.deque(
             maxlen=1024
         )
-        self._m_shed = _SHED.labels(method=self.method)
-        self._m_wait = _WAIT.labels(method=self.method)
         self._m_rows = _BATCH_ROWS.labels(method=self.method)
         self._thread = threading.Thread(
             target=self._loop, name="tdn-gen-continuous", daemon=True
@@ -570,6 +592,48 @@ class ContinuousScheduler:
         """Rows resident in slots — decoding OR mid-prefill."""
         return sum(1 for o in self._occupant if o is not None)
 
+    # Legacy counter/queue surface, owned by the shared core (the
+    # runtime sampler, drain plumbing, and resilience tests read these
+    # names on both schedulers).
+    @property
+    def pending_rows(self) -> int:
+        """Rows awaiting a slot: queued fresh rows plus preempted rows
+        awaiting re-bind. Deliberately lock-free (GIL-atomic int read
+        + deque len): the runtime sampler's gauge read must never
+        queue behind admission."""
+        return (self._sched_core.pending_rows
+                + len(self._resume))  # tdnlint: disable=lock-discipline
+
+    @property
+    def requests_total(self) -> int:
+        return self._sched_core.requests_total
+
+    @property
+    def shed_total(self) -> int:
+        return self._sched_core.shed_total
+
+    @property
+    def expired_total(self) -> int:
+        return self._sched_core.expired_total
+
+    @property
+    def _pending(self) -> list:
+        return self._sched_core.pending_items()
+
+    @property
+    def _closed(self) -> bool:
+        return self._sched_core.closed
+
+    def queue_depth(self) -> int:
+        """Entries awaiting a slot (deliberately lock-free — the
+        runtime sampler's per-tick read): queued fresh items plus
+        preempted rows awaiting resume."""
+        return (self._sched_core.queue_depth()
+                + len(self._resume))  # tdnlint: disable=lock-discipline
+
+    def pending_by_class(self) -> dict:
+        return self._sched_core.pending_by_class()
+
     @property
     def slots(self) -> int:
         return self._S
@@ -614,7 +678,8 @@ class ContinuousScheduler:
         return self.prefix_hits_total / n if n else 0.0
 
     def submit(self, x: np.ndarray, *, max_new_tokens: int | None = None,
-               timeout: float | None = None, ctx=None) -> np.ndarray:
+               timeout: float | None = None, ctx=None,
+               slo_class: str = "standard") -> np.ndarray:
         """Block until every row of ``x (N, prompt_len)`` has finished
         generating; returns ``(N, prompt_len + max_new_tokens)`` int64
         (prompt included, post-retirement positions padded with
@@ -627,13 +692,10 @@ class ContinuousScheduler:
         (iteration-level scheduling makes per-request budgets free:
         the row simply retires earlier); the output width stays the
         endpoint's. ``timeout``/``ctx`` follow ``_Batcher.submit``.
+        ``slo_class`` sets queue priority and the shed watermark; a
+        ``critical`` row that cannot bind may PREEMPT a lower-class
+        resident (docs/ROBUSTNESS.md "Degradation ladder").
         """
-        from tpu_dist_nn.utils.errors import (
-            DeadlineExceededError,
-            ResourceExhaustedError,
-            UnavailableError,
-        )
-
         x = np.asarray(x, np.int32)
         if x.ndim != 2 or x.shape[1] != self._T:
             raise ValueError(
@@ -661,66 +723,19 @@ class ContinuousScheduler:
             "x": x, "budget": budget, "out": out, "next_row": 0,
             "remaining": n, "done": threading.Event(), "err": None,
             "abandoned": False, "t_submit": time.monotonic(),
+            "slo_class": slo_class,
             "ctx": ctx if ctx is not None and ctx.sampled else None,
         }
-        with self._cond:
-            if self._closed:
-                raise UnavailableError("server is shutting down")
-            # Admission control: same watermark semantics as _Batcher
-            # (an oversized request against an empty queue is admitted;
-            # the watermark bounds backlog, not request size).
-            if (self._max_pending_rows is not None and self._pending
-                    and self.pending_rows + n > self._max_pending_rows):
-                self.shed_total += 1
-                self._m_shed.inc()
-                raise ResourceExhaustedError(
-                    f"generation queue at capacity ({self.pending_rows} "
-                    f"rows pending, watermark {self._max_pending_rows}); "
-                    "back off and retry"
-                )
-            self._pending.append(item)
-            self.pending_rows += n
-            self.requests_total += 1
-            self._cond.notify()
-        bounds = [
-            t for t in (self._submit_timeout, timeout) if t is not None
-        ]
-        wait = min(bounds) if bounds else None
-        if not item["done"].wait(wait):
-            # Abandoned rows already decoding finish their (bounded)
-            # budget and are discarded; rows still pending are skipped
-            # at admission. Either way nobody computes for a caller
-            # that is gone for longer than one residual decode.
-            with self._cond:
-                item["abandoned"] = True
-            raise DeadlineExceededError(
-                f"generation did not complete within {wait}s "
-                "(decode wedged or request backlogged?)"
-            )
-        self._m_wait.observe(time.monotonic() - item["t_submit"])
-        if item["err"] is not None:
-            raise item["err"]
+        # Admission (class watermark, close check, deadline stamp) and
+        # the bounded wait are the shared core's contract — identical
+        # to _Batcher by construction. Abandoned rows already decoding
+        # finish their (bounded) budget and are discarded; rows still
+        # pending are skipped at bind.
+        self._sched_core.admit(item, timeout)
+        self._sched_core.wait(item, what="generation")
         return item["out"]
 
     # ------------------------------------------------------------ loop
-
-    def _pop_admittable(self):  # caller-holds: _cond
-        """Under ``_cond``: the next (item, row_index) to admit, or
-        None. Drops abandoned/failed items from the queue, returning
-        their rows to the ledger."""
-        while self._pending:
-            item = self._pending[0]
-            if item["abandoned"] or item["err"] is not None:
-                self._pending.popleft()
-                self.pending_rows -= len(item["x"]) - item["next_row"]
-                continue
-            row = item["next_row"]
-            item["next_row"] += 1
-            self.pending_rows -= 1
-            if item["next_row"] >= len(item["x"]):
-                self._pending.popleft()
-            return item, row
-        return None
 
     def _release_block(self, occ: dict) -> None:
         """Drop the occupant's prefix-block reference, if it holds one
@@ -782,6 +797,9 @@ class ContinuousScheduler:
         self.retired_total += 1
         _RETIRED.labels(reason=reason).inc()
         _TOKENS.inc(len(toks))
+        # Completions feed the drain-rate window behind the shed
+        # replies' x-tdn-retry-after-ms hint.
+        self._sched_core.note_drained(1)
         if item["ctx"] is not None:
             _trace.TRACER.record_span(
                 "decode", item["ctx"], occ["t_first"],
@@ -801,12 +819,21 @@ class ContinuousScheduler:
         of a long prompt on the scheduler loop thread."""
         return ((ln, row[:ln].tobytes()) for ln in self._tiers)
 
-    def _bind_slot(self, item: dict, row: int) -> None:
+    def _bind_slot(self, item: dict, row: int,
+                   resume: list | None = None) -> None:
         """Bind one pending row to a free slot (there is one — the
         caller checked): prefix-pool lookup, copy-on-write block copy
         on a hit, and the slot enters its chunked-prefill phase. No
         prompt tokens run here — chunks are the loop's per-iteration
-        work, so binding never stalls the decode frontier."""
+        work, so binding never stalls the decode frontier.
+
+        ``resume`` is a PREEMPTED row's generated token prefix: the
+        slot re-prefills the prompt (prefix-cache hits make that
+        cheap), then REPLAYS the prefix through the shared decode-step
+        kernel with forced tokens — the exact computation the original
+        run performed, so the resumed K/V and every subsequent greedy
+        token are bit-identical to an unpreempted run (and a sampled
+        run resumes its ORIGINAL stream instead of redrawing)."""
         slot = int(
             next(s for s in range(self._S) if self._occupant[s] is None)
         )
@@ -815,10 +842,13 @@ class ContinuousScheduler:
             "item": item, "row": row, "tokens": [],
             "budget": item["budget"], "t_first": None,
             "t_bind": now, "fill": 0, "block": None,
+            # Generated tokens to replay after the prompt re-prefill
+            # (preemption resume); None on a fresh bind.
+            "resume": list(resume) if resume else None,
         }
         self._occupant[slot] = occ
         self.rows_total += 1
-        if item["ctx"] is not None:
+        if item["ctx"] is not None and resume is None:
             _trace.TRACER.record_span(
                 "queue_wait", item["ctx"], item["t_submit"],
                 now - item["t_submit"],
@@ -939,9 +969,12 @@ class ContinuousScheduler:
         occ["fill"] = start + size
         self.prefill_chunks_total += 1
         if self._gp_model is not None:
+            # A resume re-prefill's last-position logits are DISCARDED
+            # (the first generated token is already known), so its
+            # final chunk carries no sampled-unembed useful work.
             GOODPUT.record_prefill_chunk(
                 self._gp_model, start, size,
-                final=occ["fill"] >= self._T,
+                final=occ["fill"] >= self._T and occ["resume"] is None,
             )
         now = time.monotonic()
         if item["ctx"] is not None:
@@ -954,6 +987,33 @@ class ContinuousScheduler:
             if self._occupant[slot] is not occ:
                 return  # an insert-copy fault failed the slot over
         if occ["fill"] < self._T:
+            return
+        if occ["resume"] is not None:
+            # Preemption resume: the first generated token is KNOWN —
+            # the prefill's last-position sample is discarded, the
+            # remaining prefix replays through the shared step kernel
+            # with forced tokens (bit-identical K/V to the original
+            # run; TTFT was observed on the first pass and is not
+            # re-counted).
+            known = occ["resume"]
+            occ["resume"] = None
+            occ["replay"] = known[1:]
+            first = int(known[0])
+            occ["t_first"] = now
+            if item["ctx"] is not None:
+                _trace.TRACER.record_span(
+                    "prefill", item["ctx"], occ["t_bind"],
+                    now - occ["t_bind"],
+                    attrs={
+                        "slot": slot, "prompt_len": self._T,
+                        "prefix_hit": occ["block"] is not None,
+                        "resume_tokens": len(known),
+                    },
+                )
+            occ["tokens"].append(first)
+            self._active[slot] = True
+            self._pos[slot] = self._T
+            self._tok[slot] = first
             return
         # Prefill complete: `tok` is the sample from the prompt's last
         # position — the first generated token.
@@ -1041,16 +1101,23 @@ class ContinuousScheduler:
             # retire loop advances it), occupied-but-chunking lanes are
             # mid_prefill pad, empty lanes idle pad.
             active_pos = []
-            idle = mid = 0
+            idle = mid = replay = 0
             for s in range(self._S):
                 if self._active[s]:
-                    active_pos.append(int(self._pos[s]))
+                    if self._occupant[s].get("replay"):
+                        # Re-doing work the preemption threw away:
+                        # booked as pad (reason preempt_replay), never
+                        # as useful.
+                        replay += 1
+                    else:
+                        active_pos.append(int(self._pos[s]))
                 elif self._occupant[s] is None:
                     idle += 1
                 else:
                     mid += 1
             GOODPUT.record_decode_step(
                 self._gp_model, active_pos, idle, mid,
+                replay_slots=replay,
             )
         dur = time.monotonic() - t0
         for occ in traced:
@@ -1064,6 +1131,17 @@ class ContinuousScheduler:
             if not self._active[s]:
                 continue
             occ = self._occupant[s]
+            if occ.get("replay"):
+                # Preemption replay: the step WROTE this position's
+                # K/V from the forced token (the same computation the
+                # original run performed); its sample is discarded —
+                # the next token is already known. No retire checks:
+                # the replayed stream was mid-decode when preempted.
+                forced = int(occ["replay"].pop(0))
+                occ["tokens"].append(forced)
+                self._pos[s] += 1
+                self._tok[s] = forced
+                continue
             tok = int(toks[s])
             occ["tokens"].append(tok)
             self._pos[s] += 1
@@ -1078,26 +1156,164 @@ class ContinuousScheduler:
         before close() may stop the loop)."""
         return any(o is not None for o in self._occupant)
 
+    def _next_bindable(self, max_rank: int | None = None):  # caller-holds: _cond
+        """The next row to bind, in class-priority order across BOTH
+        sources — preempted rows awaiting resume and the fresh queue
+        (a tie goes to the resume row: it was admitted earlier).
+        ``max_rank=0`` restricts to critical (the preemption pop).
+        Returns ``("resume", entry)`` / ``("fresh", (item, row))`` /
+        None."""
+        core = self._sched_core
+        while True:
+            # Best-ranked resume entry, FIFO within rank: _resume is
+            # one deque in preemption order, so a head-only peek would
+            # let an earlier best_effort eviction shadow a later
+            # standard one.
+            entry = idx = None
+            e_rank = 99
+            for i, cand in enumerate(self._resume):
+                r = CLASS_RANK.get(cand["slo_class"], 1)
+                if max_rank is not None and r > max_rank:
+                    continue
+                if r < e_rank:
+                    entry, idx, e_rank = cand, i, r
+                    if r == 0:
+                        break  # nothing outranks critical
+            f_rank = core.peek_rank()
+            if (f_rank is not None and max_rank is not None
+                    and f_rank > max_rank):
+                f_rank = None
+            if entry is not None and (f_rank is None or e_rank <= f_rank):
+                del self._resume[idx]
+                item = entry["item"]
+                if item["abandoned"] or item["err"] is not None:
+                    continue  # waiter gone while awaiting resume
+                dl = item.get("deadline")
+                if dl is not None and time.monotonic() >= dl:
+                    # Budget died while the row waited to resume: same
+                    # expiry contract as a queued entry.
+                    core._expire(item, time.monotonic())
+                    continue
+                return "resume", entry
+            got = core.pop_row(max_rank=max_rank)
+            if got is not None:
+                return "fresh", got
+            if entry is None:
+                return None
+            # Fresh queue exhausted (or all dead): retry the resume
+            # head on the next pass.
+
+    def _bind(self, bindable) -> None:
+        kind, data = bindable
+        if kind == "resume":
+            self._bind_slot(data["item"], data["row"],
+                            resume=data["tokens"])
+        else:
+            self._bind_slot(*data)
+
+    def _pick_victim(self) -> int | None:
+        """The slot to preempt for a critical bind: never a critical
+        resident; prefer occupants whose waiter is already gone
+        (abandoned / budget-expired — evicting them costs nothing),
+        then the LOWEST class, then the fewest generated tokens (the
+        cheapest replay). None when every resident is critical."""
+        now = time.monotonic()
+        best = best_key = None
+        for s in range(self._S):
+            occ = self._occupant[s]
+            if occ is None:
+                continue
+            item = occ["item"]
+            rank = CLASS_RANK.get(item.get("slo_class", "standard"), 1)
+            if rank == 0:
+                continue
+            dl = item.get("deadline")
+            dead = item["abandoned"] or (dl is not None and now >= dl)
+            key = (0 if dead else 1, -rank, len(occ["tokens"]))
+            if best_key is None or key < best_key:
+                best_key, best = key, s
+        return best
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Evict one resident so a critical row can bind: the victim's
+        prompt + generated prefix re-queue for resume (re-prefill +
+        forced-token replay — bit-identical continuation), its slot
+        and prefix-block reference free immediately."""
+        now = time.monotonic()
+        occ = self._occupant[slot]
+        item = occ["item"]
+        cls = item.get("slo_class", "standard")
+        # The full known generated stream, whatever phase the victim
+        # was in: mid-resume-prefill (resume holds it all), mid-replay
+        # (tokens + the un-replayed remainder), or plain decoding.
+        if occ.get("resume"):
+            prefix = list(occ["resume"])
+        else:
+            prefix = list(occ["tokens"]) + list(occ.get("replay") or ())
+        self._occupant[slot] = None
+        self._active[slot] = False
+        self._release_block(occ)
+        self.preempted_total += 1
+        _PREEMPTED.labels(slo_class=cls).inc()
+        if item["ctx"] is not None and occ["t_first"] is not None:
+            _trace.TRACER.record_span(
+                "decode", item["ctx"], occ["t_first"],
+                now - occ["t_first"],
+                attrs={"slot": slot, "steps": len(occ["tokens"]),
+                       "reason": "preempted"},
+            )
+        slog.info(
+            "gen.preempted", slot=slot, slo_class=cls,
+            tokens_generated=len(prefix),
+        )
+        if item["abandoned"] or item["err"] is not None:
+            return  # nobody is waiting: evicted work is simply dropped
+        with self._cond:
+            self._resume.append({
+                "item": item, "row": occ["row"], "tokens": prefix,
+                "slo_class": cls,
+            })
+
+    def _preempt_for_critical(self) -> None:
+        """While a critical row is queued with no free slot, evict the
+        best victim and bind the critical row INTO the freed slot —
+        same scheduler iteration, so the class the SLO pages on never
+        waits out a lower-class resident's full decode."""
+        while True:
+            victim = self._pick_victim()
+            if victim is None:
+                return
+            with self._cond:
+                got = self._next_bindable(max_rank=0)
+            if got is None:
+                return
+            self._preempt_slot(victim)
+            self._bind(got)
+
     def _loop(self) -> None:
+        core = self._sched_core
         while True:
             admits = []
             with self._cond:
-                while (not self._closed and not self._pending
-                       and not self._resident()):
+                while (not core.closed and not core.has_pending()
+                       and not self._resume and not self._resident()):
                     self._cond.wait()
-                if self._closed and not self._resident():
+                if core.closed and not self._resident():
                     return  # close() sweeps whatever is still pending
-                if not self._closed:
+                if not core.closed:
                     free = sum(1 for o in self._occupant if o is None)
                     while len(admits) < free:
-                        got = self._pop_admittable()
+                        got = self._next_bindable()
                         if got is None:
                             break
                         admits.append(got)
+            core.drain_deferred()
             # Device work OUTSIDE the lock: submitters must never block
             # behind a block copy, a prefill chunk, or a step.
-            for item, row in admits:
-                self._bind_slot(item, row)
+            for bindable in admits:
+                self._bind(bindable)
+            if self._preemption and not core.closed:
+                self._preempt_for_critical()
             slot = self._next_prefill_slot()
             if slot is not None:
                 self._prefill_chunk_once(slot)
@@ -1110,23 +1326,27 @@ class ContinuousScheduler:
         """Stop admitting, let resident rows — including half-prefilled
         slots, which finish their remaining chunks — complete their
         (bounded) decodes, then fail still-pending waiters over as
-        UNAVAILABLE — the ``_Batcher.close`` contract ``GracefulDrain``
-        relies on."""
+        UNAVAILABLE (preempted rows awaiting resume included) — the
+        ``_Batcher.close`` contract ``GracefulDrain`` relies on, now
+        one implementation in the shared core."""
         from tpu_dist_nn.utils.errors import UnavailableError
 
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
+        self._sched_core.close_begin()
         self._thread.join(timeout=timeout)
+        # Preempted rows still awaiting a resume slot are pending too:
+        # their waiters fail over like any queued entry's. Popped
+        # under _cond, so a still-alive (wedged past the join timeout)
+        # loop thread and this sweep can never double-serve or strand
+        # an entry.
         leftovers = []
         with self._cond:
-            while self._pending:
-                item = self._pending.popleft()
-                self.pending_rows -= len(item["x"]) - item["next_row"]
-                if not item["abandoned"] and item["err"] is None:
-                    leftovers.append(item)
-        for item in leftovers:
-            item["err"] = UnavailableError(
-                "server shut down before this request was served"
-            )
-            item["done"].set()
+            while self._resume:
+                leftovers.append(self._resume.popleft())
+        for entry in leftovers:
+            item = entry["item"]
+            if not item["abandoned"] and item["err"] is None:
+                item["err"] = UnavailableError(
+                    "server shut down before this request was served"
+                )
+                item["done"].set()
+        self._sched_core.sweep_leftovers()

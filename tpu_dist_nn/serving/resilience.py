@@ -131,11 +131,25 @@ class RetryPolicy:
     def retryable(self, code) -> bool:
         return _code_name(code) in self.retryable_codes
 
-    def backoff(self, attempt: int) -> float:
+    def backoff(self, attempt: int, floor: float | None = None) -> float:
         """Jittered delay BEFORE retry number ``attempt`` (1-based:
-        attempt 1 is the delay after the first failed call)."""
+        attempt 1 is the delay after the first failed call).
+
+        ``floor`` is a server-provided minimum (the shed replies'
+        ``x-tdn-retry-after-ms`` hint, in seconds): the draw is
+        clamped UP to it — jitter still spreads the herd above the
+        floor, but nobody retries before the server said the backlog
+        could have moved. The floor may exceed ``max_delay`` (the
+        server knows its own drain rate better than the client's cap).
+        """
         cap = min(self.max_delay, self.base_delay * (2 ** max(0, attempt - 1)))
-        return self._rng.uniform(0.0, cap)
+        delay = self._rng.uniform(0.0, cap)
+        if floor is not None and floor > 0:
+            # Full jitter ON TOP of the floor (up to 25%): a uniform
+            # clamp would stack every shed client on the exact floor
+            # tick — the synchronized storm the hint exists to break.
+            return max(delay, floor * self._rng.uniform(1.0, 1.25))
+        return delay
 
 
 class CircuitBreaker:

@@ -60,8 +60,14 @@ from tpu_dist_nn.serving.resilience import (
     RetryPolicy,
     _code_name,
 )
+from tpu_dist_nn.serving.sched_core import normalize_class
 from tpu_dist_nn.serving.server import _new_grpc_server, _request_span
-from tpu_dist_nn.serving.wire import SERVICE_NAME, SESSION_HEADER
+from tpu_dist_nn.serving.wire import (
+    CLASS_HEADER,
+    RETRY_AFTER_HEADER,
+    SERVICE_NAME,
+    SESSION_HEADER,
+)
 
 log = logging.getLogger(__name__)
 slog = get_logger(__name__)
@@ -222,10 +228,17 @@ class Router:
     def handle(self, method: str, payload: bytes, context) -> bytes:
         span, budget, md = _request_span(context, f"{method}")
         session = md.get(SESSION_HEADER)
+        # SLO class: forwarded verbatim to the replica's scheduler and
+        # read here for the hedging exemption (best_effort traffic is
+        # the load the fleet sheds under pressure — racing a second
+        # copy of it would spend tail-latency budget on the class that
+        # has none). None when the caller sent no header (nothing to
+        # forward; the replica defaults to standard).
+        slo_class = md.get(CLASS_HEADER)
         t0 = time.monotonic()
         try:
             return self._route(method, payload, context, span, budget,
-                               session)
+                               session, slo_class)
         finally:
             # Observed on EVERY outcome (abort raises through here):
             # an SLO over this family must see the slow failures, not
@@ -242,7 +255,7 @@ class Router:
         context.abort(code, message)
 
     def _route(self, method: str, payload: bytes, context, span, budget,
-               session: str | None) -> bytes:
+               session: str | None, slo_class: str | None = None) -> bytes:
         policy = self._retry
         deadline = time.monotonic() + budget if budget is not None else None
         attempt = 0
@@ -292,6 +305,8 @@ class Router:
                 )
             if session is not None:
                 metadata.append((SESSION_HEADER, session))
+            if slo_class is not None:
+                metadata.append((CLASS_HEADER, slo_class))
             if prev_failed is not None and rep.target != prev_failed:
                 # Only an actual re-placement onto ANOTHER replica is a
                 # failover — a same-replica retry (single-replica pool,
@@ -300,7 +315,7 @@ class Router:
                 ROUTER_FAILOVERS.inc()
             reply, err, serving, hedged = self._forward(
                 method, payload, rep, remaining, metadata, span,
-                attempt, tried,
+                attempt, tried, slo_class,
             )
             if err is None:
                 serving.breaker.record_success()
@@ -333,7 +348,11 @@ class Router:
             ).inc()
             if not transient:
                 # Deterministic verdicts propagate verbatim — another
-                # replica would say the same thing.
+                # replica would say the same thing. A shed's backoff
+                # hint (x-tdn-retry-after-ms) crosses the hop too:
+                # the replica's drain rate is the number the client
+                # must pace on, router or no router.
+                _copy_retry_after(context, err)
                 span.annotate(
                     f"{_code_name(code)} from {rep.target}: propagated"
                 )
@@ -393,16 +412,21 @@ class Router:
     # -------------------------------------------------------- forwards
 
     def _forward(self, method, payload, rep, remaining, metadata, span,
-                 attempt, tried):
+                 attempt, tried, slo_class=None):
         """One forward attempt — plain, or hedged when the policy
         applies and its p99-derived delay leaves room inside the
-        budget. Returns ``(reply, err, serving_replica, hedged)``:
-        ``serving_replica`` is the winner on success, the last errored
-        replica on failure."""
+        budget. ``best_effort`` requests are NEVER hedged: the class
+        the degradation ladder sheds first must not spend a second
+        replica's slot chasing its tail (docs/SCALING.md). Returns
+        ``(reply, err, serving_replica, hedged)``: ``serving_replica``
+        is the winner on success, the last errored replica on
+        failure."""
         timeout = (remaining if remaining is not None
                    else self._forward_timeout)
         delay = None
-        if self._hedge is not None and self._hedge.applies(method):
+        if (self._hedge is not None and self._hedge.applies(method)
+                and (slo_class is None
+                     or normalize_class(slo_class) != "best_effort")):
             delay = self._hedge.delay(method)
             if (delay is not None and timeout is not None
                     and delay >= timeout):
@@ -605,6 +629,23 @@ class Router:
         ROUTER_REQUESTS.labels(
             replica=rep.target, outcome=_code_name(code)
         ).inc()
+
+
+def _copy_retry_after(context, err) -> None:
+    """Forward a replica's x-tdn-retry-after-ms trailing metadata onto
+    the router's own reply (extending — not replacing — the trace-id
+    trailing metadata `_request_span` stashed). Best-effort: fakes may
+    lack metadata on either side."""
+    try:
+        for k, v in err.trailing_metadata() or ():
+            if k == RETRY_AFTER_HEADER:
+                base = tuple(getattr(context, "_tdn_trailing", ()))
+                context.set_trailing_metadata(
+                    base + ((RETRY_AFTER_HEADER, v),)
+                )
+                return
+    except Exception:  # noqa: BLE001 — enrichment only
+        pass
 
 
 def _status_of(e: grpc.RpcError):

@@ -28,7 +28,6 @@ unexpected failures return ``INTERNAL``.
 
 from __future__ import annotations
 
-import collections
 import logging
 import queue
 import threading
@@ -41,9 +40,12 @@ import numpy as np
 from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
+from tpu_dist_nn.serving.sched_core import SchedCore, normalize_class
 from tpu_dist_nn.serving.wire import (
+    CLASS_HEADER,
     GENERATE_METHOD,
     PROCESS_METHOD,
+    RETRY_AFTER_HEADER,
     SERVICE_NAME,
     SESSION_HEADER,
     WireMatrix,
@@ -72,11 +74,6 @@ _BATCH_ROWS = REGISTRY.histogram(
     "tdn_batch_rows", "coalesced rows per device launch (pre-padding)",
     labels=("method",), buckets=POW2_BUCKETS,
 )
-_BATCH_WAIT = REGISTRY.histogram(
-    "tdn_batch_wait_seconds",
-    "time a request spent in the batcher (submit to result)",
-    labels=("method",),
-)
 _SUBMITS = REGISTRY.counter(
     "tdn_batcher_submits_total", "requests entering the coalescing queue",
     labels=("method",),
@@ -90,12 +87,9 @@ _LAUNCHES = REGISTRY.counter(
     "tdn_batch_launches_total", "device launches issued by the batcher",
     labels=("method",),
 )
-_SHED = REGISTRY.counter(
-    "tdn_batcher_shed_total",
-    "submits fast-failed RESOURCE_EXHAUSTED at the pending-rows "
-    "watermark (admission control)",
-    labels=("method",),
-)
+# tdn_batcher_shed_total / tdn_batch_wait_seconds and the class-labeled
+# admission families moved to serving/sched_core.py — the ONE
+# admission/shed/close implementation both schedulers rebase on.
 
 
 class _Batcher:
@@ -127,7 +121,8 @@ class _Batcher:
     def __init__(self, engine, max_batch_rows: int = 65536,
                  submit_timeout: float | None = 120.0, run_fn=None,
                  method: str = "Process", pipeline_depth: int = 2,
-                 max_pending_rows: int | None = None, account_fn=None):
+                 max_pending_rows: int | None = None, account_fn=None,
+                 class_watermarks: dict | None = None):
         self._engine = engine
         # The device launch the batcher owns, split into the dispatch
         # half (launch, ideally non-blocking) and the fetch half (the
@@ -161,23 +156,32 @@ class _Batcher:
         # visible in the materialized sequences). Must never fail a
         # request; exceptions are swallowed to a log line.
         self._account_fn = account_fn
+        # Whether the accounting seam takes the dead-waiter row count
+        # (rows whose caller abandoned mid-flight — goodput books them
+        # as pad, not useful). Signature-probed so older account fakes
+        # keep working.
+        self._account_dead_aware = False
+        if account_fn is not None:
+            try:
+                import inspect
+
+                self._account_dead_aware = "dead_rows" in inspect.signature(
+                    account_fn
+                ).parameters
+            except (TypeError, ValueError):
+                pass
         self._max_rows = int(max_batch_rows)
-        self._submit_timeout = submit_timeout
-        # Admission watermark: submits that would push the queued row
-        # count past this fast-fail RESOURCE_EXHAUSTED instead of
-        # queueing unboundedly (None = the old unbounded behavior).
-        self._max_pending_rows = (
-            int(max_pending_rows) if max_pending_rows is not None else None
+        # The admission/shed/close/drain contract lives in the shared
+        # scheduling core (serving/sched_core.py): pending queue +
+        # rows ledger under core.cond, class watermarks, deadline
+        # expiry, close-failover sweep. The dispatch loop below holds
+        # core.cond exactly where it held its own condition before.
+        self._core = SchedCore(
+            method, max_pending_rows=max_pending_rows,
+            submit_timeout=submit_timeout,
+            class_watermarks=class_watermarks,
         )
-        self._cond = threading.Condition()
-        # deque: the dispatch stage pops from the head per item — O(1)
-        # under backlog where list.pop(0) was O(n) per pop.
-        self._pending: collections.deque[dict] = collections.deque()  # guarded-by: _cond
-        # Rows currently queued (NOT yet popped by dispatch): the
-        # admission-control ledger and the sampler's
-        # tdn_batcher_pending_rows gauge. Updated only under _cond.
-        self.pending_rows = 0  # guarded-by: _cond
-        self._closed = False  # guarded-by: _cond
+        self._cond = self._core.cond
         self._serial = pipeline_depth <= 1
         # Launched-but-not-drained hand-off. The SEMAPHORE is the
         # launch-ahead bound — dispatch takes a slot BEFORE staging or
@@ -197,11 +201,11 @@ class _Batcher:
         self._staging_keep = max(2, pipeline_depth)
         # Observability: served totals let tests/operators confirm
         # coalescing actually happens (batches < requests under load).
-        self.requests_total = 0
+        # requests/shed/pending ride the core (delegating properties
+        # below keep the legacy attribute names the sampler and tests
+        # read).
         self.batches_total = 0
         self.rows_total = 0
-        # Submits refused at the admission watermark.
-        self.shed_total = 0
         # Launches issued while a previously launched batch had not
         # finished draining — the overlap evidence
         # (tdn_batcher_overlap_ratio = overlapped_total/batches_total).
@@ -217,10 +221,8 @@ class _Batcher:
         # not a label lookup.
         self._m_submits = _SUBMITS.labels(method=method)
         self._m_abandoned = _ABANDONED.labels(method=method)
-        self._m_shed = _SHED.labels(method=method)
         self._m_launches = _LAUNCHES.labels(method=method)
         self._m_rows = _BATCH_ROWS.labels(method=method)
-        self._m_wait = _BATCH_WAIT.labels(method=method)
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name="tdn-serve-dispatch", daemon=True
         )
@@ -232,15 +234,57 @@ class _Batcher:
             self._drain_thread.start()
         self._dispatch_thread.start()
 
+    # Legacy counter/queue surface, now owned by the shared core (the
+    # runtime sampler, drain plumbing, and the resilience tests read
+    # these names).
+    @property
+    def pending_rows(self) -> int:
+        return self._core.pending_rows
+
+    @property
+    def requests_total(self) -> int:
+        return self._core.requests_total
+
+    @property
+    def shed_total(self) -> int:
+        return self._core.shed_total
+
+    @property
+    def expired_total(self) -> int:
+        return self._core.expired_total
+
+    @property
+    def _pending(self) -> list:
+        return self._core.pending_items()
+
+    @property
+    def _closed(self) -> bool:
+        return self._core.closed
+
+    def queue_depth(self) -> int:
+        """Entries queued (lock-free; the runtime sampler's per-tick
+        read — the `_pending` property above copies the whole queue
+        under the admission lock and exists for tests)."""
+        return self._core.queue_depth()
+
+    def pending_by_class(self) -> dict:
+        return self._core.pending_by_class()
+
     def submit(self, x: np.ndarray,
                timeout: float | None = None,
-               ctx=None) -> np.ndarray:
+               ctx=None, slo_class: str = "standard") -> np.ndarray:
         """Block until this request's rows are served.
 
         ``timeout`` is the CALLER's remaining budget (the RPC deadline);
         the effective wait is ``min(timeout, submit_timeout)`` — there
         is no point holding a worker thread past the moment its client
-        gave up.
+        gave up. The same budget is the entry's queue DEADLINE: if it
+        expires before dispatch stages the entry, the entry fails
+        DEADLINE_EXCEEDED without riding a launch.
+
+        ``slo_class`` (``critical``/``standard``/``best_effort``, the
+        ``x-tdn-class`` header) sets the entry's queue priority and
+        shed watermark (docs/ROBUSTNESS.md "Degradation ladder").
 
         ``ctx`` is the request's :class:`~tpu_dist_nn.obs.trace
         .SpanContext`: when sampled, this entry's passage through the
@@ -248,79 +292,20 @@ class _Batcher:
         spans under it (each batch-level stage appears once per member
         request, so every trace tree is complete on its own).
         """
-        from tpu_dist_nn.utils.errors import (
-            ResourceExhaustedError,
-            UnavailableError,
-        )
-
         item = {"x": x, "done": threading.Event(), "out": None, "err": None,
-                "abandoned": False,
+                "abandoned": False, "slo_class": slo_class,
+                "t_submit": time.monotonic(),
                 # Only a SAMPLED context is worth carrying: the per-item
                 # skip below is then one None check.
                 "ctx": ctx if ctx is not None and ctx.sampled else None}
-        t_submit = time.monotonic()
-        item["t_submit"] = t_submit
-        n = len(x)
-        shed_pending = None
-        with self._cond:
-            if self._closed:
-                raise UnavailableError("server is shutting down")
-            # Admission control: past the watermark, shed NOW with a
-            # back-off signal instead of queueing work the device is
-            # already minutes behind on. An oversized request against
-            # an EMPTY queue is admitted — it could otherwise never
-            # run, and the watermark bounds backlog, not batch size.
-            if (self._max_pending_rows is not None and self._pending
-                    and self.pending_rows + n > self._max_pending_rows):
-                self.shed_total += 1
-                self._m_shed.inc()
-                shed_pending = self.pending_rows
-            else:
-                self._pending.append(item)
-                self.pending_rows += n
-                self.requests_total += 1
-                self._cond.notify()
-        if shed_pending is not None:
-            # Structured (and thereby log-ring) evidence for the flight
-            # recorder's shed-spike detector. Emitted OUTSIDE _cond:
-            # the record write blocks on stderr, and one stalled log
-            # consumer holding the admission lock would wedge every
-            # submit and the dispatch loop behind it.
-            slog.warning(
-                "batcher.shed", method=self.method,
-                pending_rows=shed_pending, rows=n,
-                watermark=self._max_pending_rows,
-            )
-            raise ResourceExhaustedError(
-                f"serving queue at capacity ({shed_pending} rows "
-                f"pending, watermark {self._max_pending_rows}); "
-                "back off and retry"
-            )
+        self._core.admit(item, timeout)
         self._m_submits.inc()
-        bounds = [t for t in (self._submit_timeout, timeout) if t is not None]
-        wait = min(bounds) if bounds else None
-        # Bounded wait: if the engine wedges mid-batch (the tunneled-TPU
-        # hang mode), the gRPC worker thread must get back to the client
-        # with DEADLINE_EXCEEDED instead of blocking forever — an
-        # unbounded wait here would eventually strand every worker
-        # thread and leave the server unable even to return errors.
-        if not item["done"].wait(wait):
-            from tpu_dist_nn.utils.errors import DeadlineExceededError
-
-            # Mark abandoned under the lock so the consumer discards it
-            # at pop time: without this, a long wedge accumulates dead
-            # requests unboundedly and the recovered engine burns its
-            # first launches computing rows nobody is waiting for.
-            with self._cond:
-                item["abandoned"] = True
-            self._m_abandoned.inc()
-            raise DeadlineExceededError(
-                f"coalesced batch did not complete within {wait}s "
-                "(engine wedged or request backlogged?)"
-            )
-        self._m_wait.observe(time.monotonic() - t_submit)
-        if item["err"] is not None:
-            raise item["err"]
+        try:
+            self._core.wait(item, what="coalesced batch")
+        except Exception:
+            if item["abandoned"]:
+                self._m_abandoned.inc()
+            raise
         return item["out"]
 
     def _stage(self, group: list[dict]):
@@ -396,9 +381,20 @@ class _Batcher:
                 # Post-fetch goodput accounting (static Generate path:
                 # EOS-frozen positions only exist in the materialized
                 # sequences). Best-effort — accounting must never fail
-                # a request that already has its result.
+                # a request that already has its result. Rows whose
+                # waiter abandoned AFTER dispatch popped them (the one
+                # window deadline expiry cannot close) are declared as
+                # dead: goodput books the launch they rode as pad, not
+                # useful (reason dead_waiter).
                 try:
-                    self._account_fn(out, ofs, launched_rows)
+                    if self._account_dead_aware:
+                        dead = sum(
+                            len(it["x"]) for it in group if it["abandoned"]
+                        )
+                        self._account_fn(out, ofs, launched_rows,
+                                         dead_rows=dead)
+                    else:
+                        self._account_fn(out, ofs, launched_rows)
                 except Exception:  # noqa: BLE001 — accounting only
                     log.exception("goodput accounting failed")
         except Exception as e:  # noqa: BLE001 — per request
@@ -425,36 +421,36 @@ class _Batcher:
             with self._stats_lock:
                 self.inflight_batches -= 1
                 self.inflight_rows -= launched_rows
+            if err is None:
+                # Completions feed the drain-rate window behind the
+                # shed replies' x-tdn-retry-after-ms hint.
+                self._core.note_drained(
+                    sum(len(it["x"]) for it in group)
+                )
             self._release(key, buf)
             self._slots.release()
             for it in group:
                 it["done"].set()
 
     def _dispatch_loop(self) -> None:
+        core = self._core
         while True:
-            with self._cond:
-                while not self._pending and not self._closed:
-                    self._cond.wait()
-                if not self._pending and self._closed:
+            with core.cond:
+                while not core.has_pending() and not core.closed:
+                    core.cond.wait()
+                if not core.has_pending() and core.closed:
                     if not self._serial:
                         self._launched.put(None)  # drain's shutdown pill
                     return
-                batch, rows = [], 0
-                while self._pending and (
-                    not batch
-                    or rows + len(self._pending[0]["x"]) <= self._max_rows
-                ):
-                    it = self._pending.popleft()
-                    # Popped (computed OR discarded): either way these
-                    # rows leave the admission ledger.
-                    self.pending_rows -= len(it["x"])
-                    if it["abandoned"]:  # caller timed out; don't compute
-                        continue
-                    rows += len(it["x"])
-                    batch.append(it)
-                if not batch:
-                    continue
+                # Class-priority pop (critical first, FIFO within a
+                # class); abandoned entries are discarded and
+                # budget-expired ones failed DEADLINE_EXCEEDED here —
+                # neither rides the launch.
+                batch, rows = core.pop_group(self._max_rows)
                 self.rows_total += rows
+            core.drain_deferred()
+            if not batch:
+                continue
             # Queue wait ends the moment the dispatch stage owns the
             # request (recorded outside the condition lock — tracing
             # must not extend the producers' critical section).
@@ -559,34 +555,17 @@ class _Batcher:
             self._drain_one(*item)
 
     def close(self, timeout: float = 10.0) -> None:
-        from tpu_dist_nn.utils.errors import UnavailableError
-
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-        # Dispatch drains _pending then pills the drain queue; drain
+        self._core.close_begin()
+        # Dispatch drains the queue then pills the drain queue; drain
         # finishes every launched batch before exiting — both stages
-        # empty by the time close returns.
+        # empty by the time close returns. Anything STILL pending (a
+        # wedged dispatch never popped it) is failed over UNAVAILABLE
+        # by the core's sweep, so its waiters don't sit out their full
+        # submit timeout against a batcher that is already gone.
         self._dispatch_thread.join(timeout=timeout)
         if self._drain_thread is not None:
             self._drain_thread.join(timeout=timeout)
-        # Fail over anything STILL pending (a wedged dispatch never
-        # popped it): its waiters would otherwise sit out their full
-        # submit timeout against a batcher that is already gone. Pops
-        # under the lock, so a still-alive dispatch thread and this
-        # sweep never double-serve an entry.
-        leftovers = []
-        with self._cond:
-            while self._pending:
-                it = self._pending.popleft()
-                self.pending_rows -= len(it["x"])
-                if not it["abandoned"]:
-                    leftovers.append(it)
-        for it in leftovers:
-            it["err"] = UnavailableError(
-                "server shut down before this request was served"
-            )
-            it["done"].set()
+        self._core.sweep_leftovers()
 
 
 def _request_span(context, method: str):
@@ -611,10 +590,13 @@ def _request_span(context, method: str):
         pass
     parent = _trace.SpanContext.from_header(md.get(_trace.TRACE_HEADER))
     span = _trace.TRACER.start(f"rpc.{method}", parent=parent)
+    base_trailing = ((_trace.TRACE_ID_HEADER, span.ctx.trace_id),)
     try:
-        context.set_trailing_metadata(
-            ((_trace.TRACE_ID_HEADER, span.ctx.trace_id),)
-        )
+        # Stashed so a later abort path (shed replies' retry-after
+        # hint) can EXTEND the trailing metadata instead of replacing
+        # the trace id — set_trailing_metadata's last call wins.
+        context._tdn_trailing = base_trailing
+        context.set_trailing_metadata(base_trailing)
     except Exception:  # noqa: BLE001 — in-process fakes may not have it
         pass
     bounds = []
@@ -660,7 +642,20 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
         _abort(context, method, grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
     if isinstance(e, ResourceExhaustedError):
         # Admission-control shed: the queue is at its watermark — the
-        # server is healthy and asking this client to back off.
+        # server is healthy and asking this client to back off. The
+        # reply names HOW LONG in x-tdn-retry-after-ms (derived from
+        # the current drain rate — serving/sched_core.py), which
+        # RetryPolicy honors as its backoff floor so a shed storm
+        # cannot re-synchronize into a hot-retry storm.
+        retry_after = getattr(e, "retry_after_ms", None)
+        if retry_after is not None:
+            try:
+                context.set_trailing_metadata(
+                    tuple(getattr(context, "_tdn_trailing", ()))
+                    + ((RETRY_AFTER_HEADER, str(int(retry_after))),)
+                )
+            except Exception:  # noqa: BLE001 — fakes without metadata
+                pass
         _abort(context, method, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
     if isinstance(e, UnavailableError):
         # Engine torn down mid-flight: the reference's dead-channel
@@ -743,7 +738,10 @@ def _make_handler(engine, batcher: _Batcher | None):
 
     def process(request_bytes: bytes, context) -> bytes:
         _RPC_REQUESTS.labels(method="Process").inc()
-        span, budget, _md = _request_span(context, "Process")
+        span, budget, md = _request_span(context, "Process")
+        # SLO class rides x-tdn-class (missing/unknown -> standard):
+        # queue priority + shed watermark in the scheduling core.
+        slo_class = normalize_class(md.get(CLASS_HEADER))
         try:
             try:
                 # Structure probe only on the fast path: a WireMatrix
@@ -777,7 +775,8 @@ def _make_handler(engine, batcher: _Batcher | None):
                     # client hint) so the worker never waits for a
                     # client that already gave up; the span context
                     # rides the pending entry through the pipeline.
-                    out = batcher.submit(x, timeout=budget, ctx=span.ctx)
+                    out = batcher.submit(x, timeout=budget, ctx=span.ctx,
+                                         slo_class=slo_class)
                 else:
                     with lock, _trace.TRACER.activate(span):
                         out = engine.infer(x)
@@ -810,6 +809,7 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
                  submit_timeout: float | None = 120.0,
                  pipeline_depth: int = 2,
                  max_pending_rows: int | None = None,
+                 class_watermarks: dict | None = None,
                  interceptors=()):
     """Start a gRPC server bound to ``host:port``; returns
     ``(server, bound_port)`` (``port=0`` picks an ephemeral port;
@@ -843,15 +843,18 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
     ``max_pending_rows`` is the admission-control watermark (``tdn up
     --max-pending-rows``): a submit that would queue past it is shed
     with RESOURCE_EXHAUSTED instead of joining an unbounded backlog
-    (None = unbounded, the legacy behavior). ``interceptors`` are gRPC
-    server interceptors — the fault-injection seam
-    (:mod:`tpu_dist_nn.testing.faults`).
+    (None = unbounded, the legacy behavior). ``class_watermarks``
+    overrides the per-SLO-class shed fractions of that watermark
+    (``tdn up --class-watermarks``; docs/ROBUSTNESS.md "Degradation
+    ladder"). ``interceptors`` are gRPC server interceptors — the
+    fault-injection seam (:mod:`tpu_dist_nn.testing.faults`).
     """
     server = _new_grpc_server(max_workers, interceptors)
     batcher = (
         _Batcher(engine, max_batch_rows, submit_timeout,
                  pipeline_depth=pipeline_depth,
-                 max_pending_rows=max_pending_rows)
+                 max_pending_rows=max_pending_rows,
+                 class_watermarks=class_watermarks)
         if coalesce else None
     )
     if coalesce and warm_rows > 0:
@@ -885,7 +888,8 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
 
     def generate(request_bytes: bytes, context) -> bytes:
         _RPC_REQUESTS.labels(method="Generate").inc()
-        span, budget, _md = _request_span(context, "Generate")
+        span, budget, md = _request_span(context, "Generate")
+        slo_class = normalize_class(md.get(CLASS_HEADER))
         try:
             try:
                 with _trace.TRACER.span("decode", span.ctx):
@@ -913,7 +917,8 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
                     f"prompts must be integer token ids in [0, {vocab_size})",
                 )
             try:
-                out = run_submit(ids.astype(np.int32), budget, span.ctx)
+                out = run_submit(ids.astype(np.int32), budget, span.ctx,
+                                 slo_class)
             except Exception as e:  # noqa: BLE001 — map to status codes
                 span.annotate(f"error: {type(e).__name__}: {e}")
                 _abort_for_exception(context, e, "generation", "Generate")
@@ -947,6 +952,7 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
                       eos_id: int | None = None,
                       prefix_cache_blocks: int = 0,
                       prefill_chunk: int | None = None,
+                      class_watermarks: dict | None = None,
                       interceptors=()):
     """Serve LM GENERATION over the reference wire.
 
@@ -1066,12 +1072,15 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
             max_pending_rows=max_pending_rows,
             prefix_cache_blocks=prefix_cache_blocks,
             prefill_chunk=prefill_chunk,
+            class_watermarks=class_watermarks,
         )
         if warm_rows > 0:
             sched.warm()
 
-        def run_submit(ids: np.ndarray, time_remaining, ctx=None):
-            return sched.submit(ids, timeout=time_remaining, ctx=ctx)
+        def run_submit(ids: np.ndarray, time_remaining, ctx=None,
+                       slo_class: str = "standard"):
+            return sched.submit(ids, timeout=time_remaining, ctx=ctx,
+                                slo_class=slo_class)
 
         server = _new_grpc_server(max_workers, interceptors)
         server.add_generic_rpc_handlers(
@@ -1170,22 +1179,26 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
     # single-chip path over one — the peak must match the footprint.
     GOODPUT.ensure_peak(device_count=max(int(num_stages), 1))
 
-    def account(out, useful_rows, launched_rows):
+    def account(out, useful_rows, launched_rows, dead_rows=0):
         GOODPUT.record_static_generate(
             gp_model, out, useful_rows, launched_rows, T, eos_id,
+            dead_rows=dead_rows,
         )
 
     batcher = (
         _Batcher(None, 65536, submit_timeout, run_fn=run, method="Generate",
                  pipeline_depth=pipeline_depth,
-                 max_pending_rows=max_pending_rows, account_fn=account)
+                 max_pending_rows=max_pending_rows, account_fn=account,
+                 class_watermarks=class_watermarks)
         if coalesce else None
     )
     lock = threading.Lock()
 
-    def run_submit(ids: np.ndarray, time_remaining, ctx=None):
+    def run_submit(ids: np.ndarray, time_remaining, ctx=None,
+                   slo_class: str = "standard"):
         if batcher is not None:
-            return batcher.submit(ids, timeout=time_remaining, ctx=ctx)
+            return batcher.submit(ids, timeout=time_remaining, ctx=ctx,
+                                  slo_class=slo_class)
         with lock:
             return run(ids)
 
@@ -1248,12 +1261,19 @@ class GrpcClient:
     def __init__(self, target: str, timeout: float = 30.0, *,
                  retry=_CLIENT_DEFAULT, breaker=_CLIENT_DEFAULT,
                  wait_for_ready: bool = False, ready_timeout: float = 5.0,
-                 session_key: str | None = None):
+                 session_key: str | None = None,
+                 slo_class: str | None = None):
         from tpu_dist_nn.serving.resilience import CircuitBreaker, RetryPolicy
 
         self.target = target
         self.timeout = timeout
         self.session_key = session_key
+        # SLO class rides every call as x-tdn-class (None = send no
+        # header — the server defaults to "standard"): queue priority,
+        # shed watermark, and — behind a router — the hedging
+        # exemption for best_effort (docs/ROBUSTNESS.md "Degradation
+        # ladder"). Per-call override via process/generate(slo_class=).
+        self.slo_class = slo_class
         self._retry = RetryPolicy() if retry is _CLIENT_DEFAULT else retry
         self._breaker = (
             CircuitBreaker.for_target(target)
@@ -1292,16 +1312,26 @@ class GrpcClient:
 
     @staticmethod
     def _enrich(e, span) -> tuple:
-        """Attach ``server_trace_id`` + extract the status code from a
-        failed RPC (best-effort — in-process fakes may lack both)."""
+        """Attach ``server_trace_id`` / ``retry_after_ms`` + extract
+        the status code from a failed RPC (best-effort — in-process
+        fakes may lack both)."""
         trace_id = span.ctx.trace_id  # the id we propagated
+        retry_after = None
         try:
             for k, v in e.trailing_metadata() or ():
                 if k == _trace.TRACE_ID_HEADER:
                     trace_id = v  # the server's own root, if any
+                elif k == RETRY_AFTER_HEADER:
+                    try:
+                        retry_after = int(v)
+                    except (TypeError, ValueError):
+                        pass  # a garbled hint is no hint
         except Exception:  # noqa: BLE001 — best-effort enrichment
             pass
         e.server_trace_id = trace_id
+        # The shed reply's backoff hint (x-tdn-retry-after-ms): the
+        # server's drain-rate-derived floor for the next attempt.
+        e.retry_after_ms = retry_after
         code = None
         try:
             code = e.code()
@@ -1310,7 +1340,8 @@ class GrpcClient:
         return code, trace_id
 
     def _traced_call(self, call, method: str, payload: bytes,
-                     session_key=_CLIENT_DEFAULT) -> bytes:
+                     session_key=_CLIENT_DEFAULT,
+                     slo_class=_CLIENT_DEFAULT) -> bytes:
         """One LOGICAL call (original attempt + bounded retries) under
         one client span: the trace context and the remaining-budget
         hint ride the metadata out on every attempt; a final failure
@@ -1326,6 +1357,9 @@ class GrpcClient:
         session = (
             self.session_key if session_key is _CLIENT_DEFAULT
             else session_key
+        )
+        cls = (
+            self.slo_class if slo_class is _CLIENT_DEFAULT else slo_class
         )
         span = _trace.TRACER.start(f"client.{method}")
         deadline = (
@@ -1362,6 +1396,11 @@ class GrpcClient:
                     # Session affinity key for the router; an engine
                     # server just never reads it.
                     metadata += ((SESSION_HEADER, session),)
+                if cls is not None:
+                    # SLO class: admission priority + shed watermark
+                    # at the scheduler, hedging exemption at the
+                    # router (best_effort).
+                    metadata += ((CLASS_HEADER, cls),)
                 if remaining is not None:
                     # Remaining-budget hint (the grpc-timeout analogue,
                     # readable by the batcher even where a proxy
@@ -1406,11 +1445,28 @@ class GrpcClient:
                             # INVALID_ARGUMENT proves the server is
                             # back even though the request was bad).
                             breaker.record_success()
-                    retryable = policy is not None and transient
+                    # A shed (RESOURCE_EXHAUSTED) is retryable too —
+                    # the server is healthy and explicitly asked for a
+                    # paced retry — but stays NON-transient for the
+                    # breaker above: a shed storm must never open
+                    # breakers to a healthy server.
+                    shed = _code_name(code) == "RESOURCE_EXHAUSTED"
+                    retryable = policy is not None and (transient or shed)
                     out_of_attempts = (
                         policy is None or attempt >= policy.max_attempts
                     )
-                    delay = 0.0 if out_of_attempts else policy.backoff(attempt)
+                    # The server's drain-rate hint is the backoff
+                    # FLOOR: jitter still de-synchronizes the herd
+                    # above it, but nobody retries before the backlog
+                    # can have moved.
+                    floor = (
+                        e.retry_after_ms / 1000.0
+                        if getattr(e, "retry_after_ms", None) else None
+                    )
+                    delay = (
+                        0.0 if out_of_attempts
+                        else policy.backoff(attempt, floor=floor)
+                    )
                     out_of_budget = (
                         deadline is not None
                         and time.monotonic() + delay >= deadline
@@ -1445,25 +1501,28 @@ class GrpcClient:
             span.end()
 
     def process(self, x: np.ndarray,
-                session_key=_CLIENT_DEFAULT) -> np.ndarray:
+                session_key=_CLIENT_DEFAULT,
+                slo_class=_CLIENT_DEFAULT) -> np.ndarray:
         # The codec owns the ONE cast to wire float64 (per-stripe into
         # its output buffer) — pre-casting here would materialize a
         # float64 copy just for encode_matrix to walk.
         reply = self._traced_call(
             self._call, "Process", encode_matrix(x),
-            session_key=session_key,
+            session_key=session_key, slo_class=slo_class,
         )
         return decode_matrix(reply)
 
     def generate(self, prompts: np.ndarray,
-                 session_key=_CLIENT_DEFAULT) -> np.ndarray:
+                 session_key=_CLIENT_DEFAULT,
+                 slo_class=_CLIENT_DEFAULT) -> np.ndarray:
         """Token-id prompts ``(N, prompt_len)`` -> full sequences
         ``(N, prompt_len + max_new_tokens)`` (ids ride the Matrix wire
-        as doubles — exact). ``session_key`` overrides the client-level
-        key for this call (None = send no session header)."""
+        as doubles — exact). ``session_key`` / ``slo_class`` override
+        the client-level values for this call (None = send no such
+        header)."""
         reply = self._traced_call(
             self._call_generate, "Generate", encode_matrix(prompts),
-            session_key=session_key,
+            session_key=session_key, slo_class=slo_class,
         )
         # Decode lands token ids straight in int64 — the wire doubles
         # are exact for ids < 2^53, so the cast-on-decode is lossless.

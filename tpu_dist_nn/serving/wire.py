@@ -512,3 +512,12 @@ SERVICE_NAME = "grpc_dist_nn.LayerService"
 # follow-up Generate requests to the replica already holding its
 # KV/prefix-cache state. Engine servers ignore it; the router reads it.
 SESSION_HEADER = "x-tdn-session"
+# Client -> server SLO class (serving/sched_core.py): critical /
+# standard / best_effort. Queue priority + shed watermark at the
+# scheduler; the router forwards it and exempts best_effort from
+# hedging. Missing/unknown values degrade to "standard".
+CLASS_HEADER = "x-tdn-class"
+# Server -> client trailing metadata on RESOURCE_EXHAUSTED sheds: the
+# drain-rate-derived backoff floor in milliseconds (RetryPolicy honors
+# it so a shed storm cannot re-synchronize into a hot-retry storm).
+RETRY_AFTER_HEADER = "x-tdn-retry-after-ms"
