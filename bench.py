@@ -790,6 +790,48 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
             if gerrors:
                 out["generate"]["completed"] = n_req
                 out["generate"]["errors"] = gerrors[:3]
+            # STREAMED arm (ISSUE 16): the same endpoint through
+            # GenerateStream. Streamed TTFT is CLIENT-observed
+            # (submit -> first token frame on the wire), unlike the
+            # scheduler-side ttft_p50_ms above, so it includes frame
+            # encode + gRPC delivery; gen_stream_ttft_p50_ms is a
+            # GATED series (tools/bench_gate.py). Continuous-only:
+            # the static path leaves GenerateStream unregistered.
+            if sched is not None:
+                sc = GrpcClient(f"127.0.0.1:{gport}")
+                sttft: list[float] = []
+                sgaps: list[float] = []
+                stoks = 0
+                try:
+                    for i in range(min(gclients, 4)):
+                        t0 = time.monotonic()
+                        prev = None
+                        for _tok in sc.generate_stream(gprompts[i]):
+                            now = time.monotonic()
+                            if prev is None:
+                                sttft.append(now - t0)
+                            else:
+                                sgaps.append(now - prev)
+                            prev = now
+                            stoks += 1
+                finally:
+                    sc.close()
+                out["generate_stream"] = {
+                    "requests": min(gclients, 4),
+                    "tokens": stoks,
+                    "ttft_p50_ms": round(
+                        float(np.percentile(sttft, 50)) * 1e3, 2
+                    ),
+                    "ttft_p99_ms": round(
+                        float(np.percentile(sttft, 99)) * 1e3, 2
+                    ),
+                    "intertoken_p50_ms": round(
+                        float(np.percentile(sgaps, 50)) * 1e3, 2
+                    ),
+                    "intertoken_p99_ms": round(
+                        float(np.percentile(sgaps, 99)) * 1e3, 2
+                    ),
+                }
         finally:
             gsrv.stop(0)
     except Exception as e:  # noqa: BLE001 — must not cost the block
@@ -2054,9 +2096,71 @@ def gen_ab_bench(jax=None, *, slots: int = 8, requests: int = 16,
     finally:
         sched.close()
 
+    # STREAMED arm (ISSUE 16): the same staggered schedule through
+    # ``submit_stream`` — per-token delivery instead of
+    # retire-then-return. TTFT here is CONSUMER-observed (submit ->
+    # first token event popped off the stream), and inter-token p99
+    # is the gap a streaming caller would size its per-gap deadline
+    # against (docs/ROBUSTNESS.md "Stream deadlines"). Gaps are
+    # measured per delivery event; a multi-token event counts once,
+    # so the figure is the conservative upper bound on any single
+    # token's wait.
+    sched = make_continuous()
+    try:
+        sttft: list[float] = []
+        sgaps: list[float] = []
+        slock = threading.Lock()
+
+        def stream_submit(row, budget):
+            t0 = time.monotonic()
+            stream = sched.submit_stream(
+                np.asarray(row), max_new_tokens=budget
+            )
+            prev = None
+            ttft = None
+            gaps: list[float] = []
+            while True:
+                ev = stream.next_event(30.0)
+                if ev is None:
+                    stream.cancel()
+                    raise RuntimeError("stream stalled (30s gap)")
+                kind, data = ev
+                if kind == "tokens":
+                    now = time.monotonic()
+                    if prev is None:
+                        ttft = now - t0
+                    else:
+                        gaps.append(now - prev)
+                    prev = now
+                    continue
+                if data.get("reason") == "error":
+                    raise RuntimeError(
+                        data.get("message") or "stream failed"
+                    )
+                break
+            with slock:
+                if ttft is not None:
+                    sttft.append(ttft)
+                sgaps.extend(gaps)
+
+        streamed = drive(stream_submit)
+        streamed["ttft_p50_ms"] = round(
+            float(np.percentile(sttft, 50)) * 1e3, 2
+        )
+        streamed["ttft_p99_ms"] = round(
+            float(np.percentile(sttft, 99)) * 1e3, 2
+        )
+        streamed["intertoken_p99_ms"] = (
+            round(float(np.percentile(sgaps, 99)) * 1e3, 2)
+            if sgaps else 0.0
+        )
+    finally:
+        sched.close()
+
     return {
         "static": static,
         "continuous": continuous,
+        "streamed": streamed,
         "continuous_vs_static_rps": round(
             continuous["rps"] / static["rps"], 3
         ),

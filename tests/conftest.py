@@ -243,6 +243,16 @@ QUICK_TESTS = {
     "test_serving": ["test_codec_round_trip",
                      "test_grpc_round_trip_matches_local",
                      "test_serve_generate_single_chip_and_validation"],
+    # ISSUE 16 streaming smokes: frame codec + TokenStream channel
+    # invariants (pure host logic, milliseconds), the loopback
+    # router-hop stream (first token delivered BEFORE retirement,
+    # tokens bit-identical to unary through the same hop), and the
+    # hedging exemption contract.
+    "test_stream": [
+        "test_frame_codec_roundtrips_and_rejects_garbage",
+        "test_token_stream_cursor_dedupes_replayed_prefix",
+        "test_stream_first_token_before_retirement_through_router",
+        "test_hedge_policy_rejects_generate_stream"],
     # ISSUE 13: the tdn lint gate in both directions — zero
     # non-baselined findings on the shipped tree, exit 1 on a planted
     # violation, each rule firing on its fixture with the exact id and

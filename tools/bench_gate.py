@@ -35,6 +35,8 @@ Gated metrics (docs/PERF.md "Regression gate"):
     serving_pad_ratio               serving.goodput.pad_ratio    lower
     slo_class_critical_p99_ms       serving.slo_classes.critical_p99_ms
                                                                  lower
+    gen_stream_ttft_p50_ms          serving.generate_stream.ttft_p50_ms
+                                                                 lower
 
 Rules:
 
@@ -140,6 +142,13 @@ GATED_METRICS = (
     # Absent in pre-ISSUE-15 rounds -> per-metric skip.
     ("slo_class_critical_p99_ms",
      ("serving", "slo_classes", "critical_p99_ms"), "lower"),
+    # Streaming plane (ISSUE 16): client-observed streamed TTFT
+    # (submit -> first GenerateStream token frame on the wire) through
+    # the loopback serving endpoint — the latency streaming exists to
+    # surface, lower is better. Absent in pre-ISSUE-16 rounds ->
+    # per-metric skip.
+    ("gen_stream_ttft_p50_ms",
+     ("serving", "generate_stream", "ttft_p50_ms"), "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

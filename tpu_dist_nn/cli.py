@@ -1311,6 +1311,73 @@ def _lm_block_layout(sched: str, stages: int, num_virtual: int, *,
     return lm_block_layout(sched, stages, num_virtual, cfg=cfg, tp=tp, ep=ep)
 
 
+def _lm_stream_demo(args) -> int:
+    """Client-only streaming demo — ``tdn infer --target``'s role for
+    the streaming plane (``tdn lm --stream --target HOST:PORT``): no
+    training, no model file — connect to a running ``--serve-generate``
+    endpoint (or a router in front of a fleet), stream ONE generation
+    of ``--prompt`` over ``LayerService/GenerateStream``, print bytes
+    as each token frame LANDS (first output at ~TTFT, not retirement),
+    then a JSON latency summary (TTFT + inter-token gaps + terminal)."""
+    import sys
+
+    import numpy as np
+
+    from tpu_dist_nn.data.text import decode as decode_text
+    from tpu_dist_nn.data.text import encode
+    from tpu_dist_nn.serving import GrpcClient
+
+    if not getattr(args, "target", None):
+        raise ValueError(
+            "tdn lm --stream is client-only: pass --target HOST:PORT of "
+            "a running --serve-generate endpoint (continuous scheduler; "
+            "a router front door works too)"
+        )
+    T = args.serve_prompt_len
+    ids = encode(args.prompt).tolist()
+    # The endpoint decodes ONE static prompt shape; pad on the LEFT
+    # (byte 32, space) so the real text stays adjacent to the
+    # generated continuation, and keep the tail when too long.
+    row = ([32] * max(0, T - len(ids)) + ids)[-T:]
+    client = GrpcClient(args.target, session_key=getattr(
+        args, "session_key", None))
+    t0 = time.monotonic()
+    first = None
+    last = None
+    gaps: list[float] = []
+    n = 0
+    try:
+        reply = client.generate_stream(np.asarray([row], np.int64))
+        for tok in reply:
+            now = time.monotonic()
+            if first is None:
+                first = now - t0
+            else:
+                gaps.append(now - last)
+            last = now
+            n += 1
+            sys.stdout.write(decode_text([tok]))
+            sys.stdout.flush()
+        sys.stdout.write("\n")
+        summary = {
+            "tokens": n,
+            "ttft_s": round(first, 6) if first is not None else None,
+            "intertoken_p50_ms": (
+                round(sorted(gaps)[len(gaps) // 2] * 1000, 3)
+                if gaps else None
+            ),
+            "intertoken_max_ms": (
+                round(max(gaps) * 1000, 3) if gaps else None
+            ),
+            "finish": reply.finish,
+            "trace_id": reply.trace_id,
+        }
+        print(json.dumps(summary), flush=True)
+        return 0
+    finally:
+        client.close()
+
+
 def cmd_lm(args) -> int:
     """Train + evaluate the Tiny-Transformer LM (BASELINE configs[4]).
 
@@ -1321,6 +1388,10 @@ def cmd_lm(args) -> int:
     this zero-egress box), else the synthetic gated fallback.
     Pipelined over ``--stages`` when > 1.
     """
+    if getattr(args, "stream", False):
+        # Client-only streaming demo: nothing below (training, model
+        # construction) applies — bail before the heavy imports.
+        return _lm_stream_demo(args)
     import jax
 
     from tpu_dist_nn.data.text import lm_sequences, load_corpus, encode
@@ -3771,6 +3842,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-seconds", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                         "interrupted)")
+    p.add_argument("--stream", action="store_true",
+                   help="client-only streaming demo: connect to a "
+                        "running --serve-generate endpoint (--target "
+                        "HOST:PORT; router front doors work too) and "
+                        "stream ONE generation of --prompt over "
+                        "LayerService/GenerateStream, printing bytes "
+                        "as each token frame lands (first output at "
+                        "~TTFT, not retirement) plus a JSON latency "
+                        "summary. Prompt pads/truncates to "
+                        "--serve-prompt-len")
+    p.add_argument("--target", default=None, metavar="HOST:PORT",
+                   help="the --serve-generate endpoint for --stream")
+    p.add_argument("--session-key", default=None,
+                   help="x-tdn-session affinity key for --stream "
+                        "behind a router")
     p.add_argument("--max-pending-rows", type=int, default=None,
                    help="admission-control watermark for --serve-generate: "
                         "requests that would queue past this many pending "

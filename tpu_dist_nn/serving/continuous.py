@@ -96,7 +96,12 @@ from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.goodput import GOODPUT, LMFlopModel
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
-from tpu_dist_nn.serving.sched_core import CLASS_RANK, SchedCore
+from tpu_dist_nn.serving.sched_core import (
+    CLASS_RANK,
+    SchedCore,
+    slide_stream_deadline,
+)
+from tpu_dist_nn.serving.stream import StreamDone, TokenStream
 
 log = logging.getLogger(__name__)  # plain channel (kept for debug use)
 slog = get_logger(__name__)
@@ -735,7 +740,129 @@ class ContinuousScheduler:
         self._sched_core.wait(item, what="generation")
         return item["out"]
 
+    def submit_stream(self, x: np.ndarray, *,
+                      max_new_tokens: int | None = None,
+                      timeout: float | None = None, ctx=None,
+                      slo_class: str = "standard",
+                      resume_tokens=None,
+                      max_buffer: int = 4096) -> TokenStream:
+        """Admit ONE prompt row ``(1, prompt_len)`` for streaming
+        generation and return its :class:`TokenStream` immediately (the
+        GenerateStream handler drains it; nothing blocks here beyond
+        admission itself, which can shed). Single-row by contract:
+        frame ordering and failover resume are per-sequence concepts —
+        a client streams N prompts over N streams.
+
+        ``timeout`` is STREAM-aware (docs/ROBUSTNESS.md): it bounds the
+        submit-to-first-token wait (queue + prefill) and then each
+        NEXT-TOKEN gap — the deadline slides forward at every published
+        token — instead of total retirement time, so a long generation
+        that is steadily producing tokens never expires mid-stream.
+
+        ``resume_tokens`` is the router's mid-stream-failover prefix:
+        tokens the CLIENT already holds. The row binds through the
+        preemption-resume path (prompt re-prefill + forced-token
+        replay, bit-identical at temperature 0) and the stream's sent
+        cursor swallows the replayed prefix, so the client receives
+        each token exactly once across the replica switch.
+        """
+        x = np.asarray(x, np.int32)
+        if x.ndim != 2 or x.shape != (1, self._T):
+            raise ValueError(
+                f"streaming expects ONE prompt of shape (1, {self._T}), "
+                f"got {tuple(x.shape)}"
+            )
+        budget = self._N if max_new_tokens is None else int(max_new_tokens)
+        if not 1 <= budget <= self._N:
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self._N}], got {budget}"
+            )
+        resume = [int(t) for t in resume_tokens] if resume_tokens else None
+        stream = TokenStream(max_buffer)
+        if resume is not None:
+            # The client already holds the whole replayed prefix.
+            stream.seed(len(resume))
+            # Degenerate resumes — the stream actually FINISHED on the
+            # dead replica (terminal frame lost in the failover): there
+            # is nothing left to generate, so answer the terminal
+            # without burning a slot on a full replay.
+            if self._eos is not None and self._eos in resume:
+                stream.finish("eos")
+                return stream
+            if len(resume) >= budget:
+                stream.finish("max_tokens")
+                return stream
+        out = np.full(
+            (1, self._T + self._N),
+            self._eos if self._eos is not None else 0, np.int64,
+        )
+        out[:, :self._T] = x
+        item = {
+            "x": x, "budget": budget, "out": out, "next_row": 0,
+            "remaining": 1, "err": None,
+            "abandoned": False, "t_submit": time.monotonic(),
+            "slo_class": slo_class,
+            "ctx": ctx if ctx is not None and ctx.sampled else None,
+            "stream": stream,
+            # Per-token-gap budget: _publish slides item["deadline"]
+            # forward by this much at every published token.
+            "gap_budget": timeout,
+            # Consumed at bind: routes the row through the preemption-
+            # resume path (forced-token replay).
+            "resume_tokens": resume,
+        }
+        # The done Event is the terminal seam: every existing exit path
+        # (_retire, _free_slot_on_error, queue expiry, close sweeps)
+        # already stamps err/finish_reason then calls done.set() — the
+        # StreamDone subclass turns that into the END frame.
+        item["done"] = StreamDone(item, stream)
+        self._sched_core.admit(item, timeout)
+        return stream
+
     # ------------------------------------------------------------ loop
+
+    def _publish(self, occ: dict) -> None:
+        """Flush the occupant's known-token list into its stream, if it
+        has one (called after every ``occ["tokens"]`` append). A dead
+        stream (client gone / buffer overflow) marks the item abandoned
+        — the loop's reap pass frees the slot next iteration. A live
+        publish slides the stream's next-token-gap deadline."""
+        item = occ["item"]
+        stream = item.get("stream")
+        if stream is None:
+            return
+        if not stream.publish(occ["tokens"]):
+            item["abandoned"] = True
+            return
+        slide_stream_deadline(item, item.get("gap_budget"))
+
+    def _reap_cancelled(self) -> None:
+        """Free resident slots whose STREAM item died — client abandon,
+        gRPC cancellation, or backpressure overflow (satellite 2: the
+        cancel-propagation half of the streaming contract). Unary items
+        keep their documented semantics: abandoned rows already
+        decoding finish their bounded budget and are discarded."""
+        for s in range(self._S):
+            occ = self._occupant[s]
+            if occ is None:
+                continue
+            item = occ["item"]
+            if item.get("stream") is None:
+                continue
+            if not (item["abandoned"] or item["err"] is not None):
+                continue
+            self._occupant[s] = None
+            self._active[s] = False
+            self._release_block(occ)
+            self.retired_total += 1
+            _RETIRED.labels(reason="cancelled").inc()
+            _TOKENS.inc(len(occ["tokens"]))
+            self._sched_core.note_drained(1)
+            item["remaining"] -= 1
+            slog.info(
+                "gen.stream_cancelled", slot=s,
+                tokens_generated=len(occ["tokens"]),
+            )
 
     def _release_block(self, occ: dict) -> None:
         """Drop the occupant's prefix-block reference, if it holds one
@@ -791,6 +918,9 @@ class ContinuousScheduler:
         item, row = occ["item"], occ["row"]
         toks = occ["tokens"]
         item["out"][row, self._T:self._T + len(toks)] = toks
+        # Terminal state BEFORE done.set(): a streaming item's
+        # StreamDone reads it to build the END frame.
+        item["finish_reason"] = reason
         self._active[slot] = False
         self._occupant[slot] = None
         self._release_block(occ)
@@ -1011,6 +1141,7 @@ class ContinuousScheduler:
                     },
                 )
             occ["tokens"].append(first)
+            self._publish(occ)
             self._active[slot] = True
             self._pos[slot] = self._T
             self._tok[slot] = first
@@ -1030,6 +1161,7 @@ class ContinuousScheduler:
                 },
             )
         occ["tokens"].append(tok)
+        self._publish(occ)
         self._active[slot] = True
         self._pos[slot] = self._T
         self._tok[slot] = tok
@@ -1139,11 +1271,13 @@ class ContinuousScheduler:
                 # the replayed stream was mid-decode when preempted.
                 forced = int(occ["replay"].pop(0))
                 occ["tokens"].append(forced)
+                self._publish(occ)
                 self._pos[s] += 1
                 self._tok[s] = forced
                 continue
             tok = int(toks[s])
             occ["tokens"].append(tok)
+            self._publish(occ)
             self._pos[s] += 1
             self._tok[s] = tok
             if self._eos is not None and tok == self._eos:
@@ -1209,7 +1343,13 @@ class ContinuousScheduler:
             self._bind_slot(data["item"], data["row"],
                             resume=data["tokens"])
         else:
-            self._bind_slot(*data)
+            item, row = data
+            # A streaming failover resume (submit_stream's
+            # resume_tokens) rides the SAME replay path a preemption
+            # victim uses: re-prefill the prompt, force-replay the
+            # already-delivered tokens, continue bit-identically.
+            self._bind_slot(item, row,
+                            resume=item.pop("resume_tokens", None))
 
     def _pick_victim(self) -> int | None:
         """The slot to preempt for a critical bind: never a critical
@@ -1293,6 +1433,10 @@ class ContinuousScheduler:
     def _loop(self) -> None:
         core = self._sched_core
         while True:
+            # Cancel propagation first: slots freed by dead streams are
+            # bindable THIS iteration (satellite 2 — a cancel storm must
+            # not strand slots for even one extra step).
+            self._reap_cancelled()
             admits = []
             with self._cond:
                 while (not core.closed and not core.has_pending()

@@ -166,6 +166,7 @@ class Replica:
         # express; only the channel state below is this object's own.)
         self._channel = None  # guarded-by: _lock
         self._stubs: dict[str, object] = {}  # guarded-by: _lock
+        self._stream_stubs: dict[str, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ wire
@@ -206,12 +207,37 @@ class Replica:
         return self._stub(method).future(payload, timeout=timeout,
                                          metadata=tuple(metadata))
 
+    def call_stream(self, method: str, payload: bytes, *, timeout=None,
+                    metadata=()):
+        """Server-streaming forward (GenerateStream): returns the grpc
+        response iterator over raw frame bytes — the router relays them
+        without decoding (serving/wire.py owns the frame format)."""
+        with self._lock:
+            if self._channel is None:
+                self._channel = grpc.insecure_channel(
+                    self.target,
+                    options=[
+                        ("grpc.max_send_message_length", -1),
+                        ("grpc.max_receive_message_length", -1),
+                    ],
+                )
+            stub = self._stream_stubs.get(method)
+            if stub is None:
+                stub = self._channel.unary_stream(
+                    f"/{SERVICE_NAME}/{method}",
+                    request_serializer=bytes,
+                    response_deserializer=bytes,
+                )
+                self._stream_stubs[method] = stub
+        return stub(payload, timeout=timeout, metadata=tuple(metadata))
+
     def close_channel(self) -> None:
         with self._lock:
             if self._channel is not None:
                 self._channel.close()
             self._channel = None
             self._stubs = {}
+            self._stream_stubs = {}
 
     # ------------------------------------------------------------ load
 

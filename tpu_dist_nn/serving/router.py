@@ -67,6 +67,8 @@ from tpu_dist_nn.serving.wire import (
     RETRY_AFTER_HEADER,
     SERVICE_NAME,
     SESSION_HEADER,
+    STREAM_RESUME_HEADER,
+    decode_frame,
 )
 
 log = logging.getLogger(__name__)
@@ -109,6 +111,12 @@ ROUTER_HEDGE_WINS = REGISTRY.counter(
     "cancelled) — the tail the hedge actually cut",
     labels=("method",),
 )
+ROUTER_STREAM_RESUMES = REGISTRY.counter(
+    "tdn_router_stream_resumes_total",
+    "GenerateStream failovers resumed mid-stream on another replica "
+    "(already-delivered tokens replayed as forced tokens — the client "
+    "sees one uninterrupted, exactly-once stream)",
+)
 
 _CLIENT_DEFAULT = object()
 
@@ -130,6 +138,14 @@ class HedgePolicy:
     on the hedge replica, and both replicas burn decode slots), so it
     must be opted in explicitly (``--hedge-generate``) by operators
     running greedy decoding or accepting the cost.
+
+    ``GenerateStream`` can never be hedged and is rejected here: a
+    stream is non-idempotent MID-FLIGHT — by the time patience expires,
+    tokens have already been delivered to the client, so "first reply
+    wins" has no meaning (two replicas would race to continue one
+    half-consumed sequence). Streams get replay-resume failover
+    instead (docs/SCALING.md "Streaming failover"): strictly
+    sequential, resumed from exactly the delivered prefix.
     """
 
     def __init__(self, p99_ratio: float = 2.0, *,
@@ -139,6 +155,13 @@ class HedgePolicy:
         if p99_ratio <= 0:
             raise ValueError(
                 f"hedge p99_ratio must be > 0, got {p99_ratio}"
+            )
+        if "GenerateStream" in methods:
+            raise ValueError(
+                "GenerateStream cannot be hedged: a stream is "
+                "non-idempotent mid-flight (tokens already delivered); "
+                "streams fail over by replay-resume instead "
+                "(docs/SCALING.md \"Streaming failover\")"
             )
         self.p99_ratio = float(p99_ratio)
         self.methods = frozenset(methods)
@@ -395,6 +418,244 @@ class Router:
                        else "budget exhausted")
                 span.annotate(
                     f"failover stopped after attempt {attempt} ({why})"
+                )
+                slog.warning(
+                    "router.request_failed", method=method,
+                    replica=rep.target, code=_code_name(code),
+                    attempts=attempt, why=why,
+                )
+                context.abort(code, _details_of(err))
+            prev_failed = rep.target
+            span.annotate(
+                f"failover after {_code_name(code)} from {rep.target}"
+            )
+            if delay:
+                policy.sleep(delay)
+
+    # --------------------------------------------------------- streams
+
+    def handle_stream(self, method: str, payload: bytes, context):
+        """The GenerateStream hop: relay the replica's frame bytes
+        WITHOUT re-encoding (the router shallow-parses each frame's
+        type byte + token ids only, to keep the resume ledger), and
+        redefine failover for the streaming case — a transient failure
+        MID-STREAM re-places onto another replica carrying the prompt
+        plus every already-delivered token as ``x-tdn-stream-resume``;
+        the replica replays that prefix as forced tokens (the PR-15
+        preemption-resume path) and its stream cursor suppresses
+        re-delivery, so the client sees one uninterrupted stream,
+        bit-identical at temperature 0, with zero duplicated or
+        dropped tokens.
+
+        Hedging never applies here (structurally: this path never
+        consults the HedgePolicy, and the policy itself rejects
+        ``GenerateStream`` at construction): a stream is non-idempotent
+        the moment its first token is delivered.
+        """
+        span, _budget, md = _request_span(context, method)
+        session = md.get(SESSION_HEADER)
+        slo_class = md.get(CLASS_HEADER)
+        t0 = time.monotonic()
+        try:
+            yield from self._route_stream(method, payload, context, span,
+                                          md, session, slo_class)
+        finally:
+            ROUTER_LATENCY.labels(method=method).observe(
+                time.monotonic() - t0
+            )
+            span.end()
+
+    def _route_stream(self, method, payload, context, span, md,
+                      session, slo_class):
+        policy = self._retry
+        # Stream deadline semantics (docs/ROBUSTNESS.md): the
+        # x-tdn-timeout-ms hint is a NEXT-TOKEN-GAP budget, not a total
+        # — it is forwarded VERBATIM on every attempt (never carved
+        # down), because a healthy long stream outlives any per-request
+        # budget by design. Only a real gRPC deadline (the client
+        # explicitly bounding the whole stream) is carved across
+        # failover attempts. _forward_timeout is NOT applied: a stream
+        # legitimately holds its worker for the whole generation, and
+        # the replica's gap deadline is what kills a wedged one.
+        gap_hint = md.get(_trace.TIMEOUT_HEADER)
+        deadline = None
+        try:
+            rem = context.time_remaining()
+            if rem is not None and rem < 1e9:  # far-future sentinel
+                deadline = time.monotonic() + rem
+        except Exception:  # noqa: BLE001 — in-process fakes
+            pass
+        # The resume ledger: token ids this router has handed to gRPC
+        # for delivery. Seeded from an INBOUND resume header so a
+        # resuming caller (stacked router) composes.
+        delivered: list[int] = []
+        raw = md.get(STREAM_RESUME_HEADER)
+        if raw:
+            try:
+                delivered = [int(t) for t in raw.split(",")]
+            except ValueError:
+                self._abort(
+                    context, "none", grpc.StatusCode.INVALID_ARGUMENT,
+                    f"bad {STREAM_RESUME_HEADER}: expected "
+                    "comma-separated token ids",
+                )
+        # Streams surface the trace id in INITIAL metadata (the replica
+        # handler does the same): trailing metadata only lands at
+        # stream end — useless against a wedged stream.
+        try:
+            context.send_initial_metadata(
+                ((_trace.TRACE_ID_HEADER, span.ctx.trace_id),)
+            )
+        except Exception:  # noqa: BLE001 — in-process fakes
+            pass
+        attempt = 0
+        tried: set[str] = set()
+        last: grpc.RpcError | None = None
+        prev_failed: str | None = None
+        while True:
+            attempt += 1
+            t0 = time.monotonic()
+            rep = self.pool.place(session_key=session, exclude=tried)
+            if rep is None and tried:
+                tried.clear()
+                rep = self.pool.place(session_key=session)
+            ROUTER_PLACEMENT.observe(time.monotonic() - t0)
+            if rep is None:
+                span.annotate("no placeable replica")
+                if last is not None:
+                    self._abort(
+                        context, "none", _status_of(last),
+                        f"no replica left to fail over to: "
+                        f"{_details_of(last)}",
+                    )
+                self._abort(
+                    context, "none", grpc.StatusCode.UNAVAILABLE,
+                    "no healthy replica available (pool empty, all "
+                    "draining, or all breakers open)",
+                )
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0.001:
+                    span.annotate("budget exhausted before forward")
+                    self._abort(
+                        context, "none",
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "request budget exhausted during failover",
+                    )
+            metadata = [(_trace.TRACE_HEADER, span.ctx.header())]
+            if gap_hint is not None:
+                metadata.append((_trace.TIMEOUT_HEADER, gap_hint))
+            if session is not None:
+                metadata.append((SESSION_HEADER, session))
+            if slo_class is not None:
+                metadata.append((CLASS_HEADER, slo_class))
+            if delivered:
+                metadata.append(
+                    (STREAM_RESUME_HEADER,
+                     ",".join(str(t) for t in delivered))
+                )
+            if prev_failed is not None and rep.target != prev_failed:
+                ROUTER_FAILOVERS.inc()
+            n_before = len(delivered)
+            err: grpc.RpcError | None = None
+            ended = False
+            self.pool.begin(rep)
+            t_fwd = time.monotonic()
+            try:
+                call = rep.call_stream(method, payload, timeout=remaining,
+                                       metadata=metadata)
+                for frame in call:
+                    kind = frame[0] if frame else None
+                    if kind == 1:  # TOKENS: ledger BEFORE the relay
+                        _k, ids = decode_frame(frame)
+                        delivered.extend(ids)
+                    yield frame
+                    if kind == 2:  # END: the terminal — stream is done
+                        ended = True
+                        break
+                if not ended:
+                    # The replica closed the stream OK but never sent
+                    # its END frame: it died between flushes. Shape it
+                    # like the wire failure it is so failover resumes.
+                    err = _SyntheticRpcError(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "replica stream closed without a terminal frame",
+                    )
+            except grpc.RpcError as e:
+                err = e
+            finally:
+                self.pool.done(rep)
+                _trace.TRACER.record_span(
+                    "router.forward", span.ctx, t_fwd,
+                    time.monotonic() - t_fwd,
+                    attrs={"replica": rep.target, "attempt": attempt,
+                           "ok": err is None, "stream": True,
+                           "tokens": len(delivered) - n_before},
+                )
+            if err is None:
+                rep.breaker.record_success()
+                ROUTER_REQUESTS.labels(
+                    replica=rep.target, outcome="ok"
+                ).inc()
+                if session is not None:
+                    self.pool.pin(session, rep.target)
+                if attempt > 1:
+                    span.annotate(
+                        f"served by {rep.target} on attempt {attempt}"
+                    )
+                return
+            code = _status_of(err)
+            transient = self._transient(code)
+            if transient:
+                rep.breaker.record_failure()
+            else:
+                rep.breaker.record_success()
+            ROUTER_REQUESTS.labels(
+                replica=rep.target, outcome=_code_name(code)
+            ).inc()
+            if not transient:
+                _copy_retry_after(context, err)
+                span.annotate(
+                    f"{_code_name(code)} from {rep.target}: propagated"
+                )
+                context.abort(code, _details_of(err))
+            if len(delivered) > n_before or n_before > 0:
+                # Tokens are mid-flight: the re-placement below is a
+                # RESUME, not a plain failover — the next attempt
+                # carries the delivered prefix for forced-token replay.
+                ROUTER_STREAM_RESUMES.inc()
+                span.annotate(
+                    f"mid-stream {_code_name(code)} from {rep.target}: "
+                    f"resuming at token {len(delivered)}"
+                )
+            last = err
+            tried.add(rep.target)
+            placeable = {
+                r.target for r in self.pool.replicas()
+                if r.state == ACTIVE
+                and r.breaker.state == CircuitBreaker.CLOSED
+            }
+            retry_same_set = not (placeable - tried)
+            out_of_attempts = (
+                policy is None
+                or attempt >= max(policy.max_attempts,
+                                  len(placeable | tried))
+            )
+            delay = (
+                policy.backoff(attempt)
+                if not out_of_attempts and retry_same_set else 0.0
+            )
+            out_of_budget = (
+                deadline is not None
+                and time.monotonic() + delay >= deadline
+            )
+            if out_of_attempts or out_of_budget:
+                why = ("attempts exhausted" if out_of_attempts
+                       else "budget exhausted")
+                span.annotate(
+                    f"stream failover stopped after attempt {attempt} "
+                    f"({why})"
                 )
                 slog.warning(
                     "router.request_failed", method=method,
@@ -672,9 +933,21 @@ def _make_router_handler(router: Router):
             handle, request_deserializer=bytes, response_serializer=bytes
         )
 
+    def handle_stream(request_bytes: bytes, context):
+        yield from router.handle_stream(
+            "GenerateStream", request_bytes, context
+        )
+
     return grpc.method_handlers_generic_handler(
         SERVICE_NAME,
-        {"Process": bind("Process"), "Generate": bind("Generate")},
+        {
+            "Process": bind("Process"),
+            "Generate": bind("Generate"),
+            "GenerateStream": grpc.unary_stream_rpc_method_handler(
+                handle_stream, request_deserializer=bytes,
+                response_serializer=bytes,
+            ),
+        },
     )
 
 

@@ -142,6 +142,29 @@ def normalize_class(value) -> str:
     return "standard"
 
 
+def slide_stream_deadline(item: dict, gap: float | None) -> None:
+    """Stream-aware deadline semantics (PR 16, docs/ROBUSTNESS.md).
+
+    A unary entry's ``deadline`` bounds submit-to-RETIREMENT — the
+    caller is blocked until the whole result exists. A STREAMING entry
+    delivers incrementally, so the same absolute deadline would expire
+    a perfectly healthy long generation mid-stream; what the client
+    actually needs bounded is the NEXT-TOKEN gap. The scheduler calls
+    this after every published token: the deadline slides forward by
+    ``gap`` (the original caller budget), so :meth:`SchedCore._expire`
+    / the preemption victim picker only ever kill a stream that has
+    genuinely STALLED for a full budget — queued too long before its
+    first token (the un-slid admission deadline covers that), or
+    silent for ``gap`` seconds while preempted/wedged.
+
+    Plain dict write, GIL-atomic: the scheduler loop is the only
+    writer after admission, and readers (:meth:`SchedCore._dead`, the
+    victim picker) tolerate either the old or new value.
+    """
+    if gap is not None:
+        item["deadline"] = time.monotonic() + gap
+
+
 def validate_class_watermarks(fractions: dict) -> dict:
     """Fail-fast validation for ``--class-watermarks``: known classes,
     fractions in [0, 1], returned as a full table over DEFAULTS."""

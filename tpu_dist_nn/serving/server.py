@@ -41,17 +41,23 @@ from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
 from tpu_dist_nn.serving.sched_core import SchedCore, normalize_class
+from tpu_dist_nn.serving.stream import note_stream_resumed
 from tpu_dist_nn.serving.wire import (
     CLASS_HEADER,
     GENERATE_METHOD,
+    GENERATE_STREAM_METHOD,
     PROCESS_METHOD,
     RETRY_AFTER_HEADER,
     SERVICE_NAME,
     SESSION_HEADER,
+    STREAM_RESUME_HEADER,
     WireMatrix,
+    decode_frame,
     decode_matrix,
     decode_matrix_lazy,
     encode_matrix,
+    encode_end_frame,
+    encode_token_frame,
 )
 
 log = logging.getLogger(__name__)
@@ -602,7 +608,10 @@ def _request_span(context, method: str):
     bounds = []
     try:
         rem = context.time_remaining()
-        if rem is not None:
+        # Deadline-less calls can report a far-future sentinel (~1e10 s)
+        # instead of None; a "budget" measured in centuries is no bound
+        # at all and overflows condition waits downstream.
+        if rem is not None and rem < 1e9:
             bounds.append(rem)
     except Exception:  # noqa: BLE001
         pass
@@ -938,6 +947,154 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
     )
 
 
+def _status_from_code(name: str):
+    """Stream END-frame / FrameworkError code name -> gRPC status (the
+    stream-side twin of _abort_for_exception's isinstance ladder — by
+    the time an error reaches a TokenStream terminal it is a string)."""
+    try:
+        return grpc.StatusCode[name]
+    except KeyError:
+        return grpc.StatusCode.INTERNAL
+
+
+def _make_generate_stream_handler(run_submit_stream, prompt_len: int,
+                                  vocab_size: int):
+    """The GenerateStream method (PR 16): ONE prompt row in, a stream
+    of wire frames out — TOKENS deltas as the continuous scheduler
+    publishes them (serving/stream.py), then exactly one END frame
+    naming the terminal (eos / max_tokens). Same Matrix request wire
+    and status taxonomy as Generate; frames per serving/wire.py.
+
+    Continuous-scheduler only: the static run-to-completion decode has
+    no step-granular tokens to stream, so a static endpoint leaves the
+    method unregistered (UNIMPLEMENTED — the honest answer).
+    """
+
+    def generate_stream(request_bytes: bytes, context):
+        _RPC_REQUESTS.labels(method="GenerateStream").inc()
+        span, budget, md = _request_span(context, "GenerateStream")
+        slo_class = normalize_class(md.get(CLASS_HEADER))
+        stream = None
+        try:
+            try:
+                with _trace.TRACER.span("decode", span.ctx):
+                    x = decode_matrix(request_bytes)
+            except ValueError as e:
+                span.annotate(f"abort INVALID_ARGUMENT: bad Matrix: {e}")
+                _abort(context, "GenerateStream",
+                       grpc.StatusCode.INVALID_ARGUMENT, f"bad Matrix: {e}")
+            if x.ndim != 2 or x.shape != (1, prompt_len):
+                # One stream = one sequence: frame order and failover
+                # resume are per-sequence concepts. A client streams N
+                # prompts over N concurrent RPCs.
+                span.annotate("abort INVALID_ARGUMENT: prompt shape")
+                _abort(
+                    context, "GenerateStream",
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"GenerateStream takes ONE prompt of shape "
+                    f"(1, {prompt_len}), got {tuple(x.shape)}",
+                )
+            ids = x.astype(np.int64)
+            if (ids != x).any() or (ids < 0).any() or (ids >= vocab_size).any():
+                span.annotate("abort INVALID_ARGUMENT: token id range")
+                _abort(
+                    context, "GenerateStream",
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"prompts must be integer token ids in [0, {vocab_size})",
+                )
+            resume = None
+            raw = md.get(STREAM_RESUME_HEADER)
+            if raw:
+                # The router's mid-stream-failover prefix: tokens the
+                # client already received from the dead replica. Rides
+                # the preemption-resume path (forced-token replay) so
+                # the stream continues bit-identically at temperature 0.
+                try:
+                    resume = [int(t) for t in raw.split(",")]
+                except ValueError:
+                    span.annotate("abort INVALID_ARGUMENT: resume header")
+                    _abort(
+                        context, "GenerateStream",
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"bad {STREAM_RESUME_HEADER}: expected "
+                        "comma-separated token ids",
+                    )
+            # Streams surface the trace id in INITIAL metadata (ISSUE
+            # 16 satellite): trailing only lands at stream end — useless
+            # while debugging a stream that is wedged mid-flight. Unary
+            # methods keep the trailing-only contract (_request_span).
+            try:
+                context.send_initial_metadata(
+                    ((_trace.TRACE_ID_HEADER, span.ctx.trace_id),)
+                )
+            except Exception:  # noqa: BLE001 — in-process fakes
+                pass
+            try:
+                stream = run_submit_stream(
+                    x.astype(np.int32), budget, span.ctx, slo_class, resume
+                )
+            except Exception as e:  # noqa: BLE001 — map to status codes
+                span.annotate(f"error: {type(e).__name__}: {e}")
+                _abort_for_exception(context, e, "stream admission",
+                                     "GenerateStream")
+            if resume:
+                note_stream_resumed()
+                span.set("resume_tokens", len(resume))
+            # Client disconnect / gRPC cancellation must free the decode
+            # slot: the callback flips the channel, the next publish
+            # returns False, and the scheduler's reap pass releases the
+            # slot + prefix-cache refs on its next iteration.
+            try:
+                context.add_callback(stream.cancel)
+            except Exception:  # noqa: BLE001 — in-process fakes
+                pass
+            ntok = 0
+            while True:
+                # The budget is the STREAM deadline (docs/ROBUSTNESS.md):
+                # it bounds each next-token gap — admission + prefill
+                # before the first frame, decode cadence after — not
+                # total stream duration. None = wait for the scheduler's
+                # own terminal (every exit path reaches finish()).
+                ev = stream.next_event(budget)
+                if ev is None:
+                    stream.cancel()
+                    span.annotate("abort DEADLINE_EXCEEDED: token gap")
+                    _abort(
+                        context, "GenerateStream",
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        f"no token within the {budget:.3f}s stream gap "
+                        "budget",
+                    )
+                kind, data = ev
+                if kind == "tokens":
+                    ntok += len(data)
+                    yield encode_token_frame(data)
+                    continue
+                if data["reason"] == "error":
+                    span.annotate(
+                        f"stream error {data['code']}: {data['message']}"
+                    )
+                    _abort(context, "GenerateStream",
+                           _status_from_code(data["code"]),
+                           data["message"] or "stream failed")
+                span.set("tokens", ntok)
+                yield encode_end_frame(data["reason"], data["code"],
+                                       data["message"])
+                return
+        finally:
+            if stream is not None:
+                stream.cancel()  # no-op after a clean terminal
+            span.end()
+
+    rpc = grpc.unary_stream_rpc_method_handler(
+        generate_stream, request_deserializer=bytes,
+        response_serializer=bytes,
+    )
+    return grpc.method_handlers_generic_handler(
+        SERVICE_NAME, {"GenerateStream": rpc}
+    )
+
+
 def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
                       prompt_len: int, num_stages: int = 1,
                       num_groups: int | None = None,
@@ -1082,10 +1239,20 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
             return sched.submit(ids, timeout=time_remaining, ctx=ctx,
                                 slo_class=slo_class)
 
+        def run_submit_stream(ids: np.ndarray, time_remaining, ctx=None,
+                              slo_class: str = "standard", resume=None):
+            return sched.submit_stream(
+                ids, timeout=time_remaining, ctx=ctx, slo_class=slo_class,
+                resume_tokens=resume,
+            )
+
         server = _new_grpc_server(max_workers, interceptors)
-        server.add_generic_rpc_handlers(
-            (_make_generate_handler(run_submit, T, cfg.vocab_size),)
-        )
+        server.add_generic_rpc_handlers((
+            _make_generate_handler(run_submit, T, cfg.vocab_size),
+            _make_generate_stream_handler(
+                run_submit_stream, T, cfg.vocab_size
+            ),
+        ))
         bound = _bind_or_close(server, host, port, sched)
         # The scheduler fulfils the batcher counter/close contract, so
         # stop-wrapping, GracefulDrain, and the runtime sampler work
@@ -1226,6 +1393,74 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
 _CLIENT_DEFAULT = object()  # "use the built-in default" sentinel
 
 
+class StreamReply:
+    """One streamed generation (``GrpcClient.generate_stream``).
+
+    Iterate to receive token ids as the server publishes them; when
+    iteration ends normally, ``finish`` holds the terminal frame
+    (``{"reason": "eos" | "max_tokens", ...}``). ``trace_id`` carries
+    the server's trace id from INITIAL metadata — available as soon as
+    the stream opens, so a wedged stream can be debugged (``tdn trace``)
+    before it ever terminates. ``cancel()`` tears the RPC down; the
+    server frees the decode slot on its next scheduler iteration.
+
+    A broken stream raises ``grpc.RpcError`` (enriched with
+    ``server_trace_id``) from the iterator. There is deliberately NO
+    client-side retry: a mid-stream failure is not idempotent from here
+    (tokens were already delivered) — failover is the ROUTER's job,
+    which resumes the stream on another replica via forced-token replay
+    (docs/SCALING.md "Streaming failover").
+    """
+
+    def __init__(self, call, span):
+        self._call = call
+        self._span = span
+        self._ended = False
+        self.finish: dict | None = None
+        self.trace_id: str | None = None
+
+    def cancel(self) -> None:
+        self._call.cancel()
+
+    def _end_span(self) -> None:
+        if not self._ended:
+            self._ended = True
+            self._span.end()
+
+    def __iter__(self):
+        try:
+            try:
+                for k, v in self._call.initial_metadata() or ():
+                    if k == _trace.TRACE_ID_HEADER:
+                        self.trace_id = v
+            except Exception:  # noqa: BLE001 — metadata is best-effort
+                pass
+            for frame in self._call:
+                kind, data = decode_frame(frame)
+                if kind == "tokens":
+                    yield from data
+                else:
+                    self.finish = data
+                    self._span.annotate(f"end: {data['reason']}")
+                    return
+            # Stream closed OK without an END frame: a server that died
+            # between its last TOKENS flush and the terminal. Surface it
+            # rather than pretend the generation completed.
+            raise grpc.RpcError(
+                "stream closed without a terminal END frame"
+            )
+        except grpc.RpcError as e:
+            code, trace_id = GrpcClient._enrich(e, self._span)
+            if trace_id is not None:
+                self.trace_id = trace_id
+            self._span.annotate(
+                f"stream failed {code}: server trace {trace_id}"
+            )
+            raise
+        finally:
+            self._end_span()
+
+
 class GrpcClient:
     """Minimal client for the Process RPC — the ``tdn infer --target``
     transport (the reference client's ``run_batch_inference`` analogue,
@@ -1306,6 +1541,11 @@ class GrpcClient:
         )
         self._call_generate = self._channel.unary_unary(
             GENERATE_METHOD,
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
+        self._call_generate_stream = self._channel.unary_stream(
+            GENERATE_STREAM_METHOD,
             request_serializer=bytes,
             response_deserializer=bytes,
         )
@@ -1527,6 +1767,51 @@ class GrpcClient:
         # Decode lands token ids straight in int64 — the wire doubles
         # are exact for ids < 2^53, so the cast-on-decode is lossless.
         return decode_matrix(reply, dtype=np.int64)
+
+    def generate_stream(self, prompt: np.ndarray, *,
+                        session_key=_CLIENT_DEFAULT,
+                        slo_class=_CLIENT_DEFAULT,
+                        timeout: float | None = None,
+                        gap_timeout: float | None = None) -> StreamReply:
+        """Stream ONE prompt's tokens as the server produces them.
+
+        ``prompt`` is one sequence of token ids — ``(prompt_len,)`` or
+        ``(1, prompt_len)``. Returns a :class:`StreamReply`; iterate it
+        for token ids at decode-step granularity (first token at ~TTFT,
+        not retirement).
+
+        ``timeout`` bounds the WHOLE stream (gRPC deadline; None =
+        unbounded — the streaming default, a long generation is not an
+        error). ``gap_timeout`` is the stream-aware deadline
+        (docs/ROBUSTNESS.md): the server bounds admission + prefill to
+        first token and then every next-token gap by it, so a stalled
+        stream dies fast while a steadily-producing one never expires.
+        """
+        x = np.asarray(prompt)
+        if x.ndim == 1:
+            x = x[None, :]
+        session = (
+            self.session_key if session_key is _CLIENT_DEFAULT
+            else session_key
+        )
+        cls = (
+            self.slo_class if slo_class is _CLIENT_DEFAULT else slo_class
+        )
+        span = _trace.TRACER.start("client.GenerateStream")
+        metadata = ((_trace.TRACE_HEADER, span.ctx.header()),)
+        if session is not None:
+            metadata += ((SESSION_HEADER, session),)
+        if cls is not None:
+            metadata += ((CLASS_HEADER, cls),)
+        if gap_timeout is not None:
+            metadata += (
+                (_trace.TIMEOUT_HEADER,
+                 str(max(0, int(gap_timeout * 1000)))),
+            )
+        call = self._call_generate_stream(
+            encode_matrix(x), timeout=timeout, metadata=metadata
+        )
+        return StreamReply(call, span)
 
     def close(self) -> None:
         self._channel.close()

@@ -499,6 +499,87 @@ def decode_matrix_lazy(data: bytes, dtype=np.float64):
     return out
 
 
+# ----------------------------------------------------- stream frames
+#
+# GenerateStream (serving/stream.py, docs/SCALING.md "Streaming
+# failover") speaks a tiny frame codec ON TOP of gRPC server-streaming:
+# each gRPC stream message is exactly ONE frame (gRPC already
+# length-delimits messages, so frames need no outer envelope). Byte 0
+# is the frame type; varints reuse the protobuf encoder above.
+#
+#   TOKENS frame: 0x01 varint(count) varint(token_id) * count
+#     — a delta of newly produced token ids, in order.
+#   END frame:    0x02 varint(len) reason_utf8 varint(len) code_utf8
+#                 varint(len) message_utf8
+#     — the terminal status: ``reason`` is "eos" / "max_tokens" for a
+#       normal finish (code/message empty), else "error" with the
+#       canonical error code name + message. Exactly one END frame
+#       closes every well-formed stream.
+#
+# The router forwards these bytes VERBATIM (it never decodes matrices),
+# but shallow-parses TOKENS frames to keep its delivered-token ledger —
+# the resume state it replays into a fallback replica on mid-stream
+# failover. Keeping the codec here (not serving/stream.py) preserves
+# the layering: wire.py owns every byte format, stream.py owns the
+# channel semantics.
+
+FRAME_TOKENS = 1
+FRAME_END = 2
+
+
+def encode_token_frame(tokens) -> bytes:
+    """``[token ids] -> TOKENS frame`` bytes (a non-empty delta)."""
+    out = bytearray((FRAME_TOKENS,))
+    out += _varint(len(tokens))
+    for t in tokens:
+        out += _varint(int(t))
+    return bytes(out)
+
+
+def encode_end_frame(reason: str, code: str = "",
+                     message: str = "") -> bytes:
+    """Terminal frame: ``reason`` ("eos" / "max_tokens" / "error"),
+    plus the canonical error code name + message when reason is
+    "error"."""
+    out = bytearray((FRAME_END,))
+    for s in (reason, code, message):
+        b = s.encode("utf-8")
+        out += _varint(len(b))
+        out += b
+    return bytes(out)
+
+
+def decode_frame(data: bytes):
+    """One stream frame -> ``("tokens", [ids])`` or
+    ``("end", {"reason", "code", "message"})``. Raises ``ValueError``
+    on malformed bytes (unknown type, truncation)."""
+    if not data:
+        raise ValueError("empty stream frame")
+    kind = data[0]
+    if kind == FRAME_TOKENS:
+        count, pos = _read_varint(data, 1)
+        toks = []
+        for _ in range(count):
+            t, pos = _read_varint(data, pos)
+            toks.append(t)
+        if pos != len(data):
+            raise ValueError("trailing bytes after TOKENS frame")
+        return "tokens", toks
+    if kind == FRAME_END:
+        fields = []
+        pos = 1
+        for _ in range(3):
+            ln, pos = _read_varint(data, pos)
+            end = _bounded(data, pos, ln)
+            fields.append(bytes(data[pos:end]).decode("utf-8"))
+            pos = end
+        if pos != len(data):
+            raise ValueError("trailing bytes after END frame")
+        return "end", {"reason": fields[0], "code": fields[1],
+                       "message": fields[2]}
+    raise ValueError(f"unknown stream frame type {kind}")
+
+
 #: The fully-qualified method the reference's stubs call — the proto
 #: package is ``grpc_dist_nn`` (``src/proto/dist_nn.proto:3``), so
 #: LayerServiceStub targets exactly this path.
@@ -507,6 +588,10 @@ PROCESS_METHOD = "/grpc_dist_nn.LayerService/Process"
 # exact for ids < 2^53): prompts (N, T) in, (N, T + max_new_tokens)
 # out. A second method on the reference's service, not a new protocol.
 GENERATE_METHOD = "/grpc_dist_nn.LayerService/Generate"
+# Server-streaming generation (PR 16): same prompt Matrix in (exactly
+# one row), a stream of TOKENS/END frames out (codec above). The
+# router forwards the frames verbatim and owns mid-stream failover.
+GENERATE_STREAM_METHOD = "/grpc_dist_nn.LayerService/GenerateStream"
 SERVICE_NAME = "grpc_dist_nn.LayerService"
 # Client -> server session key (serving/router.py): pins a session's
 # follow-up Generate requests to the replica already holding its
@@ -521,3 +606,11 @@ CLASS_HEADER = "x-tdn-class"
 # drain-rate-derived backoff floor in milliseconds (RetryPolicy honors
 # it so a shed storm cannot re-synchronize into a hot-retry storm).
 RETRY_AFTER_HEADER = "x-tdn-retry-after-ms"
+# Router -> replica request metadata on a GenerateStream failover
+# re-placement: the comma-separated token ids the client ALREADY
+# received. The fallback replica replays them as forced tokens
+# (serving/continuous.py resume path) and streams only what follows —
+# exactly-once delivery across the replica switch. Bounded by gRPC's
+# ~8 KB default metadata budget, which comfortably holds any
+# max_new_tokens this engine is configured for.
+STREAM_RESUME_HEADER = "x-tdn-stream-resume"
