@@ -912,6 +912,16 @@ def serving_bench(jax, *, batch_rpcs: int = 5, clients: int = 10,
         print(f"# mixed-class overload bench unavailable "
               f"({type(e).__name__}: {e})", file=sys.stderr)
         out["slo_classes"] = None
+    # Scenario matrix (ISSUE 18): every checked-in scenarios/*.json
+    # cell (workload x chaos, SLO-scored) run through the replay
+    # engine at quick scale; tools/bench_gate.py gates pass_ratio
+    # (higher is better, per-metric skip for pre-ISSUE-18 rounds).
+    try:
+        out["scenarios"] = scenarios_bench()
+    except Exception as e:  # noqa: BLE001 — must not cost the block
+        print(f"# scenario matrix bench unavailable "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        out["scenarios"] = None
     # Per-stage attribution of the numbers above (obs/profile over the
     # spans this bench just recorded): the round artifact then carries
     # WHERE the serving time went, and tools/bench_gate.py folds it
@@ -2342,6 +2352,63 @@ def slo_class_bench(*, slots: int = 2, prompt_len: int = 8,
         "class_mix": {"critical": 0.2, "standard": 0.2,
                       "best_effort": 0.6},
         "regime": f"controlled per-step cost {step_cost}s",
+    }
+
+
+def scenarios_bench(*, quick_scale: float = 0.5,
+                    directory: str | None = None) -> dict:
+    """Checked-in scenario matrix (ISSUE 18): run every spec under
+    ``scenarios/`` through the replay engine and report the pass
+    ratio.
+
+    Each scenario is a (workload generator | captured bundle) x
+    (chaos plan) cell with SLO objectives scored by the real
+    SLOTracker over the run's timeseries ring — so the gated figure,
+    ``pass_ratio``, is "how many of the checked-in weather cells does
+    the serving stack still survive". Scenarios run at their declared
+    seeds (deterministic) but scaled down by ``quick_scale`` to keep
+    the bench round bounded; the CLI (``tdn replay --scenario-dir``)
+    runs them full-size. A scenario that ERRORS (as opposed to
+    failing its SLO) is reported and counts as a failure — the matrix
+    is only a gate if every cell actually executes.
+    """
+    from tpu_dist_nn.obs import replay as R
+
+    scen_dir = directory or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scenarios")
+    paths = R.scenario_paths(scen_dir)
+    rows = []
+    passed = 0
+    for path in paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        t0 = time.monotonic()
+        try:
+            verdict = R.run_scenario_file(path, quick_scale=quick_scale)
+        except Exception as e:  # noqa: BLE001 — one bad cell must not
+            # cost the matrix, but it DOES cost the ratio.
+            rows.append({"scenario": name, "passed": False,
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
+        ok = bool(verdict.get("passed"))
+        passed += 1 if ok else 0
+        rows.append({
+            "scenario": name,
+            "passed": ok,
+            "duration_s": round(time.monotonic() - t0, 2),
+            "requests": verdict.get("workload", {}).get("requests"),
+            "worst_burn_rate": max(
+                (o.get("burn_rate") or 0.0)
+                for o in verdict.get("objectives", [{}])
+            ) if verdict.get("objectives") else None,
+            "faults_fired": verdict.get("faults_fired"),
+        })
+    total = len(paths)
+    return {
+        "scenarios": rows,
+        "total": total,
+        "passed": passed,
+        "pass_ratio": round(passed / total, 3) if total else None,
+        "quick_scale": quick_scale,
     }
 
 

@@ -208,6 +208,19 @@ QUICK_TESTS = {
     "test_quantized": ["test_weight_quantization_roundtrip_error_bounded",
                        "test_quantized_forward_close_to_f32",
                        "test_quantize_honors_metadata_distribution"],
+    # ISSUE 18 acceptance smokes: generator determinism, the
+    # incident-bundle -> WorkloadTrace -> replay round trip (exact mix
+    # + per-decile arrival fidelity over a live loopback fleet), the
+    # seeded-probability fault mode, the stream-resume bound at its
+    # exact boundary, one quick-scaled scenario verdict, and the
+    # bench_gate scenario_pass_ratio skip/fail contract.
+    "test_replay": [
+        "test_generators_deterministic_and_well_formed",
+        "test_fault_plan_probability_mode_deterministic_under_seed",
+        "test_bundle_round_trip_exact_mix_and_arrival_deciles",
+        "test_stream_resume_bound_boundary_and_overflow_counter",
+        "test_scenario_quick_smoke_deterministic_verdict",
+        "test_bench_gate_scenario_pass_ratio_skip_and_fail"],
     "test_router": [
         # ISSUE 8: the loopback p2c smoke (spread + tdn_router_*
         # family on /metrics), the breaker-registry-eviction

@@ -149,6 +149,13 @@ GATED_METRICS = (
     # per-metric skip.
     ("gen_stream_ttft_p50_ms",
      ("serving", "generate_stream", "ttft_p50_ms"), "lower"),
+    # Scenario matrix (ISSUE 18): fraction of the checked-in
+    # scenarios/*.json cells (workload x chaos, SLO-scored by the
+    # replay engine) that pass — higher is better; a cell newly
+    # failing its SLO verdict shows up here as a ratio drop. Absent
+    # in pre-ISSUE-18 rounds -> per-metric skip.
+    ("scenario_pass_ratio",
+     ("serving", "scenarios", "pass_ratio"), "higher"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

@@ -3119,6 +3119,97 @@ def _load_tdnlint():
     return mod
 
 
+def cmd_replay(args) -> int:
+    """The scenario engine (``tdn replay``, docs/OBSERVABILITY.md
+    "Capture & replay" / docs/ROBUSTNESS.md "Chaos-load matrix"):
+
+    * ``tdn replay --scenario scenarios/X.json`` — run one declarative
+      scenario cell (workload x faults x fleet events) on a self-hosted
+      loopback fleet, score it with the real SLOTracker, print the
+      machine-readable verdict. Exit 0 on pass, 2 on fail.
+    * ``tdn replay --scenario-dir scenarios/`` — the whole matrix;
+      exit 2 unless every cell passes.
+    * ``tdn replay --bundle incident.zip --target host:port`` —
+      extract the WorkloadTrace from a captured incident bundle and
+      fire it at a LIVE target at ``--speed`` multiples.
+    * ``tdn replay --trace trace.json --target host:port`` — replay a
+      saved WorkloadTrace file.
+    * ``tdn replay --generate diurnal -o trace.json`` — emit a seeded
+      synthetic workload as a WorkloadTrace JSON (no target needed).
+    """
+    from tpu_dist_nn.obs import replay as R
+
+    def emit(doc) -> None:
+        text = json.dumps(doc, indent=2 if args.pretty else None)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(json.dumps({"out": args.out,
+                              "passed": doc.get("passed")}))
+        else:
+            print(text)
+
+    if args.scenario or args.scenario_dir:
+        paths = ([args.scenario] if args.scenario
+                 else R.scenario_paths(args.scenario_dir))
+        if not paths:
+            raise ValueError(f"no scenario specs in {args.scenario_dir}")
+        verdicts = []
+        for path in paths:
+            v = R.run_scenario_file(
+                path, seed=args.seed, speed=args.speed,
+                quick_scale=args.quick_scale,
+            )
+            verdicts.append(v)
+            if len(paths) > 1:
+                print(json.dumps({
+                    "scenario": v["scenario"], "passed": v["passed"],
+                    "duration_s": v["duration_s"],
+                    "requests": v["replay"]["requests"],
+                    "ok": v["replay"]["ok"],
+                }))
+        doc = (verdicts[0] if len(verdicts) == 1 else {
+            "scenarios": len(verdicts),
+            "passed": all(v["passed"] for v in verdicts),
+            "pass_ratio": round(
+                sum(v["passed"] for v in verdicts) / len(verdicts), 4
+            ),
+            "verdicts": verdicts,
+        })
+        emit(doc)
+        return 0 if doc["passed"] else 2
+
+    if args.generate:
+        gen_args = json.loads(args.generator_args or "{}")
+        wl = R.make_workload(args.generate, seed=args.seed or 0,
+                             **gen_args)
+        if args.out:
+            wl.save(args.out)
+            print(json.dumps({"out": args.out, **wl.mix()}))
+        else:
+            print(wl.to_json())
+        return 0
+
+    if args.bundle:
+        wl = R.trace_from_bundle(args.bundle)
+    elif args.trace:
+        wl = R.WorkloadTrace.load(args.trace)
+    else:
+        raise ValueError(
+            "tdn replay needs one of --scenario/--scenario-dir/"
+            "--bundle/--trace/--generate"
+        )
+    if not args.target:
+        raise ValueError("--bundle/--trace replay needs --target")
+    report = R.replay(
+        wl, args.target, speed=args.speed or 1.0,
+        dim=args.dim, prompt_len=args.prompt_len,
+        vocab_size=args.vocab_size, timeout=args.timeout,
+    )
+    emit(report)
+    return 0
+
+
 def cmd_lint(args) -> int:
     tdnlint = _load_tdnlint()
     argv = list(args.paths or ())
@@ -4114,6 +4205,66 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", dest="lint_json", action="store_true",
                    help="also print one machine-readable JSON line")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser(
+        "replay",
+        help="scenario engine: trace-driven workload capture & "
+             "replay crossed with the chaos-load matrix — run "
+             "declarative scenarios on a loopback fleet with real "
+             "SLO verdicts, or fire a captured bundle / saved trace "
+             "at a live target (docs/OBSERVABILITY.md 'Capture & "
+             "replay')")
+    p.add_argument("--scenario", default=None,
+                   help="one scenario spec JSON to run (exit 0 pass, "
+                        "2 fail)")
+    p.add_argument("--scenario-dir", default=None,
+                   help="run every *.json scenario in a directory "
+                        "(the checked-in matrix lives in scenarios/)")
+    p.add_argument("--bundle", default=None,
+                   help="incident bundle zip: extract its "
+                        "WorkloadTrace and replay it at --target")
+    p.add_argument("--trace", default=None,
+                   help="saved WorkloadTrace JSON to replay at "
+                        "--target")
+    p.add_argument("--generate", default=None,
+                   metavar="GENERATOR",
+                   help="emit a seeded synthetic WorkloadTrace "
+                        "(diurnal, flash_crowd, heavy_tail, "
+                        "shared_prefix_flood, mixed_class) instead "
+                        "of replaying")
+    p.add_argument("--generator-args", default=None,
+                   help="JSON kwargs for --generate (e.g. "
+                        "'{\"requests\": 200, \"duration\": 60}')")
+    p.add_argument("--target", default=None,
+                   help="host:port to replay against (--bundle/"
+                        "--trace mode; scenarios self-host a "
+                        "loopback fleet)")
+    p.add_argument("--speed", type=float, default=None,
+                   help="arrival-process multiplier (2 = twice as "
+                        "fast; default 1, or the scenario's own)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override the scenario/generator seed")
+    p.add_argument("--quick-scale", type=float, default=None,
+                   help="shrink scenario workloads by this factor "
+                        "(rates preserved) — the CI smoke setting")
+    p.add_argument("--dim", type=int, default=8,
+                   help="Process row width when the trace does not "
+                        "record one (default 8)")
+    p.add_argument("--prompt-len", type=int, default=8,
+                   help="target endpoint's static prompt length for "
+                        "Generate replay (default 8)")
+    p.add_argument("--vocab-size", type=int, default=64,
+                   help="token id range for synthesized prompts "
+                        "(default 64)")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="per-request client timeout seconds "
+                        "(default 30)")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the verdict/report/trace JSON here "
+                        "instead of stdout")
+    p.add_argument("--pretty", action="store_true",
+                   help="indent the JSON output")
+    p.set_defaults(fn=cmd_replay)
 
     return parser
 

@@ -68,6 +68,7 @@ from tpu_dist_nn.serving.wire import (
     SERVICE_NAME,
     SESSION_HEADER,
     STREAM_RESUME_HEADER,
+    STREAM_RESUME_MAX_TOKENS,
     decode_frame,
 )
 
@@ -116,6 +117,13 @@ ROUTER_STREAM_RESUMES = REGISTRY.counter(
     "GenerateStream failovers resumed mid-stream on another replica "
     "(already-delivered tokens replayed as forced tokens — the client "
     "sees one uninterrupted, exactly-once stream)",
+)
+ROUTER_STREAM_RESUME_OVERFLOW = REGISTRY.counter(
+    "tdn_router_stream_resume_overflow_total",
+    "GenerateStream failovers ABANDONED because the delivered-token "
+    "ledger outgrew the metadata-borne resume bound "
+    "(STREAM_RESUME_MAX_TOKENS) — surfaced as OUT_OF_RANGE instead of "
+    "an opaque gRPC metadata error mid-failover",
 )
 
 _CLIENT_DEFAULT = object()
@@ -551,6 +559,28 @@ class Router:
             if slo_class is not None:
                 metadata.append((CLASS_HEADER, slo_class))
             if delivered:
+                if len(delivered) > STREAM_RESUME_MAX_TOKENS:
+                    # The ledger no longer fits the metadata-borne
+                    # resume path (~8 KB gRPC budget; see wire.py).
+                    # A clamped suffix would replay against KV state
+                    # the fallback replica does not have, so the only
+                    # honest outcome is a CLEAR failure the client can
+                    # retry from scratch — not an opaque metadata
+                    # error. Counter + annotated span for the autopsy.
+                    ROUTER_STREAM_RESUME_OVERFLOW.inc()
+                    span.annotate(
+                        f"resume ledger {len(delivered)} tokens > "
+                        f"bound {STREAM_RESUME_MAX_TOKENS}: failover "
+                        "abandoned"
+                    )
+                    self._abort(
+                        context, "none", grpc.StatusCode.OUT_OF_RANGE,
+                        f"stream failover needs to resume "
+                        f"{len(delivered)} delivered tokens but the "
+                        f"metadata-borne resume path is bounded at "
+                        f"{STREAM_RESUME_MAX_TOKENS}; restart the "
+                        f"stream from the prompt",
+                    )
                 metadata.append(
                     (STREAM_RESUME_HEADER,
                      ",".join(str(t) for t in delivered))

@@ -51,6 +51,7 @@ from tpu_dist_nn.serving.wire import (
     SERVICE_NAME,
     SESSION_HEADER,
     STREAM_RESUME_HEADER,
+    STREAM_RESUME_MAX_TOKENS,
     WireMatrix,
     decode_frame,
     decode_matrix,
@@ -765,6 +766,10 @@ def _make_handler(engine, batcher: _Batcher | None):
                 _abort(context, "Process", grpc.StatusCode.INVALID_ARGUMENT,
                        f"bad Matrix: {e}")
             span.set("rows", len(x))
+            # Capture-completeness attrs (ISSUE 18): a bundle's root
+            # span alone must be a replayable request.
+            _annotate_capture_attrs(span, md, slo_class, budget)
+            span.set("dim", int(x.shape[1]))
             if (
                 batcher is not None
                 and expected_dim is not None
@@ -890,7 +895,8 @@ def serve_engine(engine, port: int, *, max_workers: int = 10,
     return server, bound
 
 
-def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
+def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int,
+                           max_new_tokens: int | None = None):
     """The Generate method: Matrix of token ids (N, prompt_len) ->
     Matrix (N, prompt_len + max_new_tokens). Same wire format, same
     status taxonomy as Process."""
@@ -899,6 +905,9 @@ def _make_generate_handler(run_submit, prompt_len: int, vocab_size: int):
         _RPC_REQUESTS.labels(method="Generate").inc()
         span, budget, md = _request_span(context, "Generate")
         slo_class = normalize_class(md.get(CLASS_HEADER))
+        _annotate_capture_attrs(span, md, slo_class, budget,
+                                prompt_len=prompt_len,
+                                max_new_tokens=max_new_tokens)
         try:
             try:
                 with _trace.TRACER.span("decode", span.ctx):
@@ -957,8 +966,31 @@ def _status_from_code(name: str):
         return grpc.StatusCode.INTERNAL
 
 
+def _annotate_capture_attrs(span, md, slo_class, budget, *,
+                            prompt_len=None, max_new_tokens=None,
+                            stream=False):
+    """Capture-completeness attrs (ISSUE 18): the handler root span
+    carries every request attribute :mod:`tpu_dist_nn.obs.replay`
+    needs, so an incident bundle's trace.json alone is a replayable
+    workload. Attrs ride ``Span.set`` -> chrome ``args`` and survive
+    ``stitch_chrome_traces`` (which passes args through verbatim)."""
+    span.set("slo_class", slo_class)
+    sess = md.get(SESSION_HEADER)
+    if sess:
+        span.set("session", sess)
+    if prompt_len is not None:
+        span.set("prompt_len", int(prompt_len))
+    if max_new_tokens is not None:
+        span.set("max_new_tokens", int(max_new_tokens))
+    if budget is not None:
+        span.set("budget_ms", int(budget * 1000))
+    if stream:
+        span.set("stream", True)
+
+
 def _make_generate_stream_handler(run_submit_stream, prompt_len: int,
-                                  vocab_size: int):
+                                  vocab_size: int,
+                                  max_new_tokens: int | None = None):
     """The GenerateStream method (PR 16): ONE prompt row in, a stream
     of wire frames out — TOKENS deltas as the continuous scheduler
     publishes them (serving/stream.py), then exactly one END frame
@@ -974,6 +1006,10 @@ def _make_generate_stream_handler(run_submit_stream, prompt_len: int,
         _RPC_REQUESTS.labels(method="GenerateStream").inc()
         span, budget, md = _request_span(context, "GenerateStream")
         slo_class = normalize_class(md.get(CLASS_HEADER))
+        _annotate_capture_attrs(span, md, slo_class, budget,
+                                prompt_len=prompt_len,
+                                max_new_tokens=max_new_tokens,
+                                stream=True)
         stream = None
         try:
             try:
@@ -1018,6 +1054,20 @@ def _make_generate_stream_handler(run_submit_stream, prompt_len: int,
                         grpc.StatusCode.INVALID_ARGUMENT,
                         f"bad {STREAM_RESUME_HEADER}: expected "
                         "comma-separated token ids",
+                    )
+                if len(resume) > STREAM_RESUME_MAX_TOKENS:
+                    # Bit-exact resume needs EVERY delivered token; a
+                    # clamped suffix would replay against KV state this
+                    # replica does not have. Fail loudly (the router
+                    # refuses to even attempt it — this is the backstop
+                    # for hand-rolled clients).
+                    span.annotate("abort OUT_OF_RANGE: resume too long")
+                    _abort(
+                        context, "GenerateStream",
+                        grpc.StatusCode.OUT_OF_RANGE,
+                        f"{STREAM_RESUME_HEADER} carries {len(resume)} "
+                        f"tokens; the metadata-borne resume path is "
+                        f"bounded at {STREAM_RESUME_MAX_TOKENS}",
                     )
             # Streams surface the trace id in INITIAL metadata (ISSUE
             # 16 satellite): trailing only lands at stream end — useless
@@ -1248,9 +1298,10 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
 
         server = _new_grpc_server(max_workers, interceptors)
         server.add_generic_rpc_handlers((
-            _make_generate_handler(run_submit, T, cfg.vocab_size),
+            _make_generate_handler(run_submit, T, cfg.vocab_size,
+                                   max_new_tokens=N),
             _make_generate_stream_handler(
-                run_submit_stream, T, cfg.vocab_size
+                run_submit_stream, T, cfg.vocab_size, max_new_tokens=N
             ),
         ))
         bound = _bind_or_close(server, host, port, sched)
@@ -1377,7 +1428,8 @@ def serve_lm_generate(params, cfg, port: int, *, max_new_tokens: int,
             np.asarray(run(np.zeros((n, T), np.int32)))
             n *= 2
     server.add_generic_rpc_handlers(
-        (_make_generate_handler(run_submit, T, cfg.vocab_size),)
+        (_make_generate_handler(run_submit, T, cfg.vocab_size,
+                                max_new_tokens=N),)
     )
     bound = _bind_or_close(server, host, port, batcher)
     server.batcher = batcher

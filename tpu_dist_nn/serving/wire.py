@@ -614,3 +614,12 @@ RETRY_AFTER_HEADER = "x-tdn-retry-after-ms"
 # ~8 KB default metadata budget, which comfortably holds any
 # max_new_tokens this engine is configured for.
 STREAM_RESUME_HEADER = "x-tdn-stream-resume"
+# Hard cap on how many delivered tokens the resume header may carry
+# (ISSUE 18). Bit-exact resume needs EVERY delivered token to reach
+# the fallback replica — a clamped suffix would replay against KV
+# state the fallback does not have — so past this bound the failover
+# fails with OUT_OF_RANGE + a counter instead of an opaque gRPC
+# metadata error. 1024 ids x ~6 chars comma-separated ~= 7 KB, safely
+# under the ~8 KB default metadata budget; moving the ledger into the
+# request body is the ROADMAP follow-on for longer streams.
+STREAM_RESUME_MAX_TOKENS = 1024

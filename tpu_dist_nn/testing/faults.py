@@ -8,9 +8,11 @@ first-class — :class:`~tpu_dist_nn.api.engine.Engine` exposes
 :class:`FaultPlan` and every "the Nth request fails UNAVAILABLE"
 scenario replays bit-for-bit.
 
-A plan is a call-counting schedule: explicit ``{n: fault}`` entries
-and/or an ``every=k`` cadence, evaluated in call order under a lock so
-concurrent callers still see one deterministic global sequence.
+A plan is a call-counting schedule: explicit ``{n: fault}`` entries,
+an ``every=k`` cadence, and/or a seeded per-call probability ``p=``
+(rate-shaped storms for the scenario engine's chaos matrix), evaluated
+in call order under a lock so concurrent callers still see one
+deterministic global sequence.
 Faults are built by the small factories below::
 
     from tpu_dist_nn.testing import faults
@@ -30,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import random
 import threading
 import time
 
@@ -104,21 +107,34 @@ class FaultPlan:
 
     ``at={n: fault}`` names exact 1-based call numbers; ``every=k``
     (with ``fault=``) additionally faults every k-th call not already
-    named. The counter is global to the plan and lock-protected, so a
-    plan shared by concurrent request threads still yields ONE
-    reproducible sequence (call order is the only nondeterminism, and
-    tests that need strict ordering drive requests serially).
+    named; ``p=0.05`` (with ``fault=``, ISSUE 18) additionally faults
+    each remaining call with probability p from a PRIVATE
+    ``random.Random(seed)`` stream — a rate-shaped storm that is still
+    bit-reproducible, because the k-th draw of a seeded stream is a
+    fixed number regardless of wall clock or thread identity. The
+    counter (and the rng draw) is global to the plan and
+    lock-protected, so a plan shared by concurrent request threads
+    still yields ONE reproducible sequence (call order is the only
+    nondeterminism, and tests that need strict ordering drive requests
+    serially).
     """
 
     def __init__(self, at: dict[int, Fault] | None = None,
-                 every: int | None = None, fault: Fault | None = None):
+                 every: int | None = None, fault: Fault | None = None,
+                 p: float | None = None, seed: int = 0):
         if every is not None and every < 1:
             raise ValueError(f"every must be >= 1, got {every}")
-        if every is not None and fault is None:
-            raise ValueError("every= needs fault= (what to inject)")
+        if p is not None and not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be in [0, 1], got {p}")
+        if (every is not None or p is not None) and fault is None:
+            raise ValueError("every=/p= need fault= (what to inject)")
         self._at = dict(at or {})
         self._every = every
+        self._p = p
         self._fault = fault
+        # Private stream, NOT the global random module: sharing the
+        # process-wide rng would let unrelated draws shift the storm.
+        self._rng = random.Random(seed) if p is not None else None
         self._count = itertools.count(1)
         self._lock = threading.Lock()
         self.calls = 0
@@ -132,6 +148,14 @@ class FaultPlan:
             f = self._at.get(n)
             if f is None and self._every is not None and n % self._every == 0:
                 f = self._fault
+            if self._rng is not None:
+                # ALWAYS draw, even when at=/every= already decided:
+                # call k must consume exactly k draws or a mixed plan's
+                # probabilistic hits would depend on its deterministic
+                # ones.
+                hit = self._rng.random() < self._p
+                if f is None and hit:
+                    f = self._fault
             if f is not None:
                 self.fired += 1
             return f
