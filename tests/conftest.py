@@ -163,6 +163,20 @@ QUICK_TESTS = {
     "test_hetero_pipeline": ["test_forward_matches_single_program"],
     "test_interleaved": ["test_schedule_tables_build_and_verify",
                          "test_interleaved_lm_grads_match_single_chip"],
+    # ISSUE 19 acceptance smokes: the bit-flip fingerprint detector,
+    # the numeric guard's row-level failover bit-parity anchor, canary
+    # golden stability across prober restarts, the full quarantine
+    # lifecycle against two real replicas (detect -> drain-refusal ->
+    # evidence -> reverify-readmit -> strikes -> break-glass), the
+    # spot-check tamper arbitration, and the end-to-end quick-scaled
+    # corruption drill.
+    "test_integrity": [
+        "test_array_checksum_and_fingerprint_detect_bitflip",
+        "test_guard_partial_rows_failover_bit_parity",
+        "test_canary_golden_stable_across_prober_restarts",
+        "test_quarantine_lifecycle_detect_drain_refusal_evidence_reverify",
+        "test_spotcheck_tamper_mismatch_arbitrates_to_guilty_replica",
+        "test_corruption_drill_scenario_quarantines_exactly_one"],
     "test_interop": ["test_torch_round_trip", "test_torch_forward_parity"],
     "test_interop_keras": ["test_keras_forward_parity",
                            "test_keras_round_trip"],

@@ -156,6 +156,13 @@ GATED_METRICS = (
     # in pre-ISSUE-18 rounds -> per-metric skip.
     ("scenario_pass_ratio",
      ("serving", "scenarios", "pass_ratio"), "higher"),
+    # Silent-corruption defense plane (ISSUE 19): armed/disarmed
+    # serving rps ratio with the numeric guard + spot-checking +
+    # canary probes all ON and nothing corrupt — detection must stay
+    # ~free (the <5% budget), higher is better. Absent in pre-ISSUE-19
+    # rounds -> per-metric skip.
+    ("integrity_armed_ratio",
+     ("serving", "integrity_overhead", "ratio"), "higher"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

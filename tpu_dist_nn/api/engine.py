@@ -452,8 +452,24 @@ class Engine:
         grpc_node.py:83-84 → INVALID_ARGUMENT) and
         :class:`~tpu_dist_nn.utils.errors.UnavailableError` after
         :meth:`down` (the reference's dead-channel UNAVAILABLE).
+
+        A direct call is ONE request, so the numeric guard's per-row
+        failover collapses to request granularity here: any corrupt
+        row raises :class:`~tpu_dist_nn.utils.errors.IntegrityError`
+        rather than shipping a partially-poisoned batch (the batcher
+        path keeps row granularity via ``PendingInference.bad_rows``).
         """
-        return self.fetch(self.infer_async(x))
+        pending = self.infer_async(x)
+        out = self.fetch(pending)
+        bad = getattr(pending, "bad_rows", None)
+        if bad is not None and bad.any():
+            from tpu_dist_nn.utils.errors import IntegrityError
+
+            raise IntegrityError(
+                f"numeric guard: {int(bad.sum())}/{len(out)} rows of "
+                f"the result are non-finite or out of magnitude bounds"
+            )
+        return out
 
     def infer_async(self, x, *, useful_rows=None) -> PendingInference:
         """Validate, stage, and LAUNCH a batch without waiting for it.
@@ -535,6 +551,25 @@ class Engine:
             if hook is not None:
                 hook(pending)  # fault injection: may raise or delay
             out = pending.materialize(pending.value)
+            # Numeric guard at the ONE host sync: the result is already
+            # materialized host-side, so the isfinite reduction is one
+            # vectorized pass over hot memory. Partial corruption is
+            # stashed as a row mask for the batcher's per-row failover
+            # (unaffected rows ship bit-identical); a fully-bad launch
+            # has no salvageable rows and raises outright.
+            from tpu_dist_nn.serving.integrity import GUARD
+
+            bad = GUARD.bad_rows(out) if GUARD.enabled else None
+            if bad is not None and bad.any():
+                pending.bad_rows = bad
+                if bad.all():
+                    from tpu_dist_nn.utils.errors import IntegrityError
+
+                    raise IntegrityError(
+                        f"numeric guard: all {len(out)} rows of the "
+                        f"launch are non-finite or out of magnitude — "
+                        f"refusing to ship the batch"
+                    )
         except Exception:
             _INFER_ERRORS.inc()
             raise
@@ -1201,6 +1236,30 @@ class Engine:
             or self._hp is not None
         )
 
+    def fingerprint(self) -> str:
+        """Whole-model weights fingerprint (integrity.fingerprint_tree
+        over every layer's host-side float64 weights/biases) — the
+        value ``/healthz`` exposes so the pool can refuse to admit a
+        replica whose loaded weights disagree with the fleet's.
+
+        Computed from ``self.model`` (the canonical host copy every
+        placement shares), so replicas of the same model file agree
+        regardless of device layout or quantization. Cached per model
+        object — training swaps ``self.model`` wholesale, which
+        naturally invalidates."""
+        cached = getattr(self, "_fingerprint_cache", None)
+        if cached is not None and cached[0] is self.model:
+            return cached[1]
+        from tpu_dist_nn.serving.integrity import fingerprint_tree
+
+        tree = {}
+        for i, layer in enumerate(self.model.layers):
+            tree[f"layer{i}/weights"] = layer.weights
+            tree[f"layer{i}/biases"] = layer.biases
+        fp = fingerprint_tree(tree)["model"]
+        self._fingerprint_cache = (self.model, fp)
+        return fp
+
     def health(self, probe: bool = True) -> dict:
         """Structured readiness report — the reference's TCP readiness
         poll (run_grpc_fcnn.py:157-172) as an inspectable status.
@@ -1217,6 +1276,12 @@ class Engine:
             "pipelined": self.pipelined,
             "setup_seconds": self.setup_seconds,
         }
+        try:
+            # getattr-shaped: hand-constructed engines (Engine.__new__
+            # in tests) may lack a model.
+            status["fingerprint"] = self.fingerprint()
+        except Exception:  # noqa: BLE001 — health must never crash
+            pass
         if ready and probe:
             try:
                 probe_x = np.zeros((1, self.model.input_dim))

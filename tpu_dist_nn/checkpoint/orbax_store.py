@@ -52,21 +52,45 @@ class OrbaxCheckpointManager:
         return self._mgr.latest_step()
 
     def save(self, step: int, state: Any, metadata: dict | None = None):
+        """Save a step. The state's integrity fingerprint (per-array
+        SHA-256 checksums + whole-model digest,
+        :func:`~tpu_dist_nn.serving.integrity.fingerprint_tree`) is
+        embedded into the checkpoint's JSON metadata under
+        ``"integrity"`` so :meth:`restore` can verify the bytes it
+        reads back are the bytes written — a bad storage read or a
+        flipped bit fails LOUDLY at load instead of serving garbage
+        (docs/ROBUSTNESS.md "Silent corruption & quarantine")."""
         import orbax.checkpoint as ocp
 
+        from tpu_dist_nn.serving.integrity import fingerprint_tree
+
+        meta = dict(metadata) if metadata else {}
+        try:
+            meta.setdefault("integrity", fingerprint_tree(state))
+        except Exception:  # noqa: BLE001 — fingerprinting is best-effort
+            # A state with exotic leaves must still checkpoint; restore
+            # simply has nothing to verify against.
+            pass
         self._mgr.save(
             int(step),
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(state),
                 **(
-                    {"metadata": ocp.args.JsonSave(metadata)}
-                    if metadata else {}
+                    {"metadata": ocp.args.JsonSave(meta)}
+                    if meta else {}
                 ),
             ),
         )
         return self.directory / str(int(step))
 
-    def restore(self, template: Any, step: int | None = None):
+    def restore(self, template: Any, step: int | None = None, *,
+                verify: bool = True):
+        """Restore a step, verifying every array's checksum against the
+        fingerprint written at save time (when one exists — older
+        checkpoints without it restore unverified). A mismatch raises
+        :class:`~tpu_dist_nn.utils.errors.IntegrityError` naming the
+        drifted arrays; ``verify=False`` opts out (forensics on a known-
+        corrupt checkpoint)."""
         import orbax.checkpoint as ocp
 
         if step is None:
@@ -81,7 +105,42 @@ class OrbaxCheckpointManager:
                 state=ocp.args.StandardRestore(template)
             ),
         )
-        return int(step), restored["state"]
+        state = restored["state"]
+        if verify:
+            expected = (self.read_metadata(int(step)) or {}).get(
+                "integrity"
+            )
+            if expected:
+                from tpu_dist_nn.serving.integrity import verify_tree
+                from tpu_dist_nn.utils.errors import IntegrityError
+
+                mismatches = verify_tree(state, expected)
+                if mismatches:
+                    raise IntegrityError(
+                        f"checkpoint step {int(step)} failed integrity "
+                        f"verification against the fingerprint written "
+                        f"at save time: " + "; ".join(mismatches[:5])
+                        + (f" (+{len(mismatches) - 5} more)"
+                           if len(mismatches) > 5 else "")
+                    )
+        return int(step), state
+
+    def read_metadata(self, step: int) -> dict | None:
+        """The checkpoint's JSON metadata item (None when the step was
+        saved without one)."""
+        import orbax.checkpoint as ocp
+
+        try:
+            restored = self._mgr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    metadata=ocp.args.JsonRestore()
+                ),
+            )
+        except Exception:  # noqa: BLE001 — no metadata item saved
+            return None
+        meta = restored.get("metadata")
+        return dict(meta) if meta else None
 
     def restore_or_none(self, template: Any):
         try:

@@ -858,13 +858,18 @@ def cmd_router(args) -> int:
     admin_action = (
         ("drain", args.drain_replica) if args.drain_replica
         else ("undrain", args.undrain_replica) if args.undrain_replica
+        else ("quarantine", args.quarantine_replica)
+        if args.quarantine_replica
+        else ("unquarantine", args.unquarantine_replica)
+        if args.unquarantine_replica
         else ("replicas", None) if args.list_replicas
         else None
     )
     if admin_action is not None:
         if not args.admin:
             raise ValueError(
-                "--drain-replica/--undrain-replica/--list-replicas need "
+                "--drain-replica/--undrain-replica/--quarantine-replica/"
+                "--unquarantine-replica/--list-replicas need "
                 "--admin HOST:METRICS_PORT (the router's metrics "
                 "endpoint, which mounts the /router/* admin routes)"
             )
@@ -874,6 +879,8 @@ def cmd_router(args) -> int:
         path = f"/router/{verb}"
         if target is not None:
             path += "?replica=" + urllib.parse.quote(target, safe="")
+        if verb == "unquarantine" and args.force:
+            path += "&force=1"
         # Drain/undrain CHANGE fleet state: POST-only on the server so
         # a GET sweep cannot actuate; the snapshot stays a GET.
         body = _endpoint_get(
@@ -998,13 +1005,46 @@ def cmd_router(args) -> int:
                 methods=(("Process", "Generate") if args.hedge_generate
                          else ("Process",)),
             )
-        server, bound = serve_router(pool, args.port, hedge=hedge)
+        # Integrity plane (docs/ROBUSTNESS.md "Silent corruption &
+        # quarantine"): canary probes ride the scrape loop, spot-checks
+        # shadow sampled Process traffic; both feed pool.quarantine.
+        canary = None
+        if args.canary_interval is not None:
+            from tpu_dist_nn.serving.integrity import CanaryProber
+
+            dim = args.canary_dim
+            if dim is None and args.config:
+                from tpu_dist_nn.core.schema import load_model
+
+                dim = load_model(args.config).input_dim
+            if dim is None:
+                raise ValueError(
+                    "--canary-interval needs the canary input width: "
+                    "pass --canary-dim D, or --config MODEL.json to "
+                    "derive it from the model"
+                )
+            canary = CanaryProber(dim=dim,
+                                  interval=args.canary_interval)
+        spotcheck = None
+        if args.spotcheck_rate:
+            from tpu_dist_nn.serving.integrity import SpotChecker
+
+            spotcheck = SpotChecker(
+                pool, rate=args.spotcheck_rate, canary=canary,
+                on_verdict=lambda target, reason, ev: pool.quarantine(
+                    target, reason=reason, evidence=ev
+                ),
+            )
+        server, bound = serve_router(pool, args.port, hedge=hedge,
+                                     canary=canary, spotcheck=spotcheck)
         drain.add_server(server)
         drain.install_signal_handler()
         print(json.dumps({
             "router_port": bound,
             "replicas": pool.targets(),
             "hedging": sorted(hedge.methods) if hedge else None,
+            "canary_interval": args.canary_interval,
+            "spotcheck_rate": args.spotcheck_rate or None,
         }), flush=True)
         sampler = None
         if metrics_server is not None:
@@ -1065,9 +1105,26 @@ def cmd_router(args) -> int:
             # Flight recorder, fleet flavor: on trigger the router
             # fans /debug/bundle out to every replica within the tick
             # and stitches the fleet trace into ONE incident.
-            _wire_incident_recorder(args, metrics_server, sampler,
-                                    ring, tracker, pool=pool,
-                                    router=True)
+            recorder = _wire_incident_recorder(args, metrics_server,
+                                               sampler, ring, tracker,
+                                               pool=pool, router=True)
+            if recorder is not None:
+                # Quarantine freezes its evidence IMMEDIATELY (not on
+                # the next detector tick): the bundle names the
+                # detector verdict — fingerprint mismatch, off-golden
+                # canary digest, spot-check disagreement — while the
+                # fleet state that produced it is still current.
+                def _quarantine_bundle(target, reason, evidence,
+                                       _rec=recorder):
+                    _rec.capture(
+                        f"quarantine_{reason}",
+                        reason=f"replica {target} quarantined "
+                               f"({reason})",
+                        details={"replica": target, "reason": reason,
+                                 "evidence": evidence},
+                    )
+
+                pool.on_quarantine = _quarantine_bundle
             sampler.start()
             _attach_metrics_sampler(metrics_server, sampler)
         try:
@@ -3129,6 +3186,12 @@ def cmd_replay(args) -> int:
       machine-readable verdict. Exit 0 on pass, 2 on fail.
     * ``tdn replay --scenario-dir scenarios/`` — the whole matrix;
       exit 2 unless every cell passes.
+    * ``tdn replay --scenario X.json --target host:port`` — remote
+      load-test mode: fire the scenario's WORKLOAD at a live fleet.
+      Fault injection, chaos events, and SLO scoring are loopback-only
+      and are disabled; the report carries the client-observed outcome
+      plus a caveat, and ``passed`` is null (score SLOs from the
+      target's own ``/metrics``).
     * ``tdn replay --bundle incident.zip --target host:port`` —
       extract the WorkloadTrace from a captured incident bundle and
       fire it at a LIVE target at ``--speed`` multiples.
@@ -3156,10 +3219,17 @@ def cmd_replay(args) -> int:
             raise ValueError(f"no scenario specs in {args.scenario_dir}")
         verdicts = []
         for path in paths:
-            v = R.run_scenario_file(
-                path, seed=args.seed, speed=args.speed,
-                quick_scale=args.quick_scale,
-            )
+            if args.target:
+                v = R.run_scenario_remote(
+                    R.load_scenario(path), args.target,
+                    seed=args.seed, speed=args.speed,
+                    quick_scale=args.quick_scale,
+                )
+            else:
+                v = R.run_scenario_file(
+                    path, seed=args.seed, speed=args.speed,
+                    quick_scale=args.quick_scale,
+                )
             verdicts.append(v)
             if len(paths) > 1:
                 print(json.dumps({
@@ -3168,16 +3238,23 @@ def cmd_replay(args) -> int:
                     "requests": v["replay"]["requests"],
                     "ok": v["replay"]["ok"],
                 }))
-        doc = (verdicts[0] if len(verdicts) == 1 else {
-            "scenarios": len(verdicts),
-            "passed": all(v["passed"] for v in verdicts),
-            "pass_ratio": round(
-                sum(v["passed"] for v in verdicts) / len(verdicts), 4
-            ),
-            "verdicts": verdicts,
-        })
+        if len(verdicts) == 1:
+            doc = verdicts[0]
+        elif args.target:
+            # Remote load-test runs carry no verdict to aggregate.
+            doc = {"scenarios": len(verdicts), "mode": "remote",
+                   "passed": None, "verdicts": verdicts}
+        else:
+            doc = {
+                "scenarios": len(verdicts),
+                "passed": all(v["passed"] for v in verdicts),
+                "pass_ratio": round(
+                    sum(v["passed"] for v in verdicts) / len(verdicts), 4
+                ),
+                "verdicts": verdicts,
+            }
         emit(doc)
-        return 0 if doc["passed"] else 2
+        return 0 if doc["passed"] in (True, None) else 2
 
     if args.generate:
         gen_args = json.loads(args.generator_args or "{}")
@@ -3614,6 +3691,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--undrain-replica", metavar="TARGET",
                    help="with --admin: re-admit a drained replica "
                         "(fresh circuit breaker on the reused address)")
+    p.add_argument("--quarantine-replica", metavar="TARGET",
+                   help="with --admin: pull TARGET out of placement as "
+                        "integrity-suspect (reason 'operator'; "
+                        "docs/ROBUSTNESS.md 'Silent corruption & "
+                        "quarantine')")
+    p.add_argument("--unquarantine-replica", metavar="TARGET",
+                   help="with --admin: re-admit a quarantined replica "
+                        "— only passes after the fleet-fingerprint and "
+                        "canary reverify succeed (see --force)")
+    p.add_argument("--force", action="store_true",
+                   help="with --unquarantine-replica: skip the "
+                        "fingerprint + canary reverify (operator "
+                        "override)")
+    p.add_argument("--canary-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="arm canary probing: every SECONDS per replica "
+                        "the scrape loop sends a fixed seeded input "
+                        "and exact-matches the reply against the "
+                        "fleet's golden answer; an off-golden replica "
+                        "is quarantined (needs --canary-dim or "
+                        "--config for the input width)")
+    p.add_argument("--canary-dim", type=int, default=None, metavar="D",
+                   help="the canary Process input width (defaults to "
+                        "the --config model's input dim)")
+    p.add_argument("--spotcheck-rate", type=float, default=None,
+                   metavar="F",
+                   help="arm shadow spot-checks: duplicate this "
+                        "fraction of Process traffic (e.g. 0.02) to a "
+                        "second replica off the request path and "
+                        "compare reply bytes; disagreement is "
+                        "arbitrated by canary-probing both replicas")
     p.add_argument("--list-replicas", action="store_true",
                    help="with --admin: print the fleet snapshot JSON")
     p.add_argument("--timeout", type=float, default=5.0,
@@ -4237,8 +4345,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "'{\"requests\": 200, \"duration\": 60}')")
     p.add_argument("--target", default=None,
                    help="host:port to replay against (--bundle/"
-                        "--trace mode; scenarios self-host a "
-                        "loopback fleet)")
+                        "--trace mode). With --scenario/"
+                        "--scenario-dir: remote load-test mode — "
+                        "fire the scenario's workload at the live "
+                        "fleet with fault injection, chaos events, "
+                        "and SLO scoring disabled (they are "
+                        "loopback-only); the report carries a "
+                        "caveat and no pass/fail verdict")
     p.add_argument("--speed", type=float, default=None,
                    help="arrival-process multiplier (2 = twice as "
                         "fast; default 1, or the scenario's own)")

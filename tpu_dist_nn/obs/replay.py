@@ -718,14 +718,24 @@ def _replay_report(trace, target, speed, wall, results) -> dict:
 
 def _fault_from_spec(d: dict):
     """{"kind": "unavailable"|...,"p"/"every"/"at","seed","seconds",
-    "hold"} -> (FaultPlan, hook) where hook is "interceptor"|"launch"."""
+    "hold"} -> (FaultPlan, hook) where hook is "interceptor"|"launch"
+    |"nan_launch"|"tamper". An optional "replica" key scopes the fault
+    to ONE replica index (corruption cells model a single bad machine,
+    not a fleet-wide defect) — honoured by ``LoopbackFleet``."""
     from tpu_dist_nn.testing import faults as F
 
     kind = d.get("kind", "unavailable")
+    hook = d.get("hook", "interceptor")
     if kind == "delay":
         fault = F.delay(float(d.get("seconds", 0.05)))
     elif kind == "drop":
         fault = F.drop(float(d.get("hold", 0.2)))
+    elif kind in ("nan_launch", "reply_tamper"):
+        # Silent-corruption kinds: the fault is a schedulable marker —
+        # nothing raises; the hook poisons data instead
+        # (docs/ROBUSTNESS.md "Silent corruption & quarantine").
+        fault = F.tamper(kind)
+        hook = "nan_launch" if kind == "nan_launch" else "tamper"
     else:
         factory = {"unavailable": F.unavailable,
                    "deadline_exceeded": F.deadline_exceeded,
@@ -738,7 +748,7 @@ def _fault_from_spec(d: dict):
     plan = F.FaultPlan(at=at, every=d.get("every"),
                       fault=fault, p=d.get("p"),
                       seed=int(d.get("seed", 0)))
-    return plan, d.get("hook", "interceptor")
+    return plan, hook
 
 
 class _FakeModel:
@@ -757,11 +767,31 @@ class _FakeEngine:
         self.fetch_hook = None
 
     def infer(self, x):
+        # Materialize to an OWNED buffer first: the handler passes a
+        # lazy WireMatrix, and the corruption hooks mutate their input
+        # in place — poisoning a temporary would be a silent no-op.
+        x = np.array(x, dtype=np.float64)
         if self.launch_hook is not None:
             self.launch_hook(x)
         if self.per_row_s:
             time.sleep(self.per_row_s * len(x))
-        return np.asarray(x, dtype=np.float64) * 2.0
+        out = x * 2.0
+        # Same numeric-guard contract as the real Engine's fetch
+        # boundary: a poisoned launch (faults.nan_launch) must fail
+        # DATA_LOSS at the wire, never ship NaN — the scenario cells
+        # exercise the router's guard -> strike -> quarantine ladder
+        # through exactly the production detection path.
+        from tpu_dist_nn.serving import integrity
+
+        bad = integrity.GUARD.bad_rows(out)
+        if bad is not None and bad.any():
+            from tpu_dist_nn.utils.errors import IntegrityError
+
+            raise IntegrityError(
+                f"numeric guard: {int(bad.sum())}/{len(bad)} rows of "
+                f"the launch are non-finite or out of magnitude bounds"
+            )
+        return out
 
 
 class LoopbackFleet:
@@ -781,7 +811,9 @@ class LoopbackFleet:
                  vocab_size: int = 64, per_row_ms: float = 1.0,
                  per_token_ms: float = 1.0, prefill_ms: float = 2.0,
                  faults=(), hedge: bool = False, seed: int = 0,
-                 forward_timeout: float | None = 30.0):
+                 forward_timeout: float | None = 30.0,
+                 canary: dict | None = None,
+                 spotcheck: dict | None = None):
         self.n = int(replicas)
         self.dim = int(dim)
         self.prompt_len = int(prompt_len)
@@ -794,6 +826,10 @@ class LoopbackFleet:
         self.hedge = bool(hedge)
         self.seed = int(seed)
         self.forward_timeout = forward_timeout
+        self.canary_spec = dict(canary) if canary else None
+        self.spotcheck_spec = dict(spotcheck) if spotcheck else None
+        self.canary = None
+        self.spotcheck = None
         self.servers: list = []
         self.engines: list[_FakeEngine] = []
         self.targets: list[str] = []
@@ -857,10 +893,22 @@ class LoopbackFleet:
 
         interceptors = []
         for spec in self.fault_specs:
+            if "replica" in spec and int(spec["replica"]) != index:
+                continue
             plan, hook = _fault_from_spec(spec)
             self.fault_plans.append(plan)
             if hook == "launch":
                 eng.launch_hook = plan.fire
+            elif hook == "nan_launch":
+                from tpu_dist_nn.testing.faults import nan_launch
+                eng.launch_hook = nan_launch(
+                    rows=tuple(spec.get("rows", (0,))), plan=plan
+                )
+            elif hook == "tamper":
+                from tpu_dist_nn.testing.faults import (
+                    make_tamper_interceptor,
+                )
+                interceptors.append(make_tamper_interceptor(plan))
             else:
                 from tpu_dist_nn.testing.faults import make_interceptor
                 interceptors.append(make_interceptor(plan))
@@ -891,9 +939,34 @@ class LoopbackFleet:
             self.targets.append(tgt)
         self.pool = ReplicaPool(self.targets, seed=self.seed)
         hedge = HedgePolicy() if self.hedge else None
+        if self.canary_spec is not None or self.spotcheck_spec is not None:
+            from tpu_dist_nn.serving.integrity import CanaryProber
+
+            c = self.canary_spec or {}
+            self.canary = CanaryProber(
+                dim=self.dim, prompt_len=self.prompt_len,
+                vocab_size=self.vocab_size,
+                interval=float(c.get("interval", 1.0)),
+                timeout=float(c.get("timeout", 5.0)),
+                seed=int(c.get("seed", 0x7DD)),
+            )
+        if self.spotcheck_spec is not None:
+            from tpu_dist_nn.serving.integrity import SpotChecker
+
+            s = self.spotcheck_spec
+            self.spotcheck = SpotChecker(
+                self.pool, rate=float(s.get("rate", 0.25)),
+                seed=int(s.get("seed", self.seed)),
+                timeout=float(s.get("timeout", 5.0)),
+                canary=self.canary,
+                on_verdict=lambda tgt, reason, ev: self.pool.quarantine(
+                    tgt, reason=reason, evidence=ev
+                ),
+            )
         self.router_server, port = serve_router(
             self.pool, 0, host="127.0.0.1",
             forward_timeout=self.forward_timeout, hedge=hedge,
+            canary=self.canary, spotcheck=self.spotcheck,
         )
         self.target = f"127.0.0.1:{port}"
         return self
@@ -1055,6 +1128,8 @@ def run_scenario(spec: dict, *, seed: int | None = None,
         faults=fleet_spec.get("faults", ()),
         hedge=bool(fleet_spec.get("hedge", False)),
         seed=seed,
+        canary=fleet_spec.get("canary"),
+        spotcheck=fleet_spec.get("spotcheck"),
     )
     ring = TimeSeriesRing(resolution=0.5, retention=600.0)
     objectives = [_objective_from_spec(o)
@@ -1114,6 +1189,11 @@ def run_scenario(spec: dict, *, seed: int | None = None,
         ticker.join(timeout=2.0)
         ring.collect(now=time.time())
         slo_doc = tracker.evaluate(now=time.time())
+        quarantined = [
+            {"target": s["target"], "reason": s.get("quarantine_reason"),
+             "strikes": s.get("integrity_strikes", 0)}
+            for s in fleet.pool.snapshot() if s["state"] == "quarantined"
+        ]
     finally:
         for t in timers:
             t.cancel()
@@ -1132,6 +1212,25 @@ def run_scenario(spec: dict, *, seed: int | None = None,
     if fidelity is not None:
         passed = passed and fidelity["passed"]
         verdict["fidelity"] = fidelity
+    integ_spec = spec.get("integrity")
+    if integ_spec:
+        # The corruption cell's teeth: the quarantine choreography must
+        # have indicted the right number of replicas — catching the
+        # corruption is the objective, not merely surviving it.
+        lo = int(integ_spec.get("min_quarantines", 0))
+        hi = integ_spec.get("max_quarantines")
+        integ_ok = len(quarantined) >= lo and (
+            hi is None or len(quarantined) <= int(hi)
+        )
+        verdict["integrity"] = {
+            "quarantined": quarantined,
+            "min_quarantines": lo,
+            "max_quarantines": hi,
+            "passed": integ_ok,
+        }
+        passed = passed and integ_ok
+    elif quarantined:
+        verdict["integrity"] = {"quarantined": quarantined}
     verdict.update({
         "passed": passed,
         "duration_s": round(time.monotonic() - t_begin, 3),
@@ -1202,6 +1301,63 @@ def run_scenario_file(path: str, *, seed: int | None = None,
                       quick_scale: float | None = None) -> dict:
     return run_scenario(load_scenario(path), seed=seed, speed=speed,
                         quick_scale=quick_scale)
+
+
+def run_scenario_remote(spec: dict, target: str, *,
+                        seed: int | None = None,
+                        speed: float | None = None,
+                        quick_scale: float | None = None) -> dict:
+    """Fire a scenario's WORKLOAD at a live remote fleet — a load-test
+    mode, not a scored verdict.
+
+    Everything that makes a scenario a controlled experiment is
+    loopback-only and is deliberately NOT applied here: no fault
+    injection, no chaos timeline (killing someone's production replica
+    from a load driver is not a feature), and no SLO scoring — the
+    remote fleet's metrics live in ITS process, so burn rates must be
+    read from the target's own ``/metrics``, not synthesized
+    client-side. ``passed`` is ``None`` and the report says so in
+    ``caveat``; what remains is the replay report — client-observed
+    outcomes, latency/TTFT percentiles, and arrival fidelity.
+
+    Capture-mode workloads (``workload.capture``) need the loopback
+    fleet's shared tracer and are rejected."""
+    seed = int(spec.get("seed", 0) if seed is None else seed)
+    speed = float(spec.get("speed", 1.0) if speed is None else speed)
+    wl = _build_workload(spec, seed, quick_scale)
+    if wl is None:
+        raise ValueError(
+            f"scenario {spec['name']}: capture-mode workloads need the "
+            f"loopback fleet; remote --target replay supports "
+            f"generator|trace workloads"
+        )
+    fleet_spec = dict(spec.get("fleet", {}))
+    disabled = sorted(
+        k for k in ("chaos", "fleet", "slo", "integrity") if spec.get(k)
+    )
+    report = replay(
+        wl, target, speed=speed,
+        dim=int(fleet_spec.get("dim", 8)),
+        prompt_len=int(fleet_spec.get("prompt_len", 8)),
+        vocab_size=int(fleet_spec.get("vocab_size", 64)),
+        timeout=float(spec.get("timeout_s", 15.0)),
+    )
+    return {
+        "scenario": spec["name"], "seed": seed, "speed": round(speed, 3),
+        "mode": "remote",
+        "target": target,
+        "caveat": (
+            "remote load-test: fault injection, chaos events, and SLO "
+            "scoring are loopback-only and were NOT applied; this "
+            "report is the client-observed outcome only — score SLOs "
+            "from the target fleet's own /metrics"
+        ),
+        "disabled": disabled,
+        "passed": None,
+        "duration_s": report["wall_s"],
+        "workload": wl.mix(),
+        "replay": report,
+    }
 
 
 def scenario_paths(directory: str) -> list[str]:

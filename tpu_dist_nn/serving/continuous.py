@@ -96,6 +96,7 @@ from tpu_dist_nn.obs import trace as _trace
 from tpu_dist_nn.obs.goodput import GOODPUT, LMFlopModel
 from tpu_dist_nn.obs.log import get_logger
 from tpu_dist_nn.obs.registry import POW2_BUCKETS, REGISTRY
+from tpu_dist_nn.serving import integrity as _integrity
 from tpu_dist_nn.serving.sched_core import (
     CLASS_RANK,
     SchedCore,
@@ -363,7 +364,14 @@ class ContinuousScheduler:
                 raise ValueError(
                     "prefill_fn and step_fn must be injected together"
                 )
-            self._prefill, self._step = prefill_fn, step_fn
+            # The public step_fn seam keeps its (toks, cache) contract;
+            # normalize to the internal 3-tuple with ok=None — injected
+            # kernels carry no logits for the in-launch numeric guard.
+            def _step_no_guard(*a, _fn=step_fn):
+                toks, cache = _fn(*a)
+                return toks, None, cache
+
+            self._prefill, self._step = prefill_fn, _step_no_guard
             # Fake caches have no block storage; the default injected
             # copy is the identity (pool bookkeeping still exercises).
             self._copy = (
@@ -523,7 +531,13 @@ class ContinuousScheduler:
                 logits, cache = decode_step_slots(
                     params, cache, pos, tok, cfg, active=active
                 )
-            return sample(logits, key), cache
+            # Numeric guard folded into the SAME launch: one fused
+            # isfinite reduction over the logits per slot (an (S,) bool
+            # riding the step's existing device->host sync — always
+            # computed so the compiled kernel never depends on the
+            # runtime GUARD toggle; acting on it is a host decision).
+            ok = jnp.isfinite(logits).all(axis=-1)
+            return sample(logits, key), ok, cache
 
         self._step = step
 
@@ -580,7 +594,7 @@ class ContinuousScheduler:
             # kernel without touching live state.
             cache = self._copy(cache, np.int32(0), np.int32(0))
             warmed.append("copy_cache_slot")
-        toks, cache = self._step(
+        toks, _ok, cache = self._step(
             self._params, cache,
             np.zeros(self._S, np.int32), np.zeros(self._S, bool),
             np.zeros(self._S, np.int32), key,
@@ -1199,7 +1213,7 @@ class ContinuousScheduler:
                 fail(e, kernel=False)
                 return
         try:
-            toks, cache = self._step(
+            toks, ok, cache = self._step(
                 self._params, self._cache, self._pos, self._active,
                 self._tok, self._next_key(),
             )
@@ -1215,6 +1229,7 @@ class ContinuousScheduler:
                 return
         try:
             toks = np.asarray(toks)
+            ok = np.asarray(ok) if ok is not None else None
         except Exception as e:  # noqa: BLE001 — fan out to occupants
             # Async backends surface a failed launch at this first host
             # sync: the rebound cache is the poisoned donated output,
@@ -1222,6 +1237,32 @@ class ContinuousScheduler:
             # pre-sync hook fault above which leaves the cache intact.
             fail(e, kernel=True)
             return
+        # Act on the in-kernel numeric guard (host decision — the
+        # runtime opt-out never reshapes the compiled kernel): a slot
+        # whose logits went non-finite fails over ALONE with INTEGRITY
+        # before its garbage token ships; every other slot's stream is
+        # untouched (bit-parity preserved).
+        bad_slots: list[int] = []
+        if ok is not None and _integrity.GUARD.enabled:
+            bad_slots = [
+                s for s in range(self._S)
+                if self._active[s] and not ok[s]
+            ]
+        if bad_slots:
+            _integrity.GUARD_ROWS_FAILED.inc(len(bad_slots))
+            _integrity.GUARD_LAUNCHES.inc()
+            from tpu_dist_nn.utils.errors import IntegrityError
+
+            for s in bad_slots:
+                slog.warning(
+                    "gen.integrity_guard_tripped", slot=s,
+                    tokens_generated=len(self._occupant[s]["tokens"]),
+                )
+                self._free_slot_on_error(s, IntegrityError(
+                    f"numeric guard: decode step produced non-finite "
+                    f"logits for slot {s} — failing this row instead "
+                    f"of shipping a garbage token"
+                ))
         self.batches_total += 1
         active = int(self._active.sum())
         self.slot_steps_total += active

@@ -82,7 +82,30 @@ REPLICA_HEALTHY = REGISTRY.gauge(
     labels=("replica",),
 )
 
+# 1 while a replica sits in integrity quarantine (docs/ROBUSTNESS.md
+# "Silent corruption & quarantine") — distinct from healthy=0, which a
+# drain also produces: quarantined means "answered WRONG", not "away".
+REPLICA_QUARANTINED = REGISTRY.gauge(
+    "tdn_router_replica_quarantined",
+    "1 while the replica is quarantined by the integrity plane "
+    "(canary/spot-check/guard/fingerprint verdict)",
+    labels=("replica",),
+)
+
+QUARANTINES = REGISTRY.counter(
+    "tdn_quarantines_total",
+    "replicas moved to QUARANTINED by the integrity plane, by detector",
+    labels=("reason",),
+)
+
 ACTIVE, DRAINING, REMOVED = "active", "draining", "removed"
+# Integrity quarantine: the replica answered WRONG (canary mismatch,
+# spot-check arbitration, repeated INTEGRITY errors, or a weights
+# fingerprint disagreeing with the fleet). Not placeable, and —
+# unlike DRAINING — never auto-rejoined by a mere ready scrape, and
+# unlike an open breaker never half-open-probed back in: re-admission
+# requires the fingerprint AND canary checks to pass (unquarantine).
+QUARANTINED = "quarantined"
 
 
 def _sum_series(parsed: dict, family: str) -> float | None:
@@ -149,6 +172,20 @@ class Replica:
         # restarted — even when the whole restart fell between two
         # scrape ticks and neither timing detector could see it.
         self.boot_id: str | None = None
+        # Integrity plane (serving/integrity.py). fingerprint is the
+        # whole-model weights fingerprint /healthz last reported;
+        # quarantine_boot_id records which process incarnation was
+        # indicted, so only a RESPAWNED replica (different boot_id) is
+        # eligible for automatic reverify-readmission.
+        self.fingerprint: str | None = None
+        self.canary_at: float = 0.0
+        self.quarantine_reason: str | None = None
+        self.quarantine_evidence: dict | None = None
+        self.quarantine_boot_id: str | None = None
+        self.quarantined_at: float | None = None
+        # Cumulative INTEGRITY (DATA_LOSS) errors the router observed
+        # from this replica — the numeric-guard verdict counter.
+        self.integrity_strikes = 0
         # Pool-spawned local replica bookkeeping (tdn router --spawn).
         self.proc: subprocess.Popen | None = None
         self.spawn_argv: list[str] | None = None
@@ -276,7 +313,7 @@ class Replica:
         return score / self.capacity_weight
 
     def snapshot(self) -> dict:
-        return {
+        snap = {
             "target": self.target,
             "metrics_target": self.metrics_target,
             "state": self.state,
@@ -289,6 +326,14 @@ class Replica:
             "weight": self.capacity_weight,
             "decommissioning": self.decommissioning,
         }
+        if self.fingerprint is not None:
+            snap["fingerprint"] = self.fingerprint
+        if self.state == QUARANTINED:
+            snap["quarantine_reason"] = self.quarantine_reason
+            snap["quarantined_at"] = self.quarantined_at
+        if self.integrity_strikes:
+            snap["integrity_strikes"] = self.integrity_strikes
+        return snap
 
 
 class ReplicaPool:
@@ -338,9 +383,24 @@ class ReplicaPool:
         # choreography itself is an incident trigger without the
         # detector having to diff per-replica states.
         self.transitions_total = 0  # guarded-by: _lock
-        # Lazy: created at the first multi-replica scrape, shut down in
-        # close(). Persistent so a sub-second scrape interval is not a
-        # per-tick thread create/teardown churn.
+        # Integrity plane (serving/integrity.py). canary: a
+        # CanaryProber ridden on the scrape loop (None = probing off).
+        # on_quarantine(target, reason, evidence): the incident hook —
+        # serve_router wires it to the flight recorder so every verdict
+        # freezes a bundle naming the evidence. fleet_fingerprint: the
+        # golden whole-model weights fingerprint, established from the
+        # first ACTIVE ready replica that reports one; any replica
+        # reporting a DIFFERENT fingerprint is refused admission
+        # (quarantined) while fingerprint_gate is on.
+        self.canary = None
+        self.on_quarantine = None
+        self.fleet_fingerprint: str | None = None  # guarded-by: _lock
+        self.fingerprint_gate = True
+        # INTEGRITY (DATA_LOSS) replies from one replica before the
+        # router's guard verdict quarantines it. 3, not 1: one launch
+        # can fail rows for a transiently absurd input; a replica that
+        # keeps producing non-finite activations is corrupt.
+        self.guard_quarantine_threshold = 3
         self._scrape_pool: concurrent.futures.ThreadPoolExecutor | None \
             = None
         metrics_targets = list(metrics_targets or ())
@@ -408,6 +468,12 @@ class ReplicaPool:
             rep = self._replicas.get(target)
             if rep is None or rep.state == REMOVED:
                 return False
+            if rep.state == QUARANTINED:
+                # Quarantine dominates: a drain would re-route the
+                # replica onto the ready-scrape auto-rejoin path,
+                # bypassing the fingerprint + canary reverify that
+                # quarantine exists to enforce.
+                return False
             if rep.state != DRAINING:
                 self.transitions_total += 1
             rep.state = DRAINING
@@ -447,6 +513,143 @@ class ReplicaPool:
             REPLICA_HEALTHY.labels(replica=target).set(1.0)
         slog.info("router.replica_undrained", replica=target)
         return True
+
+    # ------------------------------------------------------ quarantine
+
+    def quarantine(self, target: str, *, reason: str,
+                   evidence: dict | None = None) -> bool:
+        """Move a replica to QUARANTINED on an integrity verdict: stop
+        placement, sever its channel so in-flight forwards fail over
+        NOW (its in-flight answers are as suspect as its future ones),
+        unpin its sessions, fire the incident hook with the evidence,
+        and — for a pool-spawned child — SIGTERM it so the supervisor
+        respawns a fresh process for reverify-readmission.
+
+        Deliberately NOT the drain path: a drained replica auto-rejoins
+        on the next ready scrape, and a breaker-opened one half-open
+        probes back in. A wrong replica answers ready and serves probes
+        perfectly — it re-enters only through :meth:`unquarantine`'s
+        fingerprint + canary checks. Returns False for unknown/removed
+        targets and no-ops (False) when already quarantined."""
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is None or rep.state in (REMOVED, QUARANTINED):
+                return False
+            rep.state = QUARANTINED
+            self.transitions_total += 1
+            rep.quarantine_reason = reason
+            rep.quarantine_evidence = dict(evidence or {})
+            rep.quarantine_boot_id = rep.boot_id
+            rep.quarantined_at = time.monotonic()
+            REPLICA_HEALTHY.labels(replica=target).set(0.0)
+            REPLICA_QUARANTINED.labels(replica=target).set(1.0)
+            QUARANTINES.labels(reason=reason).inc()
+            # Unpin every session here: their next request re-places
+            # (affinity to a corrupt replica is affinity to wrong
+            # answers, and its KV state cannot be trusted either).
+            for k in [k for k, v in self._sessions.items() if v == target]:
+                del self._sessions[k]
+        # Outside the lock: sever the channel so the router's in-flight
+        # forwards fail immediately and ride the normal failover loop
+        # to a healthy replica (clean in-flight failover, no waiting
+        # for suspect answers to finish).
+        rep.close_channel()
+        hook = self.on_quarantine
+        if hook is not None:
+            try:
+                hook(target, reason, dict(evidence or {}))
+            except Exception:  # noqa: BLE001 — evidence capture is best-effort
+                log.exception("on_quarantine hook failed for %s", target)
+        if rep.proc is not None and rep.proc.poll() is None:
+            # Respawn-with-reverify for spawned replicas: the exit
+            # routes through _maybe_respawn (which preserves the
+            # QUARANTINED state), and the fresh process re-admits only
+            # via unquarantine's checks.
+            rep.proc.terminate()
+        slog.warning("router.replica_quarantined", replica=target,
+                     reason=reason,
+                     spawned=rep.proc is not None)
+        return True
+
+    def unquarantine(self, target: str, *, force: bool = False) -> dict:
+        """Re-admission with reverify: the replica re-enters rotation
+        only if its /healthz weights fingerprint agrees with the
+        fleet's AND a fresh canary probe answers on-golden (each check
+        skipped when unconfigured; ``force=True`` skips both — the
+        operator's break-glass). Returns a structured result with the
+        individual check outcomes; ``{"ok": True}`` means re-admitted."""
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is None or rep.state != QUARANTINED:
+                return {"ok": False, "error": "not quarantined",
+                        "target": target}
+            golden = self.fleet_fingerprint
+        checks: dict = {}
+        if not force:
+            if golden is not None and rep.fingerprint is not None \
+                    and rep.fingerprint != golden:
+                checks["fingerprint"] = {
+                    "ok": False, "fingerprint": rep.fingerprint,
+                    "fleet": golden,
+                }
+                return {"ok": False, "target": target, "checks": checks}
+            if golden is not None and rep.fingerprint is not None:
+                checks["fingerprint"] = {"ok": True}
+            if self.canary is not None:
+                verdict, ev = self.canary.probe(rep)
+                checks["canary"] = {"ok": bool(verdict), **(
+                    {} if verdict else {"evidence": ev}
+                )}
+                if not verdict:
+                    # None (unreachable) also refuses: re-admitting a
+                    # replica the prober cannot even reach proves
+                    # nothing about its answers.
+                    return {"ok": False, "target": target,
+                            "checks": checks}
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is None or rep.state != QUARANTINED:
+                return {"ok": False, "error": "not quarantined",
+                        "target": target}
+            rep.state = ACTIVE
+            self.transitions_total += 1
+            rep.quarantine_reason = None
+            rep.quarantine_evidence = None
+            rep.quarantine_boot_id = None
+            rep.quarantined_at = None
+            rep.integrity_strikes = 0
+            rep.reported_draining = False
+            rep.drain_observed = False
+            # The quarantined incumbent's failure history must not
+            # greet the re-verified (usually respawned) server.
+            CircuitBreaker.evict(target)
+            rep.breaker = CircuitBreaker.for_target(target)
+            rep.scraped_at = None
+            REPLICA_HEALTHY.labels(replica=target).set(1.0)
+            REPLICA_QUARANTINED.labels(replica=target).set(0.0)
+        slog.info("router.replica_unquarantined", replica=target,
+                  forced=force, checks=list(checks) or None)
+        return {"ok": True, "target": target, "checks": checks,
+                "forced": force}
+
+    def note_integrity_error(self, target: str) -> None:
+        """Record one INTEGRITY (DATA_LOSS) reply the router observed
+        from a replica — the numeric-guard verdict path. At
+        ``guard_quarantine_threshold`` strikes the replica is
+        quarantined (a healthy replica's guard essentially never
+        fires; repeated firing means corrupt weights or a bad core)."""
+        with self._lock:
+            rep = self._replicas.get(target)
+            if rep is None or rep.state != ACTIVE:
+                return
+            rep.integrity_strikes += 1
+            strikes = rep.integrity_strikes
+        if strikes >= self.guard_quarantine_threshold:
+            self.quarantine(
+                target, reason="guard",
+                evidence={"integrity_errors": strikes,
+                          "threshold": self.guard_quarantine_threshold},
+            )
 
     def decommission(self, target: str) -> bool:
         """Begin a SCALE-DOWN drain (serving/autoscale.py): like
@@ -625,6 +828,7 @@ class ReplicaPool:
         draining = None
         ready = None
         boot_id = None
+        fingerprint = None
         reachable = False
         try:
             req = urllib.request.urlopen(
@@ -642,6 +846,7 @@ class ReplicaPool:
                 ready = bool(health.get("ready"))
                 draining = bool(health.get("draining"))
                 boot_id = health.get("boot_id")
+                fingerprint = health.get("fingerprint")
             except (ValueError, AttributeError):
                 # 200 with a garbled or non-dict body (proxy error
                 # page, misconfigured port): something answered, so
@@ -656,6 +861,7 @@ class ReplicaPool:
                 ready = bool(health.get("ready"))
                 draining = bool(health.get("draining"))
                 boot_id = health.get("boot_id")
+                fingerprint = health.get("fingerprint")
             except (ValueError, AttributeError, OSError):
                 pass
         except (urllib.error.URLError, OSError):
@@ -711,6 +917,58 @@ class ReplicaPool:
                 REPLICA_HEALTHY.labels(replica=rep.target).set(0.0)
                 slog.info("router.replica_draining", replica=rep.target,
                           source="healthz")
+            fingerprint_mismatch = None
+            if fingerprint is not None:
+                rep.fingerprint = str(fingerprint)
+                if self.fingerprint_gate:
+                    if self.fleet_fingerprint is None and ready \
+                            and rep.state == ACTIVE:
+                        # First ACTIVE ready replica to report one
+                        # establishes the fleet golden fingerprint.
+                        self.fleet_fingerprint = rep.fingerprint
+                        slog.info("integrity.fleet_fingerprint",
+                                  source=rep.target,
+                                  fingerprint=rep.fingerprint[:12])
+                    elif (self.fleet_fingerprint is not None
+                          and rep.fingerprint != self.fleet_fingerprint
+                          and rep.state == ACTIVE):
+                        fingerprint_mismatch = {
+                            "fingerprint": rep.fingerprint,
+                            "fleet_fingerprint": self.fleet_fingerprint,
+                        }
+        if fingerprint_mismatch is not None:
+            # Outside the pool lock (quarantine takes it): the replica
+            # loaded weights the rest of the fleet disagrees with —
+            # refuse to keep serving from it.
+            self.quarantine(rep.target, reason="fingerprint",
+                            evidence=fingerprint_mismatch)
+            return
+        if ready and not draining and rep.state == QUARANTINED:
+            # Reverify-readmission for a RESPAWNED quarantined replica:
+            # a different boot_id proves the indicted process is gone
+            # and a fresh one answers — run the fingerprint + canary
+            # checks and re-admit only on a clean pass. The SAME
+            # process incarnation never auto-readmits (its weights are
+            # the ones that answered wrong); that path is the
+            # operator's explicit unquarantine.
+            if boot_id is not None and rep.quarantine_boot_id is not None \
+                    and boot_id != rep.quarantine_boot_id:
+                self.unquarantine(rep.target)
+            return
+        if rep.state == ACTIVE and ready and self.canary is not None:
+            # Canary probing rides the scrape: at most one probe per
+            # replica per canary interval, off the request path (this
+            # runs on the scrape fan-out pool). A False verdict is a
+            # corruption conviction; None (transport) is the breaker's
+            # territory.
+            now = time.monotonic()
+            if now - rep.canary_at >= self.canary.interval:
+                rep.canary_at = now
+                verdict, evidence = self.canary.probe(rep)
+                if verdict is False:
+                    self.quarantine(rep.target, reason="canary",
+                                    evidence=evidence)
+                    return
         if ready and not draining and rep.state == DRAINING \
                 and rep.drain_observed and not rep.decommissioning:
             # (decommissioning replicas never auto-rejoin: the drain is
@@ -747,6 +1005,13 @@ class ReplicaPool:
             if rep.state == DRAINING:
                 # The exit IS the drain completing (GracefulDrain ran).
                 rep.drain_observed = True
+            elif rep.state == QUARANTINED:
+                # Quarantine terminated the child on purpose: respawn a
+                # fresh process but KEEP the quarantined state — the
+                # new boot re-admits only through unquarantine's
+                # fingerprint + canary reverify (the scrape's
+                # boot_id-change path), never the drain auto-rejoin.
+                pass
             else:
                 # The child exited OUTSIDE any drain (crash, or an
                 # undrain racing a child the drain already SIGTERMed):
@@ -1032,6 +1297,7 @@ def _retire_replica_series(target: str) -> None:
     sampler's outstanding/pending gauges retire via its own churn
     handling."""
     REPLICA_HEALTHY.remove(replica=target)
+    REPLICA_QUARANTINED.remove(replica=target)
     requests = REGISTRY.get("tdn_router_requests_total")
     if requests is not None:
         requests.remove_matching(replica=target)

@@ -233,11 +233,16 @@ class Router:
 
     def __init__(self, pool: ReplicaPool, *, retry=_CLIENT_DEFAULT,
                  forward_timeout: float | None = 120.0,
-                 hedge: HedgePolicy | None = None):
+                 hedge: HedgePolicy | None = None,
+                 spotcheck=None):
         self.pool = pool
         # Off by default; a HedgePolicy races a second attempt on the
         # fleet's tail requests (docs/SCALING.md "Request hedging").
         self._hedge = hedge
+        # Off by default; a SpotChecker shadows a sampled fraction of
+        # Process traffic onto a second replica and compares replies
+        # (docs/ROBUSTNESS.md "Silent corruption & quarantine").
+        self.spotcheck = spotcheck
         # max_attempts bounds attempts per REQUEST (across replicas);
         # failover to a fresh replica is immediate, the jittered
         # backoff only paces a second pass over the same replicas.
@@ -355,6 +360,13 @@ class Router:
                 ).inc()
                 if session is not None:
                     self.pool.pin(session, serving.target)
+                if self.spotcheck is not None:
+                    # Shadow spot-check AFTER the reply is secured: the
+                    # duplicate runs off-thread against a second replica
+                    # and never touches this request's latency.
+                    self.spotcheck.maybe_check(
+                        method, payload, reply, serving.target
+                    )
                 if attempt > 1 or serving is not rep:
                     span.annotate(
                         f"served by {serving.target} on attempt "
@@ -367,17 +379,12 @@ class Router:
             # hedge fired and also failed).
             rep = serving
             code = _status_of(err)
-            transient = self._transient(code)
-            if transient:
-                rep.breaker.record_failure()
-            else:
-                # The replica ANSWERED (reachability): close a probe
-                # instead of wedging it, exactly like GrpcClient.
-                rep.breaker.record_success()
+            failover = self._failover_worthy(code)
+            self._observe_failure(rep, code)
             ROUTER_REQUESTS.labels(
                 replica=rep.target, outcome=_code_name(code)
             ).inc()
-            if not transient:
+            if not failover:
                 # Deterministic verdicts propagate verbatim — another
                 # replica would say the same thing. A shed's backoff
                 # hint (x-tdn-retry-after-ms) crosses the hop too:
@@ -636,15 +643,12 @@ class Router:
                     )
                 return
             code = _status_of(err)
-            transient = self._transient(code)
-            if transient:
-                rep.breaker.record_failure()
-            else:
-                rep.breaker.record_success()
+            failover = self._failover_worthy(code)
+            self._observe_failure(rep, code)
             ROUTER_REQUESTS.labels(
                 replica=rep.target, outcome=_code_name(code)
             ).inc()
-            if not transient:
+            if not failover:
                 _copy_retry_after(context, err)
                 span.annotate(
                     f"{_code_name(code)} from {rep.target}: propagated"
@@ -857,7 +861,7 @@ class Router:
                 )
                 return reply, None, r, hedged
             if err is not None and not cancelled:
-                if not self._transient(_status_of(err)):
+                if not self._failover_worthy(_status_of(err)):
                     # A deterministic verdict propagates IMMEDIATELY —
                     # another replica would say the same thing, so
                     # waiting out the other in-flight copy (possibly
@@ -911,12 +915,38 @@ class Router:
             return self._retry.retryable(code)
         return _code_name(code) in RETRYABLE_CODES
 
-    def _record_loser(self, rep, err) -> None:
-        code = _status_of(err)
-        if self._transient(code):
+    def _failover_worthy(self, code) -> bool:
+        """Transient errors fail over; so does DATA_LOSS (an integrity
+        guard refusing to ship an untrustworthy answer) — the one
+        non-transient code where another replica WILL say something
+        different, because the defect is this replica's weights or
+        arithmetic, not the request. DATA_LOSS is deliberately absent
+        from RETRYABLE_CODES so direct clients never retry the same
+        replica; the router's exclusion set gives it failover-to-
+        DIFFERENT-replica semantics instead."""
+        if code == grpc.StatusCode.DATA_LOSS:
+            return True
+        return self._transient(code)
+
+    def _observe_failure(self, rep, code) -> None:
+        """Feed one failed attempt's verdict to the right tripwire.
+        DATA_LOSS closes the breaker probe (the replica ANSWERED —
+        reachability is fine) but counts an integrity strike toward
+        quarantine: the breaker must stay out of it, or the replica
+        could half-open its way back while still computing garbage."""
+        if code == grpc.StatusCode.DATA_LOSS:
+            rep.breaker.record_success()
+            self.pool.note_integrity_error(rep.target)
+        elif self._transient(code):
             rep.breaker.record_failure()
         else:
+            # The replica ANSWERED (reachability): close a probe
+            # instead of wedging it, exactly like GrpcClient.
             rep.breaker.record_success()
+
+    def _record_loser(self, rep, err) -> None:
+        code = _status_of(err)
+        self._observe_failure(rep, code)
         ROUTER_REQUESTS.labels(
             replica=rep.target, outcome=_code_name(code)
         ).inc()
@@ -985,7 +1015,8 @@ def serve_router(pool: ReplicaPool, port: int, *,
                  host: str = "0.0.0.0", max_workers: int = 32,
                  retry=_CLIENT_DEFAULT, interceptors=(),
                  forward_timeout: float | None = 120.0,
-                 hedge: HedgePolicy | None = None):
+                 hedge: HedgePolicy | None = None,
+                 canary=None, spotcheck=None):
     """Start the router on ``host:port``; returns ``(server,
     bound_port)``. ``server.router`` / ``server.pool`` expose the
     internals; ``port=0`` picks an ephemeral port (printed by ``tdn
@@ -995,9 +1026,16 @@ def serve_router(pool: ReplicaPool, port: int, *,
     ``forward_timeout`` caps each forward for deadline-less callers
     (a wedged replica must not hold worker threads forever);
     ``hedge`` arms tail-latency request hedging (off by default —
-    docs/SCALING.md "Request hedging")."""
+    docs/SCALING.md "Request hedging"); ``canary`` (a
+    :class:`~tpu_dist_nn.serving.integrity.CanaryProber`) arms
+    golden-answer probing in the pool's scrape loop and ``spotcheck``
+    (a :class:`~tpu_dist_nn.serving.integrity.SpotChecker`) shadows
+    sampled Process traffic — both off by default
+    (docs/ROBUSTNESS.md "Silent corruption & quarantine")."""
+    if canary is not None:
+        pool.canary = canary
     router = Router(pool, retry=retry, forward_timeout=forward_timeout,
-                    hedge=hedge)
+                    hedge=hedge, spotcheck=spotcheck)
     server = _new_grpc_server(max_workers, interceptors)
     server.add_generic_rpc_handlers((_make_router_handler(router),))
     bound = server.add_insecure_port(f"{host}:{port}")
@@ -1026,6 +1064,9 @@ def router_health(pool: ReplicaPool):
             "role": "router",
             "replicas": len(snap),
             "placeable": len(placeable),
+            "quarantined": sum(
+                1 for s in snap if s["state"] == "quarantined"
+            ),
         }
 
     return health
@@ -1095,7 +1136,14 @@ def admin_post_routes(pool: ReplicaPool | None = None,
       autoscaler override, clamped to min/max, actuated through the
       same drain/spawn choreography); ``?mode=auto`` hands control
       back to the policy. Mounted even without an autoscaler so the
-      operator gets a hint instead of a 404."""
+      operator gets a hint instead of a 404;
+    * ``POST /router/quarantine?replica=T`` /
+      ``POST /router/unquarantine?replica=T[&force=1]`` — the
+      operator's integrity verbs: quarantine pulls a suspect replica
+      out of placement immediately (reason ``operator``); unquarantine
+      re-admits only after the fingerprint + canary reverify passes,
+      unless ``force=1`` overrides the checks
+      (docs/ROBUSTNESS.md "Silent corruption & quarantine")."""
 
     def _one_target(query: str) -> str | None:
         q = urllib.parse.parse_qs(query)
@@ -1157,8 +1205,35 @@ def admin_post_routes(pool: ReplicaPool | None = None,
         doc["granted"] = granted
         return 200, "application/json", json.dumps(doc).encode() + b"\n"
 
+    def quarantine(query: str):
+        target = _one_target(query)
+        if target is None:
+            return 400, "application/json", \
+                b'{"error": "replica= query parameter required"}\n'
+        ok = pool.quarantine(target, reason="operator")
+        status = 200 if ok else 404
+        return status, "application/json", json.dumps(
+            {"replica": target, "quarantined": ok}
+        ).encode() + b"\n"
+
+    def unquarantine(query: str):
+        target = _one_target(query)
+        if target is None:
+            return 400, "application/json", \
+                b'{"error": "replica= query parameter required"}\n'
+        q = urllib.parse.parse_qs(query)
+        force = (q.get("force") or ["0"])[0] not in ("0", "", "false")
+        doc = pool.unquarantine(target, force=force)
+        status = 200 if doc.get("ok") else (
+            404 if doc.get("error") == "not quarantined" else 409
+        )
+        return status, "application/json", \
+            json.dumps(doc).encode() + b"\n"
+
     routes = {"/router/scale": scale}
     if pool is not None:
         routes["/router/drain"] = drain
         routes["/router/undrain"] = undrain
+        routes["/router/quarantine"] = quarantine
+        routes["/router/unquarantine"] = unquarantine
     return routes

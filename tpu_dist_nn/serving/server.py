@@ -379,10 +379,25 @@ class _Batcher:
                     out = self._fetch_fn(handle)
             else:
                 out = self._fetch_fn(handle)
+            # Per-row integrity verdict (engine.fetch stashes a bad-row
+            # mask on the launch handle when the numeric guard tripped):
+            # only the requests whose rows are corrupt fail — with
+            # INTEGRITY, not INTERNAL — and every other request in the
+            # same coalesced launch ships its slice bit-identical.
+            bad = getattr(handle, "bad_rows", None)
             ofs = 0
             for it in group:
                 k = len(it["x"])
-                it["out"] = out[ofs:ofs + k]
+                if bad is not None and bad[ofs:ofs + k].any():
+                    from tpu_dist_nn.utils.errors import IntegrityError
+
+                    it["err"] = IntegrityError(
+                        f"numeric guard: {int(bad[ofs:ofs + k].sum())} "
+                        f"of this request's {k} rows carried non-finite "
+                        f"or out-of-magnitude activations"
+                    )
+                else:
+                    it["out"] = out[ofs:ofs + k]
                 ofs += k
             if self._account_fn is not None:
                 # Post-fetch goodput accounting (static Generate path:
@@ -638,6 +653,7 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
     status cannot land in Process and miss Generate."""
     from tpu_dist_nn.utils.errors import (
         DeadlineExceededError,
+        IntegrityError,
         InvalidArgumentError,
         ResourceExhaustedError,
         UnavailableError,
@@ -646,6 +662,13 @@ def _abort_for_exception(context, e, what: str, method: str = "Process"):
     if isinstance(e, InvalidArgumentError):
         # The reference's dim-check path (grpc_node.py:149-153).
         _abort(context, method, grpc.StatusCode.INVALID_ARGUMENT, str(e))
+    if isinstance(e, IntegrityError):
+        # A correctness check refused to ship the answer: DATA_LOSS —
+        # deliberately NOT in the transient-retry set, so a direct
+        # client never retries the same weights; the router gives it
+        # failover-to-a-DIFFERENT-replica semantics plus an integrity
+        # strike toward quarantine (docs/ROBUSTNESS.md).
+        _abort(context, method, grpc.StatusCode.DATA_LOSS, str(e))
     if isinstance(e, DeadlineExceededError):
         # Batcher wait expired (wedged engine): the reference's
         # per-RPC timeout semantics (grpc_node.py:133).
@@ -960,6 +983,10 @@ def _status_from_code(name: str):
     """Stream END-frame / FrameworkError code name -> gRPC status (the
     stream-side twin of _abort_for_exception's isinstance ladder — by
     the time an error reaches a TokenStream terminal it is a string)."""
+    if name == "INTEGRITY":
+        # IntegrityError.code is the framework taxonomy name; its wire
+        # status is DATA_LOSS (same mapping as _abort_for_exception).
+        return grpc.StatusCode.DATA_LOSS
     try:
         return grpc.StatusCode[name]
     except KeyError:

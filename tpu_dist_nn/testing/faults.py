@@ -234,3 +234,121 @@ def make_interceptor(plan: FaultPlan):
 
 # Alias matching the class-style spelling used in docs/tests.
 FaultInterceptor = make_interceptor
+
+
+# ------------------------------------------------- silent corruption
+# The loud faults above model replicas that FAIL; these model replicas
+# that LIE — they answer fast and wrong, which only the integrity plane
+# (serving/integrity.py: fingerprints, numeric guards, canary probes,
+# shadow spot-checks) can catch. Deterministic by construction so the
+# corruption drill replays bit-for-bit.
+
+
+def bitflip_array(a, *, seed: int = 0):
+    """Flip ONE mantissa bit of one element of a float array, in place
+    (the storage-corruption model: a single flipped bit after a bad
+    checkpoint read). Element and bit are drawn from a private seeded
+    stream. Returns ``(index, bit)`` evidence of what was flipped."""
+    import numpy as np
+
+    a = np.asarray(a)
+    if a.size == 0 or a.dtype.kind != "f":
+        raise ValueError(f"need a non-empty float array, got {a.dtype}")
+    if a.dtype.itemsize not in (4, 8) or not a.flags["C_CONTIGUOUS"]:
+        raise ValueError(
+            f"need a contiguous f32/f64 array to flip in place, got "
+            f"{a.dtype} (contiguous={a.flags['C_CONTIGUOUS']})"
+        )
+    rng = random.Random(seed)
+    flat_index = rng.randrange(a.size)
+    # Low mantissa bits only: the flip must CORRUPT, not explode — an
+    # exponent-bit flip often lands on inf and the cheap numeric guard
+    # would catch it; the silent hazard is a plausible-looking value.
+    bit = rng.randrange(8)
+    utype = np.uint64 if a.dtype.itemsize == 8 else np.uint32
+    view = a.reshape(-1).view(utype)
+    view[flat_index] ^= utype(1 << bit)
+    return flat_index, bit
+
+
+def bitflip_model(model, *, seed: int = 0) -> dict:
+    """Bit-flip one weight of one layer of a
+    :class:`~tpu_dist_nn.core.schema.ModelSpec`, in place — the
+    "corrupt replica" arm of the quarantine drill. Returns evidence
+    naming the flipped location (layer, index, bit)."""
+    rng = random.Random(seed)
+    li = rng.randrange(len(model.layers))
+    index, bit = bitflip_array(model.layers[li].weights, seed=seed + 1)
+    return {"layer": li, "index": index, "bit": bit}
+
+
+def nan_launch(rows=(0,), plan: FaultPlan | None = None):
+    """An engine ``launch_hook`` that poisons input rows with NaN —
+    the launch then SUCCEEDS and produces non-finite activations, which
+    only the numeric guard at the fetch boundary stops from shipping.
+    ``plan`` gates which launches are poisoned (every launch when
+    None); ``rows`` names the victim row indices, so the guard's
+    row-level failover (unaffected rows ship bit-identical) is directly
+    testable."""
+    import numpy as np
+
+    def hook(x):
+        if plan is not None and plan.next_fault() is None:
+            return
+        a = np.asarray(x)
+        if a.dtype.kind != "f":
+            return
+        for r in rows:
+            if 0 <= r < len(a):
+                a[r, ...] = np.nan
+
+    return hook
+
+
+def make_tamper_interceptor(plan: FaultPlan, *, flip: int = 0x01):
+    """The reply-byte tamper: a gRPC server interceptor that XORs the
+    LAST byte of scheduled unary replies — the low-order bits of the
+    final wire float, so the reply still DECODES and the client gets a
+    silently wrong value (no status code, no exception). The detector
+    for this is reply-digest comparison: a canary probe or shadow
+    spot-check (serving/integrity.py), never the error path.
+
+    The plan counts REPLIES (one ``next_fault()`` per completed unary
+    call), so ``at={3: ...}`` tampers exactly the third answer."""
+    import grpc
+
+    class TamperInterceptor(grpc.ServerInterceptor):
+        def __init__(self, p: FaultPlan):
+            self._plan = p
+
+        def intercept_service(self, continuation, handler_call_details):
+            handler = continuation(handler_call_details)
+            if handler is None or handler.unary_unary is None:
+                return handler
+            inner = handler.unary_unary
+
+            def tampered(request, context):
+                reply = inner(request, context)
+                f = self._plan.next_fault()
+                if f is None or not isinstance(reply, (bytes, bytearray)):
+                    return reply
+                if f.seconds:
+                    time.sleep(f.seconds)
+                b = bytearray(reply)
+                if b:
+                    b[-1] ^= flip
+                return bytes(b)
+
+            return grpc.unary_unary_rpc_method_handler(
+                tampered, request_deserializer=bytes,
+                response_serializer=bytes,
+            )
+
+    return TamperInterceptor(plan)
+
+
+def tamper(message: str = "tamper reply bytes") -> Fault:
+    """A schedulable marker fault for tamper/corruption plans: carries
+    no error (the whole point is that NOTHING raises) — the interceptor
+    or hook that receives it mutates data instead."""
+    return Fault(kind="tamper", message=message)

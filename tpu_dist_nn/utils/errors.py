@@ -72,6 +72,21 @@ class ResourceExhaustedError(FrameworkError, RuntimeError):
     code = "RESOURCE_EXHAUSTED"
 
 
+class IntegrityError(FrameworkError, RuntimeError):
+    """A correctness check failed: the result exists but cannot be
+    trusted — non-finite activations past a numeric guard, a checkpoint
+    array whose checksum disagrees with the fingerprint written at save
+    time, a canary probe answering off-golden. Distinct from INTERNAL
+    ("the computation crashed") because the hazard is the opposite: the
+    computation *succeeded* and would have shipped a wrong answer. On
+    the wire this maps to DATA_LOSS — unrecoverable data corruption —
+    which is deliberately NOT in the transient-retry set: the fix is
+    failover to a different replica plus quarantine of this one, never
+    a retry against the same weights."""
+
+    code = "INTEGRITY"
+
+
 def check_full_batch(num_examples: int, batch_size: int) -> None:
     """Fail fast when ``drop_remainder`` batching would yield zero
     batches — shared by every trainer's epoch loop."""
